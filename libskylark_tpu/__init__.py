@@ -1,0 +1,16 @@
+"""libskylark_tpu — TPU-native randomized numerical linear algebra & sketching.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of libSkylark
+(distributed sketching, randomized SVD/least-squares, Krylov solvers,
+kernel machines via random features, graph analytics) for TPU meshes:
+counter-based shard-local sketch realization, GSPMD/pjit sharding instead of
+Elemental distribution templates, `lax.while_loop` solvers, and ICI
+collectives instead of MPI.
+"""
+
+__version__ = "0.1.0"
+
+from . import core
+from .core import SketchContext
+
+__all__ = ["core", "SketchContext", "__version__"]

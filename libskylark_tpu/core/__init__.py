@@ -1,0 +1,25 @@
+"""Core runtime: RNG context, counter-based sampling, QMC, random matrices.
+
+TPU-native re-design of the reference's ``base/`` + ``utility/`` layers.
+"""
+
+from .context import SketchContext
+from .matrices import gaussian_matrix, random_matrix, uniform_matrix
+from .params import Params
+from .quasirand import LeapedHaltonSequence, primes, radical_inverse
+from .random import sample, sample_window, raw_bits, window_bits
+
+__all__ = [
+    "SketchContext",
+    "Params",
+    "LeapedHaltonSequence",
+    "primes",
+    "radical_inverse",
+    "sample",
+    "sample_window",
+    "raw_bits",
+    "window_bits",
+    "random_matrix",
+    "gaussian_matrix",
+    "uniform_matrix",
+]

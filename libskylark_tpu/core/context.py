@@ -1,0 +1,61 @@
+"""Deterministic RNG context: the global (seed, counter) sample stream.
+
+Re-design of ``base/context.hpp:19-183``: every consumer of randomness
+*reserves* a contiguous range of the counter stream and records its base;
+values are generated lazily (and shard-locally) from the counters.  This
+makes every transform reconstructible from ~100 bytes of JSON and makes
+results independent of device count — the invariant the reference's
+distributed-vs-local tests are built on (``tests/unit/DenseSketchApply
+ElementalTest.cpp:52-102``).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+__all__ = ["SketchContext"]
+
+_SERIAL_VERSION = 1
+
+
+@dataclass
+class SketchContext:
+    """Mutable counter-reserving context (mirrors ``context_t``).
+
+    ``reserve(size)`` returns the base counter of a freshly reserved block
+    and advances the stream — the analogue of
+    ``context_t::allocate_random_samples_array`` (``base/context.hpp:94-101``).
+    """
+
+    seed: int = 0
+    counter: int = 0
+
+    def reserve(self, size: int) -> int:
+        if size < 0:
+            raise ValueError(f"cannot reserve a negative block ({size})")
+        base = self.counter
+        self.counter += int(size)
+        return base
+
+    # -- serialization (≙ base/context.hpp:50-62 to_ptree) ------------------
+
+    def to_dict(self) -> dict[str, Any]:
+        return {
+            "skylark_object_type": "context",
+            "skylark_version": _SERIAL_VERSION,
+            "seed": int(self.seed),
+            "counter": int(self.counter),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "SketchContext":
+        return cls(seed=int(d["seed"]), counter=int(d["counter"]))
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @classmethod
+    def from_json(cls, s: str) -> "SketchContext":
+        return cls.from_dict(json.loads(s))

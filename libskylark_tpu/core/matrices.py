@@ -1,0 +1,44 @@
+"""Deterministic random matrix generation (≙ ``base/random_matrices.hpp``).
+
+The reference guarantees the generated matrix is identical regardless of how
+many MPI processes generate it (each rank fills its local entries from the
+global counter stream, ``base/random_matrices.hpp:22-177``).  Here the same
+guarantee falls out of the counter-based window generator: the full logical
+matrix is a pure function of (seed, base), and GSPMD shards its generation
+with whatever sharding the consumer requests.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from .context import SketchContext
+from .random import sample_window
+
+__all__ = ["random_matrix", "gaussian_matrix", "uniform_matrix"]
+
+
+def random_matrix(
+    ctx: SketchContext,
+    shape: tuple[int, int],
+    dist: str = "normal",
+    dtype=jnp.float32,
+    **params: Any,
+):
+    """Draw a (rows, cols) matrix from the context's stream, advancing it."""
+    rows, cols = shape
+    base = ctx.reserve(rows * cols)
+    return sample_window(dist, ctx.seed, base, (rows, cols), dtype=dtype, **params)
+
+
+def gaussian_matrix(ctx, shape, dtype=jnp.float32, mean=0.0, stddev=1.0):
+    x = random_matrix(ctx, shape, "normal", dtype=dtype)
+    if mean != 0.0 or stddev != 1.0:
+        x = x * stddev + mean
+    return x
+
+
+def uniform_matrix(ctx, shape, dtype=jnp.float32, low=0.0, high=1.0):
+    return random_matrix(ctx, shape, "uniform", dtype=dtype, low=low, high=high)

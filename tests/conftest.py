@@ -1,0 +1,31 @@
+"""Test configuration: force an 8-device virtual CPU mesh and float64.
+
+Multi-chip behavior is tested on virtual CPU devices the way the reference
+tests multi-node behavior with `mpirun -np K` on one box
+(`tests/unit/CMakeLists.txt:11-38`).  x64 is enabled for numerical-parity
+checks against the reference's double-precision semantics.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The axon sitecustomize force-sets jax_platforms to "axon,cpu"; tests run on
+# the virtual 8-device CPU mesh, so override back to cpu-only.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
