@@ -10,7 +10,7 @@ collectives instead of MPI.
 
 __version__ = "0.1.0"
 
-from . import core
+from . import core, sketch
 from .core import SketchContext
 
-__all__ = ["core", "SketchContext", "__version__"]
+__all__ = ["core", "sketch", "SketchContext", "__version__"]

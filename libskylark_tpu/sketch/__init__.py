@@ -1,0 +1,42 @@
+"""Sketching layer: random dimensionality-reducing linear/feature maps.
+
+TPU-native re-design of the reference's ``sketch/`` layer (~19.7 kLoC of
+per-distribution template specializations collapse to one GSPMD-sharded
+implementation per transform family).
+"""
+
+from .base import (
+    COLUMNWISE,
+    ROWWISE,
+    Dimension,
+    SketchTransform,
+    create_sketch,
+    from_dict,
+    from_json,
+    register_sketch,
+    sketch_registry,
+)
+from .dense import CT, JLT, DenseSketch
+from .hash import CWT, MMT, WZT, HashSketch
+from .sampling import NURST, UST
+
+__all__ = [
+    "Dimension",
+    "COLUMNWISE",
+    "ROWWISE",
+    "SketchTransform",
+    "create_sketch",
+    "from_dict",
+    "from_json",
+    "register_sketch",
+    "sketch_registry",
+    "DenseSketch",
+    "JLT",
+    "CT",
+    "HashSketch",
+    "CWT",
+    "MMT",
+    "WZT",
+    "UST",
+    "NURST",
+]

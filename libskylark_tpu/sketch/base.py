@@ -1,0 +1,185 @@
+"""Sketch transform protocol, type registry, and JSON serialization.
+
+TPU-native re-design of the reference's sketch layer scaffolding:
+
+- ``SketchTransform`` ≙ ``sketch_transform_t<In, Out>``
+  (``sketch/sketch_transform.hpp:16-48``), with the C++ tag dispatch
+  (``columnwise_tag``/``rowwise_tag``) replaced by a ``Dimension`` enum and
+  the per-(input-type × output-type) template specializations replaced by a
+  single JAX implementation that works for any sharding under GSPMD.
+- The JSON registry ≙ ``sketch/sketch_add.hpp:15-52`` — every concrete
+  transform registers its ``sketch_type`` string so serialized sketches can
+  be reconstructed by name (used by the C API / Python layer in the
+  reference, and by model persistence here).
+- Serialization keeps the reference's property-tree schema in spirit
+  (``sketch/sketch_transform_data.hpp:64-71``): a sketch is reconstructible
+  from ``(sketch_type, N, S, creation_context, params)`` — ~100 bytes of
+  JSON — because all randomness is counter-derived.
+
+Conventions (fixing the reference's math in array terms):
+
+- A transform maps R^N -> R^S.  Its logical sketch matrix ``Omega`` has
+  shape ``(S, N)``.
+- ``apply(A, Dimension.COLUMNWISE)``: ``A`` is ``(N, m)``; result is
+  ``Omega @ A`` with shape ``(S, m)`` — each *column* of A is sketched.
+- ``apply(A, Dimension.ROWWISE)``: ``A`` is ``(m, N)``; result is
+  ``A @ Omega.T`` with shape ``(m, S)`` — each *row* of A is sketched.
+
+This matches ``sketch/transforms.hpp:12-18`` (S·A columnwise, A·Sᵀ rowwise).
+"""
+
+from __future__ import annotations
+
+import abc
+import enum
+import json
+from typing import Any, Callable, ClassVar
+
+from ..core.context import SketchContext
+
+__all__ = [
+    "Dimension",
+    "SketchTransform",
+    "register_sketch",
+    "sketch_registry",
+    "create_sketch",
+    "from_dict",
+    "from_json",
+    "SERIAL_VERSION",
+]
+
+SERIAL_VERSION = 1
+
+
+class Dimension(enum.Enum):
+    """Which dimension of A is sketched (≙ columnwise_tag / rowwise_tag)."""
+
+    COLUMNWISE = "columnwise"
+    ROWWISE = "rowwise"
+
+    @classmethod
+    def of(cls, d: "Dimension | str") -> "Dimension":
+        if isinstance(d, Dimension):
+            return d
+        return cls(str(d).lower())
+
+
+COLUMNWISE = Dimension.COLUMNWISE
+ROWWISE = Dimension.ROWWISE
+
+_REGISTRY: dict[str, type["SketchTransform"]] = {}
+
+
+def register_sketch(cls: type["SketchTransform"]) -> type["SketchTransform"]:
+    """Class decorator: register under ``cls.sketch_type`` (≙ sketch_add.hpp)."""
+    _REGISTRY[cls.sketch_type] = cls
+    return cls
+
+
+def sketch_registry() -> dict[str, type["SketchTransform"]]:
+    return dict(_REGISTRY)
+
+
+class SketchTransform(abc.ABC):
+    """A random linear (or feature) map R^N -> R^S, reconstructible from JSON.
+
+    Subclass contract:
+    - ``__init__(n, s, ..., context)`` must snapshot ``context`` (seed +
+      counter) *before* reserving, into ``self._creation_context``, then
+      reserve all counter blocks it needs.  The helper ``_snapshot`` does
+      the first part.
+    - ``_param_dict()`` returns the extra JSON fields (e.g. ``sigma``).
+    - ``_from_param_dict(d, ctx)`` (classmethod) rebuilds from those fields.
+    """
+
+    sketch_type: ClassVar[str] = "Abstract"
+
+    def __init__(self, n: int, s: int, context: SketchContext):
+        if n <= 0 or s <= 0:
+            raise ValueError(f"sketch dims must be positive, got N={n}, S={s}")
+        self.n = int(n)
+        self.s = int(s)
+        self._creation_context = SketchContext(
+            seed=context.seed, counter=context.counter
+        )
+
+    # -- core op ------------------------------------------------------------
+
+    @abc.abstractmethod
+    def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
+        """Sketch ``A`` along ``dim``; returns a new array (functional)."""
+
+    def __call__(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
+        return self.apply(A, dim)
+
+    # Convenience mirroring the python-skylark operator sugar
+    # (python-skylark/skylark/sketch.py: __mul__ = columnwise, __div__ = rowwise).
+    def __mul__(self, A):
+        return self.apply(A, Dimension.COLUMNWISE)
+
+    def __truediv__(self, A):
+        return self.apply(A, Dimension.ROWWISE)
+
+    # -- serialization ------------------------------------------------------
+
+    def _param_dict(self) -> dict[str, Any]:
+        return {}
+
+    def to_dict(self) -> dict[str, Any]:
+        """≙ ``sketch_transform_data_t::add_common`` + subclass fields."""
+        d = {
+            "skylark_object_type": "sketch",
+            "skylark_version": SERIAL_VERSION,
+            "sketch_type": self.sketch_type,
+            "N": self.n,
+            "S": self.s,
+            "creation_context": self._creation_context.to_dict(),
+        }
+        d.update(self._param_dict())
+        return d
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @classmethod
+    def _from_param_dict(
+        cls, d: dict[str, Any], context: SketchContext
+    ) -> "SketchTransform":
+        return cls(d["N"], d["S"], context)  # type: ignore[call-arg]
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "SketchTransform":
+        ctx = SketchContext.from_dict(d["creation_context"])
+        return cls._from_param_dict(d, ctx)
+
+    @classmethod
+    def from_json(cls, s: str) -> "SketchTransform":
+        return cls.from_dict(json.loads(s))
+
+    def __repr__(self):
+        return f"{type(self).__name__}(N={self.n}, S={self.s})"
+
+
+def from_dict(d: dict[str, Any]) -> SketchTransform:
+    """Reconstruct any registered sketch from its dict (≙ from_ptree registry)."""
+    t = d["sketch_type"]
+    if t not in _REGISTRY:
+        raise ValueError(
+            f"unknown sketch_type {t!r}; known: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[t].from_dict(d)
+
+
+def from_json(s: str) -> SketchTransform:
+    return from_dict(json.loads(s))
+
+
+def create_sketch(
+    sketch_type: str, n: int, s: int, context: SketchContext, **params: Any
+) -> SketchTransform:
+    """String-typed factory (≙ ``capi/csketch.cpp:15-58`` / ``create_sketch``)."""
+    if sketch_type not in _REGISTRY:
+        raise ValueError(
+            f"unknown sketch_type {sketch_type!r}; known: {sorted(_REGISTRY)}"
+        )
+    return _REGISTRY[sketch_type](n, s, context=context, **params)

@@ -1,0 +1,145 @@
+"""Dense counter-based sketches: JLT, CT, and the lazy dense-transform engine.
+
+Re-design of the reference's dense_transform machinery
+(``sketch/dense_transform_data.hpp:22-152`` + the ~13
+``dense_transform_Elemental_*.hpp`` apply specializations): the sketch
+matrix ``Omega`` (shape (S, N)) is *never stored and never communicated* —
+any window of it is a pure function of ``(seed, base_counter, i, j)``
+(reference invariant P5, ``base/randgen.hpp:98-115``).  Here that is
+``core.random.sample_window``; entry (i, j) uses counter
+``base + i*N + j`` (row-major over the logical (S, N) matrix).
+
+Distribution-aware apply specializations collapse to a single einsum:
+under ``jit``/GSPMD the window generation is elementwise over an iota, so
+XLA shards Omega's generation to match whatever sharding the matmul wants,
+and the communication schedule (reduce-scatter within mesh rows/cols ≙
+``dense_transform_Elemental_mc_mr.hpp:179,302,599``; communication-free for
+the replicated-axis case ≙ ``doc/sphinx/sketching.rst:104-118``) is chosen
+by the compiler.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..core.context import SketchContext
+from ..core.random import sample_window
+from .base import Dimension, SketchTransform, register_sketch
+
+__all__ = ["DenseSketch", "JLT", "CT"]
+
+
+class DenseSketch(SketchTransform):
+    """Sketch with iid entries ``scale * dist()`` — the dense engine.
+
+    ``dist`` is a key of ``core.random.DISTRIBUTIONS``; ``scale`` is a
+    deterministic scalar (e.g. 1/sqrt(S) for JLT).
+    """
+
+    dist: str = "normal"
+
+    def __init__(
+        self,
+        n: int,
+        s: int,
+        context: SketchContext,
+        scale: float = 1.0,
+        dist_params: dict[str, Any] | None = None,
+    ):
+        super().__init__(n, s, context)
+        self.scale = float(scale)
+        self._dist_params = dict(dist_params or {})
+        self._seed = context.seed
+        # ≙ context.allocate_random_samples_array(N*S) (base/context.hpp:94-101)
+        self._base = context.reserve(n * s)
+
+    # -- lazy realization (≙ realize_matrix_view) ---------------------------
+
+    def realize(
+        self,
+        dtype=jnp.float32,
+        offset: tuple[int, int] = (0, 0),
+        shape: tuple[int, int] | None = None,
+    ):
+        """Materialize a window of the logical (S, N) sketch matrix.
+
+        Any window is bit-identical to the corresponding slice of the full
+        matrix (shard-local realization, ``dense_transform_data.hpp:79-152``).
+        """
+        w = sample_window(
+            self.dist,
+            self._seed,
+            self._base,
+            (self.s, self.n),
+            dtype=dtype,
+            offset=offset,
+            shape=shape,
+            **self._dist_params,
+        )
+        return w * jnp.asarray(self.scale, dtype)
+
+    # -- apply --------------------------------------------------------------
+
+    def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
+        dim = Dimension.of(dim)
+        A = jnp.asarray(A) if not hasattr(A, "todense") else A
+        dtype = A.dtype
+        if not jnp.issubdtype(dtype, jnp.floating):
+            dtype = jnp.float32
+        omega = self.realize(dtype)
+        if dim is Dimension.COLUMNWISE:
+            if A.shape[0] != self.n:
+                raise ValueError(
+                    f"columnwise apply needs A with {self.n} rows, "
+                    f"got {A.shape}"
+                )
+            return _matmul(omega, A)
+        if A.shape[-1] != self.n:
+            raise ValueError(
+                f"rowwise apply needs A with {self.n} columns, got {A.shape}"
+            )
+        return _matmul(A, omega.T)
+
+
+def _matmul(x, y):
+    """Dense@dense or mixed dense/BCOO matmul (≙ base::Gemm dispatch)."""
+    from jax.experimental import sparse as jsparse
+
+    if isinstance(x, jsparse.BCOO) or isinstance(y, jsparse.BCOO):
+        return x @ y
+    return jnp.matmul(x, y)
+
+
+@register_sketch
+class JLT(DenseSketch):
+    """Johnson-Lindenstrauss: iid N(0, 1/S) dense sketch — l2 subspace
+    embedding (≙ ``sketch/JLT_data.hpp:17-48``: normal entries, scale
+    sqrt(1/S))."""
+
+    sketch_type = "JLT"
+    dist = "normal"
+
+    def __init__(self, n: int, s: int, context: SketchContext):
+        super().__init__(n, s, context, scale=(1.0 / s) ** 0.5)
+
+
+@register_sketch
+class CT(DenseSketch):
+    """Cauchy transform: iid Cauchy entries scaled C/S — l1 embedding
+    (Sohler-Woodruff; ≙ ``sketch/CT_data.hpp:20-47``: scale C/S)."""
+
+    sketch_type = "CT"
+    dist = "cauchy"
+
+    def __init__(self, n: int, s: int, context: SketchContext, C: float = 1.0):
+        self.C = float(C)
+        super().__init__(n, s, context, scale=self.C / s)
+
+    def _param_dict(self):
+        return {"C": self.C}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(d["N"], d["S"], context, C=d.get("C", 1.0))

@@ -1,0 +1,167 @@
+"""Hash (CountSketch-family) sketches: CWT, MMT, WZT.
+
+Re-design of the reference's hash_transform engine
+(``sketch/hash_transform_data.hpp:21-104`` + the Elemental / local-sparse /
+CombBLAS apply specializations, ``sketch/hash_transform_Elemental.hpp``,
+``hash_transform_local_sparse.hpp``, ``hash_transform_CombBLAS.hpp``):
+each input coordinate i in [0, N) is hashed to one output slot
+``bucket[i] ~ U{0..S-1}`` with a random scaling ``value[i]`` (±1 for CWT,
+Cauchy for MMT, signed reciprocal-exponential for WZT).  Columnwise,
+
+    SA[r, :] = sum_{i : bucket[i] == r} value[i] * A[i, :]
+
+Both arrays are counter-derived (two reserved blocks of N), so any shard can
+compute its own slice of (bucket, value) without communication — the same
+"hash arrays precomputed from the context" design as the reference, minus
+the materialized std::vectors.
+
+TPU mapping: the scatter-add becomes ``jax.ops.segment_sum`` (XLA scatter,
+which GSPMD handles sharded); for BCOO sparse inputs the hash relabels
+row/col indices directly and defers duplicate summation — exactly the
+queue-then-finalize CSC build of ``hash_transform_local_sparse.hpp:88-152``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.context import SketchContext
+from ..core.random import sample
+from .base import Dimension, SketchTransform, register_sketch
+
+__all__ = ["HashSketch", "CWT", "MMT", "WZT"]
+
+
+class HashSketch(SketchTransform):
+    """Base engine: bucket ~ uniform_int(0, S-1), value ~ ``value_dist``."""
+
+    value_dist: str = "rademacher"
+
+    def __init__(self, n: int, s: int, context: SketchContext):
+        super().__init__(n, s, context)
+        self._seed = context.seed
+        # ≙ hash_transform_data_t::build: two generate_random_samples_array(N)
+        # calls (idx then value), hash_transform_data.hpp:66-73.
+        self._idx_base = context.reserve(n)
+        self._val_base = context.reserve(n)
+
+    # -- counter-derived hash arrays ---------------------------------------
+
+    def buckets(self, start: int = 0, num: int | None = None):
+        """bucket[i] for i in [start, start+num) — shard-local computable."""
+        num = self.n - start if num is None else num
+        return sample(
+            "uniform_int",
+            self._seed,
+            self._idx_base + start,
+            num,
+            dtype=jnp.int32,
+            low=0,
+            high=self.s - 1,
+        )
+
+    def values(self, dtype=jnp.float32, start: int = 0, num: int | None = None):
+        num = self.n - start if num is None else num
+        return sample(self.value_dist, self._seed, self._val_base + start, num, dtype=dtype)
+
+    # -- apply --------------------------------------------------------------
+
+    def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
+        dim = Dimension.of(dim)
+        if isinstance(A, jsparse.BCOO):
+            return self._apply_sparse(A, dim)
+        return self._apply_dense(jnp.asarray(A), dim)
+
+    def _apply_dense(self, A, dim: Dimension):
+        dtype = A.dtype if jnp.issubdtype(A.dtype, jnp.floating) else jnp.float32
+        buckets = self.buckets()
+        values = self.values(dtype)
+        if dim is Dimension.COLUMNWISE:
+            if A.shape[0] != self.n:
+                raise ValueError(
+                    f"columnwise apply needs A with {self.n} rows, got {A.shape}"
+                )
+            # SA[r, c] = sum_{i: b[i]=r} v[i] A[i, c]  — one XLA scatter-add.
+            return jax.ops.segment_sum(
+                values[:, None] * A, buckets, num_segments=self.s
+            )
+        if A.shape[-1] != self.n:
+            raise ValueError(
+                f"rowwise apply needs A with {self.n} columns, got {A.shape}"
+            )
+        # AS[r, c] = sum_{j: b[j]=c} v[j] A[r, j]: segment over columns.
+        return jax.ops.segment_sum(
+            (A * values[None, :]).T, buckets, num_segments=self.s
+        ).T
+
+    def _apply_sparse(self, A: jsparse.BCOO, dim: Dimension):
+        """BCOO → BCOO: relabel hashed indices, scale data, sum duplicates
+        (≙ the local CSC build of hash_transform_local_sparse.hpp:88-152)."""
+        dtype = A.data.dtype
+        axis = 0 if dim is Dimension.COLUMNWISE else 1
+        if A.shape[axis] != self.n:
+            raise ValueError(
+                f"{dim.value} apply needs A with {self.n} on axis {axis}, "
+                f"got {A.shape}"
+            )
+        buckets = self.buckets()
+        values = self.values(dtype)
+        hashed = A.indices[:, axis]
+        new_idx = A.indices.at[:, axis].set(buckets[hashed])
+        new_data = A.data * values[hashed]
+        shape = (
+            (self.s, A.shape[1]) if axis == 0 else (A.shape[0], self.s)
+        )
+        out = jsparse.BCOO((new_data, new_idx), shape=shape)
+        return out.sum_duplicates(nse=min(out.nse, shape[0] * shape[1]))
+
+
+@register_sketch
+class CWT(HashSketch):
+    """Clarkson-Woodruff (CountSketch, OSNAP s=1): bucket + Rademacher sign —
+    l2 embedding in input-sparsity time (≙ ``sketch/CWT_data.hpp:23-42``)."""
+
+    sketch_type = "CWT"
+    value_dist = "rademacher"
+
+
+@register_sketch
+class MMT(HashSketch):
+    """Meng-Mahoney: bucket + Cauchy values — l1 embedding
+    (≙ ``sketch/MMT_data.hpp:21-44``)."""
+
+    sketch_type = "MMT"
+    value_dist = "cauchy"
+
+
+@register_sketch
+class WZT(HashSketch):
+    """Woodruff-Zhang: bucket + signed reciprocal-exponential values — lp
+    embedding, 1 <= p <= 2 (≙ ``sketch/WZT_data.hpp:45-127``: value =
+    ±(1/Exp)^(1/p), an extra Rademacher block of N reserved after the base
+    two)."""
+
+    sketch_type = "WZT"
+    value_dist = "exponential"
+
+    def __init__(self, n: int, s: int, context: SketchContext, p: float = 2.0):
+        if not 1.0 <= p <= 2.0:
+            raise ValueError(f"WZT parameter p must be in [1, 2], got {p}")
+        self.p = float(p)
+        super().__init__(n, s, context)
+        self._pm_base = context.reserve(n)
+
+    def values(self, dtype=jnp.float32, start: int = 0, num: int | None = None):
+        num = self.n - start if num is None else num
+        e = sample("exponential", self._seed, self._val_base + start, num, dtype=dtype)
+        pm = sample("rademacher", self._seed, self._pm_base + start, num, dtype=dtype)
+        return pm * (1.0 / e) ** jnp.asarray(1.0 / self.p, dtype)
+
+    def _param_dict(self):
+        return {"P": self.p}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(d["N"], d["S"], context, p=d.get("P", 2.0))

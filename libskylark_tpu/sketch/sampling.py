@@ -1,0 +1,136 @@
+"""Sampling sketches: UST (uniform) and NURST (non-uniform).
+
+Re-design of ``sketch/UST_data.hpp:18-113`` / ``sketch/UST_Elemental.hpp``
+(pure coordinate selection, no rescaling: ``sa[i] = a[samples[i]]``) and the
+python-only non-uniform variant ``NURST``
+(``python-skylark/skylark/sketch.py`` URST/NURST classes).
+
+Without-replacement sampling: the reference runs an incremental Fisher-Yates
+shuffle over all N indices and keeps the first S
+(``sketch/UST_data.hpp:95-104``).  Here we instead rank N counter-derived
+uniform keys and keep the argmin-S — also an exchangeable uniform draw of S
+distinct indices, but random-access/shard-computable and vectorized (a
+sequential Fisher-Yates would defeat the counter design on TPU).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np  # noqa: F401  (host-side prob preprocessing)
+
+from ..core.context import SketchContext
+from ..core.random import sample
+from .base import Dimension, SketchTransform, register_sketch
+
+__all__ = ["UST", "NURST"]
+
+
+@register_sketch
+class UST(SketchTransform):
+    """Uniform sampling transform, with or without replacement."""
+
+    sketch_type = "UST"
+
+    def __init__(
+        self, n: int, s: int, context: SketchContext, replace: bool = True
+    ):
+        self.replace = bool(replace)
+        super().__init__(n, s, context)
+        self._seed = context.seed
+        if self.replace:
+            self._base = context.reserve(s)
+        else:
+            if s > n:
+                raise ValueError(
+                    f"cannot sample {s} of {n} without replacement"
+                )
+            self._base = context.reserve(n)
+
+    @property
+    def samples(self):
+        """The S selected input coordinates (deterministic)."""
+        if self.replace:
+            return sample(
+                "uniform_int",
+                self._seed,
+                self._base,
+                self.s,
+                dtype=jnp.int32,
+                low=0,
+                high=self.n - 1,
+            )
+        # S smallest of N uniform keys == uniform S-subset, in random order.
+        keys = sample("uniform", self._seed, self._base, self.n)
+        return jnp.argsort(keys)[: self.s].astype(jnp.int32)
+
+    def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
+        dim = Dimension.of(dim)
+        A = jnp.asarray(A)
+        idx = self.samples
+        if dim is Dimension.COLUMNWISE:
+            if A.shape[0] != self.n:
+                raise ValueError(
+                    f"columnwise apply needs A with {self.n} rows, got {A.shape}"
+                )
+            return A[idx, :] if A.ndim > 1 else A[idx]
+        if A.shape[-1] != self.n:
+            raise ValueError(
+                f"rowwise apply needs A with {self.n} columns, got {A.shape}"
+            )
+        return A[..., idx]
+
+    def _param_dict(self):
+        return {"replace": self.replace}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(d["N"], d["S"], context, replace=d.get("replace", True))
+
+
+@register_sketch
+class NURST(SketchTransform):
+    """Non-uniform (weighted, with-replacement) row sampling transform.
+
+    ≙ python-skylark's NURST (pure-python; not exposed in the C API).
+    Selection uses inverse-CDF over the provided probability vector with S
+    counter-derived uniforms; like UST, pure selection without rescaling.
+    """
+
+    sketch_type = "NURST"
+
+    def __init__(self, n, s, context: SketchContext, probs):
+        super().__init__(n, s, context)
+        self.probs = np.asarray(probs, dtype=np.float64)
+        if self.probs.shape != (n,):
+            raise ValueError(f"probs must have shape ({n},), got {self.probs.shape}")
+        if (self.probs < 0).any():
+            raise ValueError("probs must be nonnegative")
+        total = self.probs.sum()
+        if total <= 0:
+            raise ValueError("probs must sum to a positive value")
+        self.probs = self.probs / total
+        self._seed = context.seed
+        self._base = context.reserve(s)
+
+    @property
+    def samples(self):
+        u = sample("uniform", self._seed, self._base, self.s, dtype=jnp.float32)
+        cdf = jnp.asarray(np.cumsum(self.probs))
+        return jnp.clip(
+            jnp.searchsorted(cdf, u.astype(cdf.dtype)), 0, self.n - 1
+        ).astype(jnp.int32)
+
+    def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
+        dim = Dimension.of(dim)
+        A = jnp.asarray(A)
+        idx = self.samples
+        if dim is Dimension.COLUMNWISE:
+            return A[idx, :] if A.ndim > 1 else A[idx]
+        return A[..., idx]
+
+    def _param_dict(self):
+        return {"probs": self.probs.tolist()}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(d["N"], d["S"], context, probs=d["probs"])
