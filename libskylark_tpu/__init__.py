@@ -10,7 +10,15 @@ collectives instead of MPI.
 
 __version__ = "0.1.0"
 
-from . import core, sketch
+from . import core, io, linalg, parallel, sketch
 from .core import SketchContext
 
-__all__ = ["core", "sketch", "SketchContext", "__version__"]
+__all__ = [
+    "core",
+    "io",
+    "linalg",
+    "parallel",
+    "sketch",
+    "SketchContext",
+    "__version__",
+]

@@ -1,0 +1,4 @@
+"""Command-line drivers (≙ reference ``nla/skylark_*.cpp``, ``ml/skylark_*.cpp``).
+
+Run as modules: ``python -m libskylark_tpu.cli.svd ...`` etc.
+"""

@@ -1,0 +1,5 @@
+"""Dataset IO (≙ reference ``ml/io.hpp``, ``utility/io/libsvm_io.hpp``)."""
+
+from .libsvm import read_libsvm, write_libsvm
+
+__all__ = ["read_libsvm", "write_libsvm"]

@@ -1,0 +1,94 @@
+"""LIBSVM-format reader/writer.
+
+≙ the reference's chunked MPI LIBSVM reader
+(``utility/io/libsvm_io.hpp:529+``, ``ml/io.hpp:529-889``): rank 0 reads and
+ships chunks over MPI.  On TPU the host reads once and ``jax.device_put``
+with a sharding distributes — there is no per-rank file chunking to port.
+
+Convention: examples are **rows** — X is (n_examples, n_features) — the
+idiomatic JAX layout (the reference stores examples as columns of a d×n
+Elemental matrix; its columnwise/rowwise sketch tags already abstract this).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["read_libsvm", "write_libsvm"]
+
+
+def read_libsvm(
+    path: str,
+    n_features: int | None = None,
+    sparse: bool = False,
+    dtype=np.float64,
+):
+    """Read a LIBSVM file → ``(X, y)``.
+
+    ``sparse=True`` returns a ``jax.experimental.sparse.BCOO``; otherwise a
+    dense ndarray.  ``n_features`` pads/clips the feature dimension (the
+    reference's ``min_d`` flag, ``ml/io.hpp:534``).  Indices are 1-based in
+    the file (LIBSVM standard, matching the reference reader).
+    """
+    labels: list[float] = []
+    rows: list[int] = []
+    cols: list[int] = []
+    vals: list[float] = []
+    max_col = 0
+    with open(path, "r") as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            labels.append(float(parts[0]))
+            r = len(labels) - 1
+            for tok in parts[1:]:
+                idx, val = tok.split(":", 1)
+                c = int(idx) - 1
+                if c < 0:
+                    raise ValueError(f"bad LIBSVM index {idx!r} (1-based)")
+                max_col = max(max_col, c + 1)
+                rows.append(r)
+                cols.append(c)
+                vals.append(float(val))
+    n = len(labels)
+    d = n_features if n_features is not None else max_col
+    y = np.asarray(labels, dtype=dtype)
+    keep = [i for i in range(len(cols)) if cols[i] < d]
+    if sparse:
+        from jax.experimental import sparse as jsparse
+        import jax.numpy as jnp
+
+        idx = np.stack(
+            [np.asarray(rows)[keep], np.asarray(cols)[keep]], axis=1
+        ).astype(np.int32)
+        data = np.asarray(vals, dtype=dtype)[keep]
+        X = jsparse.BCOO(
+            (jnp.asarray(data), jnp.asarray(idx)), shape=(n, d)
+        )
+        return X, y
+    X = np.zeros((n, d), dtype=dtype)
+    for i in keep:
+        X[rows[i], cols[i]] = vals[i]
+    return X, y
+
+
+def write_libsvm(path: str, X, y) -> None:
+    """Write dense or BCOO ``X`` with labels ``y`` in LIBSVM format."""
+    X = np.asarray(X.todense()) if hasattr(X, "todense") else np.asarray(X)
+    y = np.asarray(y)
+    with open(path, "w") as f:
+        for i in range(X.shape[0]):
+            label = y[i]
+            lab = (
+                str(int(label))
+                if float(label).is_integer()
+                else repr(float(label))
+            )
+            feats = " ".join(
+                f"{j + 1}:{X[i, j]:.17g}"
+                for j in range(X.shape[1])
+                if X[i, j] != 0
+            )
+            f.write(f"{lab} {feats}\n".rstrip() + "\n")

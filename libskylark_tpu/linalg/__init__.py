@@ -1,0 +1,33 @@
+"""Randomized NLA primitives (≙ reference ``nla/``).
+
+- ``approximate_svd`` / ``approximate_symmetric_svd`` / ``power_iteration``
+  ≙ ``nla/svd.hpp``
+- ``exact_least_squares`` (QR/SNE/NE/SVD paths) ≙
+  ``algorithms/regression/linearl2_regression_solver_Elemental.hpp``
+- ``approximate_least_squares`` (sketch-and-solve) ≙
+  ``nla/least_squares.hpp:42-184``
+- ``faster_least_squares`` (Blendenpik) and ``cond_est`` live in
+  ``solvers``-backed modules and are re-exported here once built.
+"""
+
+from .least_squares import (
+    LeastSquaresParams,
+    approximate_least_squares,
+    exact_least_squares,
+)
+from .svd import (
+    SVDParams,
+    approximate_svd,
+    approximate_symmetric_svd,
+    power_iteration,
+)
+
+__all__ = [
+    "SVDParams",
+    "approximate_svd",
+    "approximate_symmetric_svd",
+    "power_iteration",
+    "LeastSquaresParams",
+    "approximate_least_squares",
+    "exact_least_squares",
+]

@@ -1,0 +1,38 @@
+"""Mesh/sharding helpers — the single communication backend.
+
+Replaces the reference's MPI substrate (Boost.MPI communicators, Elemental
+grids, CombBLAS comm grids — ``utility/get_communicator.hpp:26-50``,
+``utility/external/combblas_comm_grid.hpp``) with one module wrapping
+``jax.sharding.Mesh`` + GSPMD shardings.  The reference's per-distribution
+template specializations (``[MC,MR]``, ``[VC,*]``, ``[*,VR]``,
+``[CIRC,CIRC]``, ...) collapse to `PartitionSpec`s over a named mesh;
+collectives (psum / psum_scatter / all_gather / all_to_all) are emitted by
+XLA from sharding constraints, or explicitly under ``shard_map`` where an
+invariant must be enforced by hand.
+"""
+
+from .mesh import (
+    ROWS,
+    COLS,
+    default_mesh,
+    fully_replicated,
+    make_mesh,
+    replicate,
+    shard,
+    shard_cols,
+    shard_rows,
+    sharding,
+)
+
+__all__ = [
+    "ROWS",
+    "COLS",
+    "default_mesh",
+    "fully_replicated",
+    "make_mesh",
+    "replicate",
+    "shard",
+    "shard_cols",
+    "shard_rows",
+    "sharding",
+]

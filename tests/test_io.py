@@ -1,0 +1,39 @@
+"""LIBSVM IO round-trip tests (≙ reference ``tests/unit/io_test.py``)."""
+
+import numpy as np
+
+from libskylark_tpu.io import read_libsvm, write_libsvm
+
+
+def test_roundtrip_dense(tmp_path, rng):
+    X = rng.standard_normal((20, 7))
+    X[rng.random((20, 7)) < 0.5] = 0.0
+    y = rng.integers(0, 3, 20).astype(float)
+    write_libsvm(tmp_path / "f", X, y)
+    X2, y2 = read_libsvm(tmp_path / "f", n_features=7)
+    np.testing.assert_allclose(X2, X, rtol=1e-15)
+    np.testing.assert_allclose(y2, y)
+
+
+def test_roundtrip_sparse(tmp_path, rng):
+    X = rng.standard_normal((15, 9))
+    X[rng.random((15, 9)) < 0.7] = 0.0
+    y = rng.standard_normal(15)
+    write_libsvm(tmp_path / "f", X, y)
+    Xs, y2 = read_libsvm(tmp_path / "f", n_features=9, sparse=True)
+    np.testing.assert_allclose(np.asarray(Xs.todense()), X, rtol=1e-15)
+    np.testing.assert_allclose(y2, y, rtol=1e-15)
+
+
+def test_1_based_indices(tmp_path):
+    (tmp_path / "f").write_text("1 1:2.5 3:1.0\n-1 2:0.5\n")
+    X, y = read_libsvm(tmp_path / "f")
+    assert X.shape == (2, 3)
+    np.testing.assert_allclose(X, [[2.5, 0, 1.0], [0, 0.5, 0]])
+    np.testing.assert_allclose(y, [1, -1])
+
+
+def test_pad_features(tmp_path):
+    (tmp_path / "f").write_text("0 1:1\n")
+    X, _ = read_libsvm(tmp_path / "f", n_features=5)
+    assert X.shape == (1, 5)
