@@ -1,0 +1,99 @@
+"""Headline benchmark: dense JLT sketch-apply throughput (TFLOP/s per chip).
+
+Run by the driver on real TPU hardware at round end.  Prints exactly ONE
+JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+The metric is the BASELINE.json headline, "sketch-apply TFLOPS/chip" for a
+JLT dense sketch: counter-based on-the-fly realization of Omega (generated
+inside the fused program, never an HBM input) + bf16 MXU matmul.
+``vs_baseline`` is measured TFLOP/s over the chip's bf16 peak (MFU), since
+the reference publishes no numbers to beat (BASELINE.md).
+
+Timing notes: the axon TPU tunnel does not block in ``block_until_ready``,
+so all timings force a scalar readback; R independent sketch applies (each
+with a distinct counter block, so XLA cannot CSE them) run inside ONE jitted
+call, and the tunnel round-trip is cancelled by differencing two rep counts.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from libskylark_tpu.core.context import SketchContext
+from libskylark_tpu.sketch.dense import JLT
+
+
+def _peak_tflops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197.0
+    if "v5p" in kind or "v5" in kind:
+        return 459.0
+    if "v6" in kind:
+        return 918.0
+    if "v4" in kind:
+        return 275.0
+    return 1.0  # CPU: report raw TFLOP/s
+
+
+def _build(m, n, s, dtype, reps):
+    ctx = SketchContext(seed=92)
+    sketches = [JLT(n, s, ctx) for _ in range(reps)]
+
+    def run(A):
+        acc = jnp.zeros((), jnp.float32)
+        for S in sketches:
+            out = S.apply(A, "rowwise")
+            # Full reduction so XLA cannot dead-code-eliminate any output tile.
+            acc = acc + jnp.sum(out.astype(jnp.float32))
+        return acc
+
+    return jax.jit(run)
+
+
+def _timed(fn, A) -> float:
+    t0 = time.perf_counter()
+    np.asarray(fn(A))  # readback forces execution through the tunnel
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    dev = jax.devices()[0]
+    on_tpu = dev.platform in ("tpu", "axon")
+    if on_tpu:
+        m, n, s = 262_144, 4096, 1024
+        dtype = jnp.bfloat16
+    else:
+        m, n, s = 16_384, 1024, 256
+        dtype = jnp.float32
+
+    r1, r2 = 4, 12
+    f1, f2 = _build(m, n, s, dtype, r1), _build(m, n, s, dtype, r2)
+    A = jax.random.normal(jax.random.PRNGKey(0), (m, n), dtype=dtype)
+    _timed(f1, A), _timed(f2, A)  # compile both
+    t1 = min(_timed(f1, A) for _ in range(3))
+    t2 = min(_timed(f2, A) for _ in range(3))
+    per_apply = max(t2 - t1, 1e-9) / (r2 - r1)
+
+    flops = 2.0 * m * n * s
+    tflops = flops / per_apply / 1e12
+    peak = _peak_tflops(dev)
+    print(
+        json.dumps(
+            {
+                "metric": "JLT dense sketch-apply throughput",
+                "value": round(tflops, 3),
+                "unit": "TFLOP/s/chip",
+                "vs_baseline": round(tflops / peak, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -72,7 +72,7 @@ def main(argv=None) -> int:
         skip_qr=args.skip_qr,
     )
     t0 = time.perf_counter()
-    U, s, V = approximate_svd(jnp.asarray(A), args.rank, ctx, params)
+    U, s, V = approximate_svd(A, args.rank, ctx, params)
     jax.block_until_ready((U, s, V))
     dt = time.perf_counter() - t0
     np.save(f"{args.prefix}.U.npy", np.asarray(U))

@@ -55,22 +55,22 @@ def read_libsvm(
     n = len(labels)
     d = n_features if n_features is not None else max_col
     y = np.asarray(labels, dtype=dtype)
-    keep = [i for i in range(len(cols)) if cols[i] < d]
+    rows_a = np.asarray(rows, dtype=np.int64)
+    cols_a = np.asarray(cols, dtype=np.int64)
+    vals_a = np.asarray(vals, dtype=dtype)
+    keep = cols_a < d
+    rows_a, cols_a, vals_a = rows_a[keep], cols_a[keep], vals_a[keep]
     if sparse:
         from jax.experimental import sparse as jsparse
         import jax.numpy as jnp
 
-        idx = np.stack(
-            [np.asarray(rows)[keep], np.asarray(cols)[keep]], axis=1
-        ).astype(np.int32)
-        data = np.asarray(vals, dtype=dtype)[keep]
+        idx = np.stack([rows_a, cols_a], axis=1).astype(np.int32)
         X = jsparse.BCOO(
-            (jnp.asarray(data), jnp.asarray(idx)), shape=(n, d)
+            (jnp.asarray(vals_a), jnp.asarray(idx)), shape=(n, d)
         )
         return X, y
     X = np.zeros((n, d), dtype=dtype)
-    for i in keep:
-        X[rows[i], cols[i]] = vals[i]
+    X[rows_a, cols_a] = vals_a
     return X, y
 
 

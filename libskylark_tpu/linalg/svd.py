@@ -104,9 +104,12 @@ def approximate_svd(
     space → power iteration → QR → small SVD → truncate.
     """
     params = params or SVDParams()
-    A = jnp.asarray(A)
+    if not hasattr(A, "todense"):  # keep BCOO sparse inputs as-is
+        A = jnp.asarray(A)
     m, n = A.shape
     k = int(rank)
+    if k > min(m, n):
+        raise ValueError(f"rank {k} exceeds min(A.shape) = {min(m, n)}")
     s = min(k * params.oversampling_ratio + params.oversampling_additive, n)
     s = max(s, k)
 
@@ -114,9 +117,11 @@ def approximate_svd(
     omega = JLT(n, s, context)
     Y = omega.apply(A, Dimension.ROWWISE)
 
-    # Power iteration on the sketched basis (nla/svd.hpp:260).
+    # Power iteration on the sketched basis (nla/svd.hpp:260);
+    # its body already ends orthonormalized unless skip_qr, so only
+    # orthonormalize here when the loop didn't.
     Y = power_iteration(A, Y, params.num_iterations, not params.skip_qr)
-    Q = _orth(Y)
+    Q = Y if (params.num_iterations > 0 and not params.skip_qr) else _orth(Y)
 
     # B = Aᵀ·Q (n, s); small SVD; rotate back (nla/svd.hpp:266-285).
     B = fully_replicated(A.T @ Q)
@@ -140,16 +145,19 @@ def approximate_symmetric_svd(
     (the reference's ``HermitianEig`` on the compressed ``QᵀAQ``).
     """
     params = params or SVDParams()
-    A = jnp.asarray(A)
+    if not hasattr(A, "todense"):
+        A = jnp.asarray(A)
     n = A.shape[0]
     k = int(rank)
+    if k > n:
+        raise ValueError(f"rank {k} exceeds matrix dimension {n}")
     s = min(k * params.oversampling_ratio + params.oversampling_additive, n)
     s = max(s, k)
 
     omega = JLT(n, s, context)
     Y = omega.apply(A, Dimension.ROWWISE)  # A·Omegaᵀ (symmetric A)
     Y = power_iteration(A, Y, params.num_iterations, not params.skip_qr)
-    Q = _orth(Y)
+    Q = Y if (params.num_iterations > 0 and not params.skip_qr) else _orth(Y)
 
     # Rayleigh-Ritz on the subspace (≙ nla/svd.hpp:360-380).
     T = fully_replicated(Q.T @ (A @ Q))

@@ -76,6 +76,12 @@ class HashSketch(SketchTransform):
 
     def _apply_dense(self, A, dim: Dimension):
         dtype = A.dtype if jnp.issubdtype(A.dtype, jnp.floating) else jnp.float32
+        if A.ndim == 1:
+            # Vectors are columns columnwise / rows rowwise (as in Gemv).
+            out = self._apply_dense(
+                A[:, None] if dim is Dimension.COLUMNWISE else A[None, :], dim
+            )
+            return out[:, 0] if dim is Dimension.COLUMNWISE else out[0, :]
         buckets = self.buckets()
         values = self.values(dtype)
         if dim is Dimension.COLUMNWISE:

@@ -1,0 +1,54 @@
+"""Regression tests for review findings: sparse SVD path, rank validation,
+1-D hash-sketch apply."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import sparse as jsparse
+
+from libskylark_tpu import SketchContext
+from libskylark_tpu.linalg import SVDParams, approximate_svd
+from libskylark_tpu.sketch import CWT
+
+
+def test_approximate_svd_on_bcoo(rng):
+    dense = rng.standard_normal((60, 20))
+    dense[rng.random((60, 20)) < 0.6] = 0.0
+    A = jsparse.BCOO.fromdense(jnp.asarray(dense))
+    U, s, V = approximate_svd(A, 5, SketchContext(seed=11), SVDParams(num_iterations=1))
+    s_true = np.linalg.svd(dense, compute_uv=False)
+    np.testing.assert_allclose(np.asarray(s)[:2], s_true[:2], rtol=0.1)
+
+
+def test_rank_too_large_raises(rng):
+    A = jnp.asarray(rng.standard_normal((30, 10)))
+    with pytest.raises(ValueError, match="rank"):
+        approximate_svd(A, 50, SketchContext(seed=1))
+
+
+def test_hash_sketch_1d_vector(rng):
+    n, s = 40, 12
+    v = jnp.asarray(rng.standard_normal(n))
+    S = CWT(n, s, SketchContext(seed=3))
+    out_vec = S.apply(v, "columnwise")
+    out_mat = S.apply(v[:, None], "columnwise")
+    assert out_vec.shape == (s,)
+    np.testing.assert_allclose(np.asarray(out_vec), np.asarray(out_mat[:, 0]))
+    out_r = S.apply(v, "rowwise")
+    out_r_mat = S.apply(v[None, :], "rowwise")
+    assert out_r.shape == (s,)
+    np.testing.assert_allclose(np.asarray(out_r), np.asarray(out_r_mat[0]))
+
+
+def test_cli_sparse_path(tmp_path, rng):
+    from libskylark_tpu.cli.svd import main
+    from libskylark_tpu.io import write_libsvm
+
+    X = rng.standard_normal((30, 10))
+    X[rng.random((30, 10)) < 0.5] = 0.0
+    write_libsvm(tmp_path / "d", X, np.ones(30))
+    rc = main(
+        [str(tmp_path / "d"), "--sparse", "--rank", "3", "--prefix", str(tmp_path / "o")]
+    )
+    assert rc == 0
+    assert np.load(tmp_path / "o.S.npy").shape == (3,)
