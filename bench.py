@@ -41,7 +41,7 @@ def _peak_tflops(device) -> float:
     return 1.0  # CPU: report raw TFLOP/s
 
 
-def _build(m, n, s, dtype, reps):
+def _build(n, s, reps):
     ctx = SketchContext(seed=92)
     sketches = [JLT(n, s, ctx) for _ in range(reps)]
 
@@ -73,12 +73,17 @@ def main() -> None:
         dtype = jnp.float32
 
     r1, r2 = 4, 12
-    f1, f2 = _build(m, n, s, dtype, r1), _build(m, n, s, dtype, r2)
+    f1, f2 = _build(n, s, r1), _build(n, s, r2)
     A = jax.random.normal(jax.random.PRNGKey(0), (m, n), dtype=dtype)
     _timed(f1, A), _timed(f2, A)  # compile both
     t1 = min(_timed(f1, A) for _ in range(3))
     t2 = min(_timed(f2, A) for _ in range(3))
-    per_apply = max(t2 - t1, 1e-9) / (r2 - r1)
+    if t2 <= t1:
+        raise RuntimeError(
+            f"benchmark timing inconsistent (t1={t1:.4f}s >= t2={t2:.4f}s); "
+            "rerun on a quieter machine"
+        )
+    per_apply = (t2 - t1) / (r2 - r1)
 
     flops = 2.0 * m * n * s
     tflops = flops / per_apply / 1e12

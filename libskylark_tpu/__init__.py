@@ -10,7 +10,7 @@ collectives instead of MPI.
 
 __version__ = "0.1.0"
 
-from . import core, io, linalg, parallel, sketch
+from . import core, io, linalg, parallel, sketch, solvers
 from .core import SketchContext
 
 __all__ = [
@@ -19,6 +19,7 @@ __all__ = [
     "linalg",
     "parallel",
     "sketch",
+    "solvers",
     "SketchContext",
     "__version__",
 ]

@@ -10,6 +10,12 @@
   ``solvers``-backed modules and are re-exported here once built.
 """
 
+from ..solvers.accelerated import (
+    FasterLeastSquaresParams,
+    faster_least_squares,
+    lsrn_least_squares,
+)
+from ..solvers.cond_est import cond_est
 from .least_squares import (
     LeastSquaresParams,
     approximate_least_squares,
@@ -30,4 +36,8 @@ __all__ = [
     "LeastSquaresParams",
     "approximate_least_squares",
     "exact_least_squares",
+    "FasterLeastSquaresParams",
+    "faster_least_squares",
+    "lsrn_least_squares",
+    "cond_est",
 ]

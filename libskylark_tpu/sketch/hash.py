@@ -70,18 +70,22 @@ class HashSketch(SketchTransform):
 
     def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
         dim = Dimension.of(dim)
+        if not isinstance(A, jsparse.BCOO):
+            A = jnp.asarray(A)
+        if A.ndim == 1:
+            # Vectors are columns columnwise / rows rowwise (as in Gemv);
+            # handled here once so dense and BCOO behave identically.
+            A2 = A[:, None] if dim is Dimension.COLUMNWISE else A[None, :]
+            out = self.apply(A2, dim)
+            if isinstance(out, jsparse.BCOO):
+                out = out.todense()
+            return out[:, 0] if dim is Dimension.COLUMNWISE else out[0, :]
         if isinstance(A, jsparse.BCOO):
             return self._apply_sparse(A, dim)
-        return self._apply_dense(jnp.asarray(A), dim)
+        return self._apply_dense(A, dim)
 
     def _apply_dense(self, A, dim: Dimension):
         dtype = A.dtype if jnp.issubdtype(A.dtype, jnp.floating) else jnp.float32
-        if A.ndim == 1:
-            # Vectors are columns columnwise / rows rowwise (as in Gemv).
-            out = self._apply_dense(
-                A[:, None] if dim is Dimension.COLUMNWISE else A[None, :], dim
-            )
-            return out[:, 0] if dim is Dimension.COLUMNWISE else out[0, :]
         buckets = self.buckets()
         values = self.values(dtype)
         if dim is Dimension.COLUMNWISE:

@@ -1,0 +1,40 @@
+"""Iterative solver framework (≙ reference ``algorithms/``).
+
+- ``krylov``: LSQR / CG / FlexibleCG / Chebyshev as ``lax.while_loop``
+  iterations (≙ ``algorithms/Krylov/``)
+- ``precond``: preconditioner interface (≙ ``algorithms/Krylov/precond.hpp``)
+- ``accelerated``: Blendenpik / LSRN sketch-to-precondition least squares
+  (≙ ``algorithms/regression/accelerated_linearl2_regression_solver*``)
+- ``cond_est``: condition-number estimation (≙ ``nla/CondEst.hpp``)
+- ``gauss_seidel``: synchronous randomized block Gauss-Seidel (≙ the
+  asynchronous AsyRGS, ``algorithms/asynch/``, re-expressed for TPU)
+- ``prox``: loss/regularizer prox library (≙ ``algorithms/regression/
+  loss.hpp``, ``regularizers.hpp``)
+"""
+
+from .accelerated import FasterLeastSquaresParams, faster_least_squares, lsrn_least_squares
+from .cond_est import cond_est
+from .gauss_seidel import randomized_block_gauss_seidel
+from .krylov import KrylovParams, cg, chebyshev, flexible_cg, lsqr
+from .precond import IdPrecond, MatPrecond, TriInversePrecond
+from .prox import LOSSES, REGULARIZERS, get_loss, get_regularizer
+
+__all__ = [
+    "KrylovParams",
+    "lsqr",
+    "cg",
+    "flexible_cg",
+    "chebyshev",
+    "IdPrecond",
+    "MatPrecond",
+    "TriInversePrecond",
+    "FasterLeastSquaresParams",
+    "faster_least_squares",
+    "lsrn_least_squares",
+    "cond_est",
+    "randomized_block_gauss_seidel",
+    "LOSSES",
+    "REGULARIZERS",
+    "get_loss",
+    "get_regularizer",
+]

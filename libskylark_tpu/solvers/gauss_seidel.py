@@ -1,0 +1,77 @@
+"""Synchronous randomized block Gauss-Seidel.
+
+≙ ``algorithms/asynch/AsyRGS.hpp`` (Avron-Druinsky-Gupta): the reference
+runs lock-free asynchronous randomized coordinate sweeps with OpenMP
+atomics.  TPU has no cross-core atomics in the JAX model (SURVEY §2.7 P9),
+so the *mathematics* is kept — randomized block coordinate descent on SPD
+``A X = B`` — and the *schedule* becomes synchronous: per sweep, a
+counter-derived random permutation of blocks, each block update solving the
+``block × block`` diagonal system exactly.  Deterministic given the
+context (unlike the reference's schedule-dependent output, tagged
+"NOT deterministic" in ``AsyRGS.hpp:25-27``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.context import SketchContext
+from ..core.random import sample
+
+__all__ = ["randomized_block_gauss_seidel"]
+
+
+def randomized_block_gauss_seidel(
+    A,
+    B,
+    context: SketchContext,
+    block_size: int = 64,
+    sweeps: int = 10,
+    x0=None,
+):
+    """Solve SPD ``A X = B`` by randomized block Gauss-Seidel sweeps.
+
+    Returns ``(X, info)``.  n must be ≥ block_size; a trailing ragged block
+    is padded into the last full block (updates overlap harmlessly — GS
+    tolerates overlapping blocks).
+    """
+    A = jnp.asarray(A)
+    B = jnp.asarray(B)
+    squeeze = B.ndim == 1
+    if squeeze:
+        B = B[:, None]
+    n = A.shape[0]
+    bs = min(block_size, n)
+    nblocks = (n + bs - 1) // bs
+    # Block start offsets; last block clamped (overlap instead of ragged).
+    starts = jnp.minimum(jnp.arange(nblocks) * bs, n - bs)
+    seed = context.seed
+    base = context.reserve(sweeps * nblocks)
+
+    X = jnp.zeros_like(B) if x0 is None else jnp.asarray(x0).reshape(B.shape)
+
+    # All sweep orders generated up-front from the counter stream (static
+    # shapes for the jitted loop; ≙ the per-sweep RNG draws of AsyRGS).
+    u = sample("uniform", seed, base, sweeps * nblocks, dtype=jnp.float32)
+    orders = jnp.argsort(u.reshape(sweeps, nblocks), axis=1)
+
+    def sweep(s, X):
+        order = orders[s]
+
+        def block_update(j, X):
+            start = starts[order[j]]
+            Ablk = lax.dynamic_slice(A, (start, 0), (bs, n))  # (bs, n)
+            Rblk = lax.dynamic_slice(B, (start, 0), (bs, B.shape[1])) - Ablk @ X
+            Dblk = lax.dynamic_slice(Ablk, (0, start), (bs, bs))
+            delta = jnp.linalg.solve(Dblk, Rblk)
+            Xblk = lax.dynamic_slice(X, (start, 0), (bs, X.shape[1]))
+            return lax.dynamic_update_slice(X, Xblk + delta, (start, 0))
+
+        return lax.fori_loop(0, nblocks, block_update, X)
+
+    X = lax.fori_loop(0, sweeps, sweep, X)
+    R = B - A @ X
+    info = {"sweeps": jnp.asarray(sweeps), "resid": jnp.linalg.norm(R, axis=0)}
+    return (X[:, 0] if squeeze else X), info

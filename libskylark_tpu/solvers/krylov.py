@@ -1,0 +1,339 @@
+"""Krylov solvers as jitted ``lax.while_loop`` iterations.
+
+≙ ``algorithms/Krylov/``: LSQR (``LSQR.hpp:21-259``), preconditioned CG
+(``CG.hpp:24-150``), FlexibleCG (``FlexibleCG.hpp:23``), Chebyshev
+(``Chebyshev.hpp``), with ``krylov_iter_params_t``
+(``krylov_iter_params.hpp:8``) as a dataclass.
+
+TPU design:
+
+- Everything runs inside one ``lax.while_loop`` — convergence tests are
+  computed on-device (no per-iteration host sync, unlike the reference's
+  rank-0 logging round-trips).  The hot ops are the two matvecs per
+  iteration, which for sharded A are GSPMD matmuls with psum reductions
+  over ICI (≙ the MPI allreduces inside Elemental's Gemv).
+- All solvers are **multi-RHS**: B may be (m,) or (m, k); the Golub-Kahan /
+  CG scalars become per-column vectors (the reference iterates columns
+  together the same way, via Elemental matrices of width k).
+- Stopping: per-column Paige-Saunders S1/S2 tests plus the reference's
+  stagnation detector (``LSQR.hpp:193-230``); the loop exits when every
+  column has converged or stagnated.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.params import Params
+from .precond import IdPrecond
+
+__all__ = ["KrylovParams", "lsqr", "cg", "flexible_cg", "chebyshev"]
+
+
+@dataclass
+class KrylovParams(Params):
+    """≙ ``krylov_iter_params_t`` (tolerance, iter_lim)."""
+
+    tolerance: float = 1e-14
+    iter_lim: int = 100
+
+
+def _ops(A):
+    """(matvec, rmatvec) for dense / BCOO / (matvec, rmatvec) pair."""
+    if isinstance(A, tuple):
+        return A
+    return (lambda x: A @ x), (lambda y: A.T @ y)
+
+
+def _colnorm(X):
+    return jnp.sqrt(jnp.sum(X * X, axis=0))
+
+
+def _as2d(b):
+    b = jnp.asarray(b)
+    return (b[:, None], True) if b.ndim == 1 else (b, False)
+
+
+def lsqr(A, B, precond=None, params: KrylovParams | None = None, x0=None):
+    """Preconditioned LSQR for ``min_X ||A X - B||`` (per column).
+
+    ``precond`` is a *right* preconditioner N (≙ ``outplace_precond_t``):
+    LSQR runs on A·N and returns ``X = N·Y`` (Blendenpik/LSRN use this).
+    Returns ``(X, info)`` with ``info = {"iterations", "flag", "resid"}``;
+    flag 0 = converged, 1 = iter limit, per column 2 = stagnated.
+    """
+    params = params or KrylovParams()
+    N = precond or IdPrecond()
+    matvec0, rmatvec0 = _ops(A)
+    matvec = lambda v: matvec0(N.apply(v))
+    rmatvec = lambda u: N.apply_adjoint(rmatvec0(u))
+
+    B, squeeze = _as2d(B)
+    dtype = B.dtype
+    eps = jnp.finfo(dtype).eps
+    atol = btol = jnp.asarray(max(params.tolerance, float(eps)), dtype)
+
+    U = B if x0 is None else B - matvec0(jnp.asarray(x0))
+    beta = _colnorm(U)
+    U = U / jnp.where(beta > 0, beta, 1)
+    V = rmatvec(U)
+    alpha = _colnorm(V)
+    V = V / jnp.where(alpha > 0, alpha, 1)
+    n = V.shape[0]
+    k = B.shape[1]
+
+    Y0 = jnp.zeros((n, k), dtype)
+    state = dict(
+        it=jnp.zeros((), jnp.int32),
+        Y=Y0,
+        U=U,
+        V=V,
+        W=V,
+        alpha=alpha,
+        beta=beta,
+        rhobar=alpha,
+        phibar=beta,
+        anorm=jnp.zeros((), dtype),
+        done=beta <= btol * _colnorm(B),
+        stag=jnp.zeros((k,), jnp.int32),
+        arnorm_best=jnp.full((k,), jnp.inf, dtype),
+        bnorm=_colnorm(B),
+    )
+
+    def cond(s):
+        return (s["it"] < params.iter_lim) & ~jnp.all(s["done"])
+
+    def body(s):
+        U, V, W, Y = s["U"], s["V"], s["W"], s["Y"]
+        alpha, beta = s["alpha"], s["beta"]
+        # Golub-Kahan bidiagonalization step (LSQR.hpp:100-130).
+        U = matvec(V) - alpha[None, :] * U
+        beta = _colnorm(U)
+        U = U / jnp.where(beta > 0, beta, 1)
+        V = rmatvec(U) - beta[None, :] * V
+        alpha_new = _colnorm(V)
+        V = V / jnp.where(alpha_new > 0, alpha_new, 1)
+        # Givens rotation update (LSQR.hpp:135-160).  rho can be 0 for an
+        # all-zero RHS column (alpha=beta=0); guard every division so the
+        # column stays exactly 0 instead of NaN-poisoning Y.
+        rho = jnp.hypot(s["rhobar"], beta)
+        rho_s = jnp.where(rho > 0, rho, 1)
+        c = s["rhobar"] / rho_s
+        sn = beta / rho_s
+        theta = sn * alpha_new
+        rhobar = -c * alpha_new
+        phi = c * s["phibar"]
+        phibar_new = sn * s["phibar"]
+        step = jnp.where(s["done"], 0.0, phi / rho_s)
+        Y = Y + step[None, :] * W
+        W = V - (theta / rho_s)[None, :] * W
+        anorm = jnp.hypot(s["anorm"], jnp.max(jnp.hypot(alpha, beta)))
+        # Paige-Saunders S1/S2 per column (LSQR.hpp:193-230).
+        rnorm = phibar_new
+        arnorm = alpha_new * jnp.abs(c * phibar_new)
+        ynorm = _colnorm(Y)
+        s1 = rnorm <= btol * s["bnorm"] + atol * anorm * ynorm
+        s2 = arnorm <= atol * anorm * jnp.maximum(rnorm, eps)
+        # Stagnation (LSQR.hpp stagnation check): for LS problems the
+        # residual plateaus at the optimum while the normal-equation
+        # residual (arnorm) keeps falling, so stagnation requires BOTH to
+        # stop improving for several consecutive iterations.
+        no_progress = (phibar_new >= s["phibar"] * (1 - 10 * eps)) & (
+            arnorm >= s["arnorm_best"] * (1 - 1e3 * eps)
+        )
+        stag = jnp.where(no_progress, s["stag"] + 1, 0)
+        done = s["done"] | s1 | s2 | (stag >= 5)
+        return dict(
+            it=s["it"] + 1,
+            Y=Y,
+            U=U,
+            V=V,
+            W=W,
+            alpha=alpha_new,
+            beta=beta,
+            rhobar=rhobar,
+            phibar=phibar_new,
+            anorm=anorm,
+            done=done,
+            stag=stag,
+            arnorm_best=jnp.minimum(s["arnorm_best"], arnorm),
+            bnorm=s["bnorm"],
+        )
+
+    s = lax.while_loop(cond, body, state)
+    X = N.apply(s["Y"])
+    if x0 is not None:
+        X = X + jnp.asarray(x0).reshape(X.shape)
+    info = {
+        "iterations": s["it"],
+        "flag": jnp.where(jnp.all(s["done"]), 0, 1),
+        "resid": s["phibar"],
+    }
+    return (X[:, 0] if squeeze else X), info
+
+
+def cg(A, B, precond=None, params: KrylovParams | None = None, x0=None):
+    """Preconditioned conjugate gradient for SPD ``A X = B`` (multi-RHS).
+
+    ≙ ``algorithms/Krylov/CG.hpp:24-150`` (with ``precond`` the outplace
+    M ≈ A⁻¹ as in ``FasterKernelRidge``'s feature-map preconditioner).
+    """
+    params = params or KrylovParams()
+    M = precond or IdPrecond()
+    matvec, _ = _ops(A)
+    B, squeeze = _as2d(B)
+    dtype = B.dtype
+    tol = jnp.asarray(params.tolerance, dtype)
+
+    X = jnp.zeros_like(B) if x0 is None else jnp.asarray(x0).reshape(B.shape)
+    R = B - matvec(X) if x0 is not None else B
+    Z = M.apply(R)
+    P = Z
+    rz = jnp.sum(R * Z, axis=0)
+    bnorm = _colnorm(B)
+    state = dict(
+        it=jnp.zeros((), jnp.int32),
+        X=X,
+        R=R,
+        P=P,
+        rz=rz,
+        done=_colnorm(R) <= tol * jnp.maximum(bnorm, 1e-30),
+    )
+
+    def cond(s):
+        return (s["it"] < params.iter_lim) & ~jnp.all(s["done"])
+
+    def body(s):
+        Q = matvec(s["P"])
+        denom = jnp.sum(s["P"] * Q, axis=0)
+        alpha = jnp.where(s["done"], 0.0, s["rz"] / jnp.where(denom != 0, denom, 1))
+        X = s["X"] + alpha[None, :] * s["P"]
+        R = s["R"] - alpha[None, :] * Q
+        Z = M.apply(R)
+        rz_new = jnp.sum(R * Z, axis=0)
+        beta = rz_new / jnp.where(s["rz"] != 0, s["rz"], 1)
+        P = Z + beta[None, :] * s["P"]
+        done = s["done"] | (_colnorm(R) <= tol * jnp.maximum(bnorm, 1e-30))
+        return dict(it=s["it"] + 1, X=X, R=R, P=P, rz=rz_new, done=done)
+
+    s = lax.while_loop(cond, body, state)
+    info = {
+        "iterations": s["it"],
+        "flag": jnp.where(jnp.all(s["done"]), 0, 1),
+        "resid": _colnorm(s["R"]),
+    }
+    return (s["X"][:, 0] if squeeze else s["X"]), info
+
+
+def flexible_cg(
+    A, B, precond=None, params: KrylovParams | None = None, memory: int = 5
+):
+    """Flexible CG: supports a *varying* preconditioner by re-orthogonalizing
+    the search direction against the last ``memory`` directions.
+
+    ≙ ``algorithms/Krylov/FlexibleCG.hpp:23`` (used with the inexact/
+    randomized inner preconditioners of AsyFCG, ``algorithms/asynch/
+    AsyFCG.hpp``).  ``precond`` may be a function ``(R, it) -> Z`` for
+    iteration-dependent preconditioning, or a fixed precond object.
+    """
+    params = params or KrylovParams()
+    matvec, _ = _ops(A)
+    B, squeeze = _as2d(B)
+    dtype = B.dtype
+    tol = jnp.asarray(params.tolerance, dtype)
+    m, k = B.shape
+
+    if precond is None:
+        apply_M = lambda R, it: R
+    elif callable(precond) and not hasattr(precond, "apply"):
+        apply_M = precond
+    else:
+        apply_M = lambda R, it: precond.apply(R)
+
+    # Ring buffers of past directions P and A·P, per RHS column.
+    Pbuf = jnp.zeros((memory, m, k), dtype)
+    Qbuf = jnp.zeros((memory, m, k), dtype)
+    pq = jnp.ones((memory, k), dtype)  # pᵀAp normalizers (1 avoids 0-div)
+    bnorm = _colnorm(B)
+    state = dict(
+        it=jnp.zeros((), jnp.int32),
+        X=jnp.zeros_like(B),
+        R=B,
+        Pbuf=Pbuf,
+        Qbuf=Qbuf,
+        pq=pq,
+        done=bnorm <= tol,
+    )
+
+    def cond(s):
+        return (s["it"] < params.iter_lim) & ~jnp.all(s["done"])
+
+    def body(s):
+        Z = apply_M(s["R"], s["it"])
+        # Orthogonalize Z against stored directions (A-inner product).
+        coeffs = jnp.einsum("smk,mk->sk", s["Qbuf"], Z) / s["pq"]
+        P = Z - jnp.einsum("smk,sk->mk", s["Pbuf"], coeffs)
+        Q = matvec(P)
+        denom = jnp.sum(P * Q, axis=0)
+        denom = jnp.where(jnp.abs(denom) > 0, denom, 1)
+        alpha = jnp.where(s["done"], 0.0, jnp.sum(P * s["R"], axis=0) / denom)
+        X = s["X"] + alpha[None, :] * P
+        R = s["R"] - alpha[None, :] * Q
+        slot = s["it"] % memory
+        Pbuf = s["Pbuf"].at[slot].set(P)
+        Qbuf = s["Qbuf"].at[slot].set(Q)
+        pq = s["pq"].at[slot].set(denom)
+        done = s["done"] | (_colnorm(R) <= tol * jnp.maximum(bnorm, 1e-30))
+        return dict(
+            it=s["it"] + 1, X=X, R=R, Pbuf=Pbuf, Qbuf=Qbuf, pq=pq, done=done
+        )
+
+    s = lax.while_loop(cond, body, state)
+    info = {
+        "iterations": s["it"],
+        "flag": jnp.where(jnp.all(s["done"]), 0, 1),
+        "resid": _colnorm(s["R"]),
+    }
+    return (s["X"][:, 0] if squeeze else s["X"]), info
+
+
+def chebyshev(A, B, sigma_lo: float, sigma_hi: float, params: KrylovParams | None = None):
+    """Chebyshev semi-iteration for SPD ``A X = B`` given eigenvalue bounds
+    ``[sigma_lo, sigma_hi]`` (≙ ``algorithms/Krylov/Chebyshev.hpp`` — the
+    reference also takes singular-value bounds).  No inner products — the
+    TPU-friendliest Krylov method (no reductions → no collectives at all
+    for row-sharded A beyond the matvec itself).
+    """
+    params = params or KrylovParams()
+    matvec, _ = _ops(A)
+    B, squeeze = _as2d(B)
+    dtype = B.dtype
+    d = jnp.asarray((sigma_hi + sigma_lo) / 2, dtype)
+    c = jnp.asarray((sigma_hi - sigma_lo) / 2, dtype)
+
+    def body(i, carry):
+        X, Xprev, alpha_prev = carry
+        R = B - matvec(X)
+        alpha = jnp.where(
+            i == 0,
+            1.0 / d,
+            jnp.where(
+                i == 1,
+                d / (d * d - c * c / 2),
+                1.0 / (d - alpha_prev * c * c / 4),
+            ),
+        ).astype(dtype)
+        beta = jnp.where(i == 0, 0.0, alpha * d - 1.0).astype(dtype)
+        Xnew = X + alpha * R + beta * (X - Xprev)
+        return (Xnew, X, alpha)
+
+    X0 = jnp.zeros_like(B)
+    X, _, _ = lax.fori_loop(0, params.iter_lim, body, (X0, X0, jnp.asarray(0, dtype)))
+    info = {"iterations": jnp.asarray(params.iter_lim), "flag": jnp.asarray(0)}
+    return (X[:, 0] if squeeze else X), info

@@ -1,0 +1,52 @@
+"""Preconditioner interface (≙ ``algorithms/Krylov/precond.hpp:14-135``).
+
+The reference's ``inplace_precond_t`` / ``outplace_precond_t`` hierarchy
+(id, mat, tri_inverse) becomes three small functional classes; JAX arrays
+are immutable so everything is "outplace".  All applies are jit-compatible.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.linalg import solve_triangular
+
+__all__ = ["IdPrecond", "MatPrecond", "TriInversePrecond"]
+
+
+class IdPrecond:
+    """Identity (≙ ``id_precond_t``)."""
+
+    def apply(self, x):
+        return x
+
+    def apply_adjoint(self, x):
+        return x
+
+
+class MatPrecond:
+    """Multiply by a fixed matrix M (≙ ``mat_precond_t``): e.g. LSRN's
+    V·Σ⁻¹."""
+
+    def __init__(self, M):
+        self.M = jnp.asarray(M)
+
+    def apply(self, x):
+        return self.M @ x
+
+    def apply_adjoint(self, x):
+        return self.M.T.conj() @ x
+
+
+class TriInversePrecond:
+    """Solve against a triangular factor R (≙ ``tri_inverse_precond_t``):
+    Blendenpik's R from QR(SA), applied as R⁻¹ / R⁻ᵀ."""
+
+    def __init__(self, R, lower: bool = False):
+        self.R = jnp.asarray(R)
+        self.lower = bool(lower)
+
+    def apply(self, x):
+        return solve_triangular(self.R, x, lower=self.lower)
+
+    def apply_adjoint(self, x):
+        return solve_triangular(self.R.T.conj(), x, lower=not self.lower)

@@ -1,0 +1,243 @@
+"""Solver tests: Krylov methods, Blendenpik/LSRN, cond_est, block GS, prox.
+
+Patterned on the reference's solver usage (LSQR inside Blendenpik reaching
+near machine precision; CG on SPD systems) and on standard prox identities.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libskylark_tpu import SketchContext
+from libskylark_tpu.solvers import (
+    FasterLeastSquaresParams,
+    KrylovParams,
+    MatPrecond,
+    cg,
+    chebyshev,
+    cond_est,
+    faster_least_squares,
+    flexible_cg,
+    get_loss,
+    get_regularizer,
+    lsqr,
+    lsrn_least_squares,
+    randomized_block_gauss_seidel,
+)
+
+
+def spd(rng, n, cond=100.0):
+    Q = np.linalg.qr(rng.standard_normal((n, n)))[0]
+    lam = np.logspace(0, -np.log10(cond), n)
+    return jnp.asarray(Q @ np.diag(lam) @ Q.T)
+
+
+class TestLSQR:
+    def test_well_conditioned(self, rng):
+        A = jnp.asarray(rng.standard_normal((200, 30)))
+        b = jnp.asarray(rng.standard_normal(200))
+        x, info = lsqr(A, b, params=KrylovParams(iter_lim=200))
+        x_ref = np.linalg.lstsq(np.asarray(A), np.asarray(b), rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(x), x_ref, rtol=1e-6, atol=1e-8)
+
+    def test_multi_rhs(self, rng):
+        A = jnp.asarray(rng.standard_normal((150, 20)))
+        B = jnp.asarray(rng.standard_normal((150, 4)))
+        X, info = lsqr(A, B, params=KrylovParams(iter_lim=200))
+        X_ref = np.linalg.lstsq(np.asarray(A), np.asarray(B), rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(X), X_ref, rtol=1e-6, atol=1e-8)
+
+    def test_square_consistent(self, rng):
+        A = jnp.asarray(spd(rng, 40, cond=10))
+        x_true = rng.standard_normal(40)
+        b = A @ x_true
+        x, info = lsqr(A, b, params=KrylovParams(iter_lim=300, tolerance=1e-12))
+        np.testing.assert_allclose(np.asarray(x), x_true, rtol=1e-5, atol=1e-7)
+
+    def test_jittable(self, rng):
+        A = jnp.asarray(rng.standard_normal((100, 10)))
+        b = jnp.asarray(rng.standard_normal(100))
+        x, _ = jax.jit(lambda A, b: lsqr(A, b))(A, b)
+        assert np.all(np.isfinite(np.asarray(x)))
+
+
+class TestCG:
+    def test_spd_solve(self, rng):
+        A = spd(rng, 60, cond=50)
+        b = jnp.asarray(rng.standard_normal(60))
+        x, info = cg(A, b, params=KrylovParams(iter_lim=300, tolerance=1e-12))
+        np.testing.assert_allclose(
+            np.asarray(A @ x), np.asarray(b), rtol=1e-6, atol=1e-8
+        )
+
+    def test_preconditioned_faster(self, rng):
+        A = spd(rng, 80, cond=1e4)
+        b = jnp.asarray(rng.standard_normal((80, 2)))
+        M = MatPrecond(jnp.linalg.inv(A))  # perfect preconditioner
+        _, info_pre = cg(A, b, precond=M, params=KrylovParams(iter_lim=100, tolerance=1e-10))
+        _, info_no = cg(A, b, params=KrylovParams(iter_lim=100, tolerance=1e-10))
+        assert int(info_pre["iterations"]) < int(info_no["iterations"])
+
+
+class TestFlexibleCG:
+    def test_spd_solve(self, rng):
+        A = spd(rng, 50, cond=100)
+        b = jnp.asarray(rng.standard_normal(50))
+        x, info = flexible_cg(
+            A, b, params=KrylovParams(iter_lim=200, tolerance=1e-10)
+        )
+        np.testing.assert_allclose(
+            np.asarray(A @ x), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
+
+    def test_variable_preconditioner(self, rng):
+        A = spd(rng, 40, cond=100)
+        b = jnp.asarray(rng.standard_normal(40))
+        D = jnp.diag(A)
+
+        def precond(R, it):  # Jacobi, slightly perturbed per iteration
+            return R / (D[:, None] * (1.0 + 1e-3 * jnp.cos(it.astype(R.dtype))))
+
+        x, info = flexible_cg(
+            A, b, precond=precond, params=KrylovParams(iter_lim=200, tolerance=1e-10)
+        )
+        np.testing.assert_allclose(
+            np.asarray(A @ x), np.asarray(b), rtol=1e-5, atol=1e-7
+        )
+
+
+class TestChebyshev:
+    def test_spd_with_bounds(self, rng):
+        A = spd(rng, 50, cond=20)
+        lam = np.linalg.eigvalsh(np.asarray(A))
+        b = jnp.asarray(rng.standard_normal(50))
+        x, _ = chebyshev(
+            A, b, float(lam[0]) * 0.9, float(lam[-1]) * 1.1,
+            params=KrylovParams(iter_lim=300),
+        )
+        np.testing.assert_allclose(
+            np.asarray(A @ x), np.asarray(b), rtol=1e-4, atol=1e-5
+        )
+
+
+class TestBlendenpik:
+    def test_near_machine_precision(self, rng):
+        A = jnp.asarray(rng.standard_normal((3000, 50)))
+        b = jnp.asarray(rng.standard_normal(3000))
+        x, info = faster_least_squares(A, b, SketchContext(seed=11))
+        x_ref = np.linalg.lstsq(np.asarray(A), np.asarray(b), rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(x), x_ref, rtol=1e-8, atol=1e-10)
+        assert info["attempts"] == 1
+
+    def test_ill_conditioned(self, rng):
+        # cond ~1e6 — the preconditioner should still crack it.
+        U = np.linalg.qr(rng.standard_normal((1000, 30)))[0]
+        V = np.linalg.qr(rng.standard_normal((30, 30)))[0]
+        A = jnp.asarray(U @ np.diag(np.logspace(0, -6, 30)) @ V)
+        x_true = rng.standard_normal(30)
+        b = A @ jnp.asarray(x_true)
+        x, _ = faster_least_squares(
+            A, b, SketchContext(seed=12),
+            FasterLeastSquaresParams(krylov=KrylovParams(iter_lim=100)),
+        )
+        r = np.linalg.norm(np.asarray(A @ x) - np.asarray(b))
+        assert r <= 1e-6 * np.linalg.norm(np.asarray(b))
+
+    def test_multi_rhs(self, rng):
+        A = jnp.asarray(rng.standard_normal((800, 20)))
+        B = jnp.asarray(rng.standard_normal((800, 3)))
+        X, _ = faster_least_squares(A, B, SketchContext(seed=13))
+        X_ref = np.linalg.lstsq(np.asarray(A), np.asarray(B), rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(X), X_ref, rtol=1e-7, atol=1e-9)
+
+
+class TestLSRN:
+    def test_rank_deficient(self, rng):
+        # LSRN handles rank deficiency; returns min-norm-ish solution.
+        base = rng.standard_normal((500, 10))
+        A = jnp.asarray(np.hstack([base, base[:, :5]]))  # rank 10, 15 cols
+        b = jnp.asarray(rng.standard_normal(500))
+        x, _ = lsrn_least_squares(A, b, SketchContext(seed=14))
+        r = np.linalg.norm(np.asarray(A @ x) - np.asarray(b))
+        x_ref = np.linalg.lstsq(np.asarray(A), np.asarray(b), rcond=None)[0]
+        r_ref = np.linalg.norm(np.asarray(A) @ x_ref - np.asarray(b))
+        assert r <= r_ref * (1 + 1e-5)
+
+
+class TestCondEst:
+    def test_known_condition(self, rng):
+        U = np.linalg.qr(rng.standard_normal((400, 20)))[0]
+        V = np.linalg.qr(rng.standard_normal((20, 20)))[0]
+        s = np.logspace(0, -3, 20)
+        A = jnp.asarray(U @ np.diag(s) @ V)
+        cond, smax, smin = cond_est(A, SketchContext(seed=21))
+        assert abs(float(smax) - 1.0) < 0.05
+        assert abs(float(smin) - 1e-3) / 1e-3 < 0.2
+        assert abs(float(cond) - 1e3) / 1e3 < 0.25
+
+
+class TestBlockGaussSeidel:
+    def test_spd_converges(self, rng):
+        A = spd(rng, 100, cond=50) + 0.5 * jnp.eye(100)
+        x_true = rng.standard_normal(100)
+        b = A @ jnp.asarray(x_true)
+        x, info = randomized_block_gauss_seidel(
+            A, b, SketchContext(seed=31), block_size=16, sweeps=40
+        )
+        np.testing.assert_allclose(np.asarray(x), x_true, rtol=1e-4, atol=1e-5)
+
+    def test_deterministic_given_context(self, rng):
+        A = spd(rng, 30) + jnp.eye(30)
+        b = jnp.asarray(rng.standard_normal(30))
+        x1, _ = randomized_block_gauss_seidel(A, b, SketchContext(seed=5), 8, 5)
+        x2, _ = randomized_block_gauss_seidel(A, b, SketchContext(seed=5), 8, 5)
+        np.testing.assert_array_equal(np.asarray(x1), np.asarray(x2))
+
+
+class TestProx:
+    def _check_prox_is_argmin(self, lossobj, V, lam, Y, rng):
+        """prox must beat nearby points on lam*loss(X,Y) + 0.5||X-V||²."""
+        X = lossobj.prox(V, lam, Y)
+        obj = lambda Z: lam * lossobj.evaluate(Z, Y) + 0.5 * jnp.sum((Z - V) ** 2)
+        base = float(obj(X))
+        for _ in range(10):
+            pert = X + 0.01 * jnp.asarray(rng.standard_normal(X.shape))
+            assert float(obj(pert)) >= base - 1e-6 * max(1.0, abs(base))
+
+    def test_squared_prox_closed_form(self, rng):
+        V = jnp.asarray(rng.standard_normal((1, 20)))
+        Y = jnp.asarray(rng.standard_normal((1, 20)))
+        loss = get_loss("squared")
+        X = loss.prox(V, 0.7, Y)
+        np.testing.assert_allclose(
+            np.asarray(X), (np.asarray(V) + 0.7 * np.asarray(Y)) / 1.7, rtol=1e-6
+        )
+
+    @pytest.mark.parametrize("name", ["squared", "lad", "hinge"])
+    def test_prox_minimizes_binary(self, name, rng):
+        V = jnp.asarray(rng.standard_normal((1, 25)))
+        Y = jnp.asarray(np.sign(rng.standard_normal(25)))
+        self._check_prox_is_argmin(get_loss(name), V, 0.5, Y, rng)
+
+    def test_logistic_prox_minimizes_multiclass(self, rng):
+        V = jnp.asarray(rng.standard_normal((4, 15)))
+        Y = jnp.asarray(rng.integers(0, 4, 15))
+        self._check_prox_is_argmin(get_loss("logistic"), V, 0.5, Y, rng)
+
+    def test_hinge_evaluate_multiclass(self, rng):
+        O = jnp.asarray(rng.standard_normal((3, 10)))
+        Y = jnp.asarray(rng.integers(0, 3, 10))
+        v = float(get_loss("hinge").evaluate(O, Y))
+        assert v >= 0
+
+    def test_regularizer_prox(self, rng):
+        V = jnp.asarray(rng.standard_normal((5, 6)))
+        np.testing.assert_allclose(
+            np.asarray(get_regularizer("l2").prox(V, 1.0)), np.asarray(V) / 2
+        )
+        X1 = np.asarray(get_regularizer("l1").prox(V, 0.3))
+        assert np.all(np.abs(X1) <= np.maximum(np.abs(np.asarray(V)) - 0.3, 0) + 1e-12)
+        np.testing.assert_allclose(
+            np.asarray(get_regularizer("none").prox(V, 2.0)), np.asarray(V)
+        )
