@@ -39,6 +39,7 @@ __all__ = [
     "window_bits",
     "sample",
     "sample_window",
+    "chi2_lanes",
     "DISTRIBUTIONS",
 ]
 
@@ -210,6 +211,22 @@ def _uniform_int(hi, lo, dtype, low=0, high=None):
     return (jnp.int64(low) + s_hi if jax.config.jax_enable_x64
             else low + s_hi.astype(jnp.int32) if high < (1 << 31)
             else low + s_hi).astype(dtype)
+
+
+def chi2_lanes(seed: int, base: int, size: int, dof: int, dtype=jnp.float32):
+    """χ²(dof) samples as a sum of ``dof`` squared-normal lanes over one
+    reserved counter block (lanes 1..dof; lane 0 left for the caller).
+
+    Used by the Matérn feature maps' multivariate-t row correction
+    (``sqrt(2ν/χ²_{2ν})``, ≙ ``sketch/RFT_data.hpp:336-345``).
+    """
+    if dof < 1 or int(dof) != dof:
+        raise ValueError(f"chi2_lanes needs a positive integer dof, got {dof}")
+    acc = jnp.zeros((size,), dtype)
+    for lane in range(int(dof)):
+        z = sample("normal", seed, base, size, dtype=dtype, lane=lane + 1)
+        acc = acc + z * z
+    return acc
 
 
 DISTRIBUTIONS = {

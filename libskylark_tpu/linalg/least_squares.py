@@ -6,8 +6,8 @@
 - ``approximate_least_squares`` ≙ sketch-and-solve
   (``nla/least_squares.hpp:42-184`` + ``sketched_regression_solver_Elemental
   .hpp:29-104``): sketch A and B columnwise once, exact-solve the small
-  problem.  The reference defaults to FJLT with sketch size 4·width; we
-  default to JLT until FJLT lands (TODO: flip default to FJLT).
+  problem.  Like the reference, defaults to FJLT (sketch size 4·width) for
+  dense inputs; sparse (BCOO) inputs auto-select CWT (input-sparsity time).
 
 TPU notes: QR/Cholesky of the (sketched) s×n problem is replicated-small
 (≙ the reference's ``[*,*]`` matrices); the sketch itself is the sharded
@@ -37,7 +37,7 @@ __all__ = [
 class LeastSquaresParams(Params):
     """≙ ``nla/least_squares.hpp`` params: sketch choice + size."""
 
-    sketch_type: str = "JLT"
+    sketch_type: str | None = None  # None → FJLT dense / CWT sparse
     sketch_size: int | None = None  # default 4 * width (least_squares.hpp:60)
 
 
@@ -91,14 +91,17 @@ def approximate_least_squares(
     solve (``sketched_regression_solver_Elemental.hpp:60-104``).
     """
     params = params or LeastSquaresParams()
-    A = jnp.asarray(A)
+    is_sparse = hasattr(A, "todense")
+    if not is_sparse:
+        A = jnp.asarray(A)
     B = jnp.asarray(B)
     squeeze = B.ndim == 1
     if squeeze:
         B = B[:, None]
     m, n = A.shape
     s = params.sketch_size or min(4 * n, m)
-    S = create_sketch(params.sketch_type, m, s, context)
+    stype = params.sketch_type or ("CWT" if is_sparse else "FJLT")
+    S = create_sketch(stype, m, s, context)
     SA = S.apply(A, Dimension.COLUMNWISE)
     SB = S.apply(B, Dimension.COLUMNWISE)
     X = exact_least_squares(SA, SB, alg=alg)

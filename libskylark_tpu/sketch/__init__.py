@@ -17,7 +17,20 @@ from .base import (
     sketch_registry,
 )
 from .dense import CT, JLT, DenseSketch
+from .fjlt import FJLT
+from .frft import FastGaussianRFT, FastMaternRFT, FastRFT
+from .fut import RFUT, dct, next_pow2, wht
 from .hash import CWT, MMT, WZT, HashSketch
+from .ppt import PPT
+from .rft import (
+    RFT,
+    GaussianQRFT,
+    GaussianRFT,
+    LaplacianQRFT,
+    LaplacianRFT,
+    MaternRFT,
+)
+from .rlt import ExpSemigroupQRLT, ExpSemigroupRLT
 from .sampling import NURST, UST
 
 __all__ = [
@@ -39,4 +52,21 @@ __all__ = [
     "WZT",
     "UST",
     "NURST",
+    "RFUT",
+    "FJLT",
+    "wht",
+    "dct",
+    "next_pow2",
+    "RFT",
+    "GaussianRFT",
+    "LaplacianRFT",
+    "MaternRFT",
+    "GaussianQRFT",
+    "LaplacianQRFT",
+    "FastRFT",
+    "FastGaussianRFT",
+    "FastMaternRFT",
+    "ExpSemigroupRLT",
+    "ExpSemigroupQRLT",
+    "PPT",
 ]

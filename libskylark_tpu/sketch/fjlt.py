@@ -1,0 +1,65 @@
+"""Fast Johnson-Lindenstrauss transform (FJLT).
+
+≙ ``sketch/FJLT_data.hpp:19-95`` + ``sketch/FJLT_Elemental.hpp``:
+D (Rademacher diagonal) → fast unitary transform → uniform row sample with
+rescale.  Counter budget matches the reference's build order: N for the
+RFUT diagonal, then S for the sample indices
+(``FJLT_data.hpp:80-86``).
+
+TPU mapping (≙ the ``[VC,*] → [*,*]`` redistribute + local-FUT plan of
+``FJLT_Elemental.hpp:144-186``): under GSPMD the FUT along the sketched
+axis wants that axis unsharded; XLA inserts the all-to-all the reference
+hand-codes as an Elemental redistribution.  Sampling and scaling are
+elementwise/gather — local.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.context import SketchContext
+from .base import Dimension, SketchTransform, register_sketch
+from .fut import RFUT
+from .sampling import UST
+
+__all__ = ["FJLT"]
+
+
+@register_sketch
+class FJLT(SketchTransform):
+    """S·F·D: sample S coordinates of a randomized fast unitary transform.
+
+    With the (orthonormal) FUT the sampled coordinates are rescaled by
+    ``sqrt(NB/S)`` so that E‖sketch‖² = ‖x‖² (the reference's
+    ``sqrt(N/S)``, ``FJLT_Elemental.hpp:160``, with NB the padded size).
+    """
+
+    sketch_type = "FJLT"
+
+    def __init__(self, n: int, s: int, context: SketchContext, fut: str = "wht"):
+        super().__init__(n, s, context)
+        self._fut_name = fut
+        # Counter layout ≙ FJLT_data_t::build: RFUT diagonal (N), then the
+        # S sample indices — here a composed UST over the padded space.
+        self._rfut = RFUT(n, context, fut=fut)
+        self._nb = self._rfut._nb
+        self._ust = UST(self._nb, s, context, replace=True)
+
+    @property
+    def sample_indices(self):
+        """S uniform coordinates in [0, NB) (with replacement)."""
+        return self._ust.samples
+
+    def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
+        dim = Dimension.of(dim)
+        T = self._rfut.apply(A, dim)
+        scale = jnp.asarray(np.sqrt(self._nb / self.s), T.dtype)
+        return scale * self._ust.apply(T, dim)
+
+    def _param_dict(self):
+        return {"fut": self._fut_name}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(d["N"], d["S"], context, fut=d.get("fut", "wht"))

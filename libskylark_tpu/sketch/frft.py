@@ -1,0 +1,165 @@
+"""Fastfood feature maps (Le-Sarlós-Smola ICML'13).
+
+≙ ``sketch/FRFT_data.hpp`` / ``sketch/FRFT_Elemental.hpp``: the dense
+Gaussian W of the RFT is replaced per block by ``Sm·H·G·Π·H·B`` — B a
+Rademacher diagonal, Π a random permutation, G a Gaussian diagonal, H the
+fast unitary transform, Sm a kernel-dependent scaling
+(``FRFT_data.hpp:100-140``); features are then
+``√(2/S)·cos(V·x + shift)``.
+
+Counter budget mirrors ``FastRFT_data_t::build`` (shifts S; B, G, Π each
+numblks·NB).  The reference's Fisher-Yates permutation
+(``FRFT_data.hpp:115-125``) becomes an argsort of counter-derived uniform
+keys — same distribution, shard-local computable, O(NB log NB) on device.
+
+With the orthonormal WHT, Var((H·G·Π·H·B x)_i) = ‖x‖²/NB, so the Gaussian
+scaling is ``Sm = √NB/σ`` (the reference's ``1/(σ√N)`` compensates its
+*unnormalized* FUT); FastMatern multiplies per-row ``sqrt(2ν/χ²_{2ν})``
+like MaternRFT (``FRFT_data.hpp:208+``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.context import SketchContext
+from ..core.random import chi2_lanes, sample
+from .base import Dimension, SketchTransform, register_sketch
+from .fut import next_pow2, wht
+
+__all__ = ["FastRFT", "FastGaussianRFT", "FastMaternRFT"]
+
+_TWO_PI = 2.0 * np.pi
+
+
+class FastRFT(SketchTransform):
+    """Base Fastfood engine; subclasses set the Sm scaling."""
+
+    def __init__(self, n: int, s: int, context: SketchContext):
+        super().__init__(n, s, context)
+        self._seed = context.seed
+        self._nb = next_pow2(n)
+        self.numblks = 1 + (s - 1) // self._nb
+        self.outscale = np.sqrt(2.0 / s)
+        # ≙ FastRFT_data_t::build reserve order: shifts, B, G, P.
+        self._shift_base = context.reserve(s)
+        self._b_base = context.reserve(self.numblks * self._nb)
+        self._g_base = context.reserve(self.numblks * self._nb)
+        self._p_base = context.reserve(self.numblks * self._nb)
+
+    # -- counter-derived pieces --------------------------------------------
+
+    def _shifts(self, dtype):
+        return sample(
+            "uniform", self._seed, self._shift_base, self.s,
+            dtype=dtype, low=0.0, high=_TWO_PI,
+        )
+
+    def _B(self, dtype):
+        return sample(
+            "rademacher", self._seed, self._b_base, self.numblks * self._nb, dtype=dtype
+        ).reshape(self.numblks, self._nb)
+
+    def _G(self, dtype):
+        return sample(
+            "normal", self._seed, self._g_base, self.numblks * self._nb, dtype=dtype
+        ).reshape(self.numblks, self._nb)
+
+    def _perms(self):
+        keys = sample(
+            "uniform", self._seed, self._p_base, self.numblks * self._nb,
+            dtype=jnp.float32,
+        ).reshape(self.numblks, self._nb)
+        return jnp.argsort(keys, axis=1)
+
+    def _sm(self, dtype):
+        """Kernel scaling, shape (numblks·NB,) (≙ Sm; 1.0 in the base)."""
+        return jnp.ones((self.numblks * self._nb,), dtype)
+
+    def _features(self, X):
+        """V·X for columnwise X (n, m) → (S, m) pre-cos features."""
+        nb = self._nb
+        Xp = jnp.pad(X, ((0, nb - self.n), (0, 0))) if nb != self.n else X
+        B = self._B(X.dtype)
+        G = self._G(X.dtype)
+        perms = self._perms()
+        # All blocks at once: (blk, nb, m) — vmapped butterfly-free WHT.
+        T = wht(B[:, :, None] * Xp[None, :, :], axis=1)
+        T = jnp.take_along_axis(T, perms[:, :, None], axis=1)
+        T = G[:, :, None] * T
+        T = wht(T, axis=1)
+        V = T.reshape(self.numblks * nb, -1) * self._sm(X.dtype)[:, None]
+        return V[: self.s]
+
+    def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
+        dim = Dimension.of(dim)
+        A = jnp.asarray(A)
+        dtype = A.dtype if jnp.issubdtype(A.dtype, jnp.floating) else jnp.float32
+        A = A.astype(dtype)
+        squeeze = A.ndim == 1
+        if dim is Dimension.COLUMNWISE:
+            X = A[:, None] if squeeze else A
+            if X.shape[0] != self.n:
+                raise ValueError(f"columnwise apply needs {self.n} rows, got {A.shape}")
+            V = self._features(X)
+            Z = self.outscale * jnp.cos(V + self._shifts(dtype)[:, None])
+            return Z[:, 0] if squeeze else Z
+        X = A[None, :] if squeeze else A
+        if X.shape[-1] != self.n:
+            raise ValueError(f"rowwise apply needs {self.n} cols, got {A.shape}")
+        V = self._features(X.T).T
+        Z = self.outscale * jnp.cos(V + self._shifts(dtype)[None, :])
+        return Z[0] if squeeze else Z
+
+
+@register_sketch
+class FastGaussianRFT(FastRFT):
+    """≙ ``FastGaussianRFT_data_t`` (FRFT_data.hpp:147-205)."""
+
+    sketch_type = "FastGaussianRFT"
+
+    def __init__(self, n, s, context, sigma: float = 1.0):
+        self.sigma = float(sigma)
+        super().__init__(n, s, context)
+
+    def _sm(self, dtype):
+        return jnp.full(
+            (self.numblks * self._nb,), np.sqrt(self._nb) / self.sigma, dtype
+        )
+
+    def _param_dict(self):
+        return {"sigma": self.sigma}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(d["N"], d["S"], context, sigma=d["sigma"])
+
+
+@register_sketch
+class FastMaternRFT(FastRFT):
+    """≙ ``FastMaternRFT_data_t``: per-row multivariate-t correction."""
+
+    sketch_type = "FastMaternRFT"
+
+    def __init__(self, n, s, context, nu: float = 1.0, l: float = 1.0):
+        two_nu = 2.0 * nu
+        if abs(two_nu - round(two_nu)) > 1e-9 or round(two_nu) < 1:
+            raise ValueError(f"FastMaternRFT needs 2*nu a positive integer, got nu={nu}")
+        self.nu = float(nu)
+        self.l = float(l)
+        super().__init__(n, s, context)
+        self._chi_base = context.reserve(self.numblks * self._nb)
+
+    def _sm(self, dtype):
+        two_nu = int(round(2 * self.nu))
+        size = self.numblks * self._nb
+        chi2 = chi2_lanes(self._seed, self._chi_base, size, two_nu, dtype)
+        return jnp.sqrt(2.0 * self.nu / chi2) * (np.sqrt(self._nb) / self.l)
+
+    def _param_dict(self):
+        return {"nu": self.nu, "l": self.l}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(d["N"], d["S"], context, nu=d["nu"], l=d["l"])

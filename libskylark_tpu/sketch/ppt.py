@@ -1,0 +1,104 @@
+"""PPT — Pham-Pagh TensorSketch for the polynomial kernel.
+
+≙ ``sketch/PPT_data.hpp:24-90`` + ``sketch/PPT_Elemental.hpp:131-188``:
+features for k(x, y) = (γ·xᵀy + c)^q via q CountSketches composed in the
+FFT domain —
+
+    Z(x) = IFFT( Π_{l<q} FFT( √γ·CWT_l(x) + √c·s_l·e_{h_l} ) )
+
+where the ``√c·s_l·e_{h_l}`` term (one extra hashed coordinate per level,
+``PPT_Elemental.hpp:165-166``) carries the additive constant of the
+kernel.  The FFTs ride XLA's native complex FFT (TPU-supported); the
+reference's explicit 1/S scaling + unnormalized c2r inverse collapse to
+the normalized ``jnp.fft.ifft``.
+
+Counter budget ≙ ``PPT_data_t::build``: q CWTs (2N each), then q hash
+indices and q hash values.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.context import SketchContext
+from ..core.random import sample
+from .base import Dimension, SketchTransform, register_sketch
+from .hash import CWT
+
+__all__ = ["PPT"]
+
+
+@register_sketch
+class PPT(SketchTransform):
+    """TensorSketch feature map for the polynomial kernel (γ·xᵀy + c)^q."""
+
+    sketch_type = "PPT"
+
+    def __init__(
+        self,
+        n: int,
+        s: int,
+        context: SketchContext,
+        q: int = 3,
+        c: float = 1.0,
+        gamma: float = 1.0,
+    ):
+        super().__init__(n, s, context)
+        if q < 1:
+            raise ValueError(f"PPT needs q >= 1, got {q}")
+        self.q = int(q)
+        self.c = float(c)
+        self.gamma = float(gamma)
+        self._seed = context.seed
+        self._cwts = [CWT(n, s, context) for _ in range(self.q)]
+        self._hidx_base = context.reserve(self.q)
+        self._hval_base = context.reserve(self.q)
+
+    def _hash_consts(self, dtype):
+        idx = sample(
+            "uniform_int", self._seed, self._hidx_base, self.q,
+            dtype=jnp.int32, low=0, high=self.s - 1,
+        )
+        val = sample("rademacher", self._seed, self._hval_base, self.q, dtype=dtype)
+        return idx, val
+
+    def _features(self, X):
+        """Columnwise features for X (n, m) → (S, m) real."""
+        dtype = X.dtype
+        cdtype = jnp.complex128 if dtype == jnp.float64 else jnp.complex64
+        sqrt_g = jnp.asarray(np.sqrt(self.gamma), dtype)
+        sqrt_c = jnp.asarray(np.sqrt(self.c), dtype)
+        idx, val = self._hash_consts(dtype)
+        P = jnp.ones((self.s, X.shape[1]), cdtype)
+        for l, cwt in enumerate(self._cwts):
+            W = sqrt_g * cwt.apply(X, Dimension.COLUMNWISE)
+            W = W.at[idx[l], :].add(sqrt_c * val[l])
+            P = P * jnp.fft.fft(W, axis=0)
+        return jnp.real(jnp.fft.ifft(P, axis=0)).astype(dtype)
+
+    def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
+        dim = Dimension.of(dim)
+        A = jnp.asarray(A)
+        dtype = A.dtype if jnp.issubdtype(A.dtype, jnp.floating) else jnp.float32
+        A = A.astype(dtype)
+        squeeze = A.ndim == 1
+        if dim is Dimension.COLUMNWISE:
+            X = A[:, None] if squeeze else A
+            if X.shape[0] != self.n:
+                raise ValueError(f"columnwise apply needs {self.n} rows, got {A.shape}")
+            Z = self._features(X)
+            return Z[:, 0] if squeeze else Z
+        X = A[None, :] if squeeze else A
+        if X.shape[-1] != self.n:
+            raise ValueError(f"rowwise apply needs {self.n} cols, got {A.shape}")
+        return self._features(X.T).T if not squeeze else self._features(X.T)[:, 0]
+
+    def _param_dict(self):
+        return {"q": self.q, "c": self.c, "gamma": self.gamma}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(
+            d["N"], d["S"], context, q=d["q"], c=d["c"], gamma=d["gamma"]
+        )

@@ -1,0 +1,282 @@
+"""Random Fourier feature maps (Rahimi-Recht) and QMC variants.
+
+≙ ``sketch/RFT_data.hpp`` / ``sketch/RFT_Elemental.hpp`` (the apply is
+``Z = outscale · cos(scale_i · (W·X)_i + shift_i)`` with W the underlying
+counter-based dense transform pre-scaled by ``inscale``,
+``RFT_Elemental.hpp:85-120``) and ``sketch/QRFT_data.hpp`` (W from a
+leaped Halton sequence through the inverse CDF; shifts from the sequence's
+extra dimension N, ``QRFT_data.hpp:29-107``).
+
+Concrete kernels (constructor params ≙ the reference's data classes):
+
+- GaussianRFT(sigma):   W ~ N, inscale 1/σ, outscale √(2/S)
+- LaplacianRFT(sigma):  W ~ Cauchy, inscale 1/σ, outscale √(2/S)
+- MaternRFT(nu, l):     W ~ N with per-row multivariate-t correction
+  ``sqrt(2ν/χ²_{2ν})`` (``RFT_data.hpp:336-345``), inscale 1/l
+- GaussianQRFT / LaplacianQRFT(sigma, skip): QMC rows
+
+The W·X product is the MXU-heavy op; shifts/cos fuse into its epilogue
+under XLA (the reference hand-loops this with OpenMP + an inexact-cosine
+fallback — unnecessary on TPU, the VPU does cos at full throughput).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.context import SketchContext
+from ..core.quasirand import LeapedHaltonSequence
+from ..core.random import chi2_lanes, sample
+from .base import Dimension, SketchTransform, register_sketch
+from .dense import DenseSketch
+
+__all__ = [
+    "RFT",
+    "GaussianRFT",
+    "LaplacianRFT",
+    "MaternRFT",
+    "GaussianQRFT",
+    "LaplacianQRFT",
+]
+
+_TWO_PI = 2.0 * np.pi
+
+
+class RFT(SketchTransform):
+    """Base engine: Z = outscale · cos(scales ⊙ (W·X) + shifts)."""
+
+    w_dist = "normal"
+
+    def __init__(
+        self,
+        n: int,
+        s: int,
+        context: SketchContext,
+        inscale: float,
+        outscale: float,
+    ):
+        super().__init__(n, s, context)
+        self._seed = context.seed
+        self.inscale = float(inscale)
+        self.outscale = float(outscale)
+        # Counter budget ≙ RFT_data_t::build: N*S for W, then S shifts.
+        self._underlying = _Underlying(n, s, context, inscale, self.w_dist)
+        self._shift_base = context.reserve(s)
+
+    def shifts(self, dtype=jnp.float32):
+        return sample(
+            "uniform",
+            self._seed,
+            self._shift_base,
+            self.s,
+            dtype=dtype,
+            low=0.0,
+            high=_TWO_PI,
+        )
+
+    def scales(self, dtype=jnp.float32):
+        """Per-feature scaling; identity unless a subclass overrides
+        (≙ ``_scales`` filled with 1, ``RFT_data.hpp:88-90``)."""
+        return None
+
+    def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
+        dim = Dimension.of(dim)
+        WX = self._underlying.apply(A, dim)
+        dtype = WX.dtype
+        shifts = self.shifts(dtype)
+        scales = self.scales(dtype)
+        if dim is Dimension.COLUMNWISE:
+            if scales is not None:
+                WX = WX * scales[:, None] if WX.ndim > 1 else WX * scales
+            WX = WX + (shifts[:, None] if WX.ndim > 1 else shifts)
+        else:
+            if scales is not None:
+                WX = WX * scales
+            WX = WX + shifts
+        return jnp.asarray(self.outscale, dtype) * jnp.cos(WX)
+
+
+class _Underlying(DenseSketch):
+    """The dense W (pre-scaled by inscale); not registered — internal."""
+
+    def __init__(self, n, s, context, scale, dist):
+        self.dist = dist
+        super().__init__(n, s, context, scale=scale)
+
+
+@register_sketch
+class GaussianRFT(RFT):
+    """Feature map for the Gaussian kernel exp(−‖x−y‖²/(2σ²))
+    (≙ ``GaussianRFT_data_t``, RFT_data.hpp:103-172)."""
+
+    sketch_type = "GaussianRFT"
+    w_dist = "normal"
+
+    def __init__(self, n: int, s: int, context: SketchContext, sigma: float = 1.0):
+        self.sigma = float(sigma)
+        super().__init__(n, s, context, 1.0 / sigma, np.sqrt(2.0 / s))
+
+    def _param_dict(self):
+        return {"sigma": self.sigma}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(d["N"], d["S"], context, sigma=d["sigma"])
+
+
+@register_sketch
+class LaplacianRFT(RFT):
+    """Feature map for the Laplacian kernel exp(−‖x−y‖₁/σ)
+    (≙ ``LaplacianRFT_data_t``, RFT_data.hpp:175-255: Cauchy W)."""
+
+    sketch_type = "LaplacianRFT"
+    w_dist = "cauchy"
+
+    def __init__(self, n: int, s: int, context: SketchContext, sigma: float = 1.0):
+        self.sigma = float(sigma)
+        super().__init__(n, s, context, 1.0 / sigma, np.sqrt(2.0 / s))
+
+    def _param_dict(self):
+        return {"sigma": self.sigma}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(d["N"], d["S"], context, sigma=d["sigma"])
+
+
+@register_sketch
+class MaternRFT(RFT):
+    """Feature map for the Matérn(ν, ℓ) kernel: rows are multivariate-t —
+    Gaussian row × ``sqrt(2ν/χ²_{2ν})`` (≙ ``MaternRFT_data_t::build``,
+    RFT_data.hpp:336-345).
+
+    The χ²_{2ν} draw needs integer 2ν (sum of squares of 2ν normals from
+    independent counter lanes); all common Matérn orders (ν = ½, 1, 3/2,
+    5/2, ...) qualify.
+    """
+
+    sketch_type = "MaternRFT"
+    w_dist = "normal"
+
+    def __init__(
+        self, n: int, s: int, context: SketchContext, nu: float = 1.0, l: float = 1.0
+    ):
+        two_nu = 2.0 * nu
+        if abs(two_nu - round(two_nu)) > 1e-9 or round(two_nu) < 1:
+            raise ValueError(f"MaternRFT needs 2*nu a positive integer, got nu={nu}")
+        self.nu = float(nu)
+        self.l = float(l)
+        super().__init__(n, s, context, 1.0 / l, np.sqrt(2.0 / s))
+        self._scales_base = context.reserve(s)
+
+    def scales(self, dtype=jnp.float32):
+        two_nu = int(round(2 * self.nu))
+        # χ²_{2ν} per feature row: sum over 2ν independent lanes.
+        chi2 = chi2_lanes(self._seed, self._scales_base, self.s, two_nu, dtype)
+        return jnp.sqrt(2.0 * self.nu / chi2)
+
+    def _param_dict(self):
+        return {"nu": self.nu, "l": self.l}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(d["N"], d["S"], context, nu=d["nu"], l=d["l"])
+
+
+class QRFT(SketchTransform):
+    """Quasi-Monte-Carlo random features (Yang et al, ICML'14).
+
+    W[j, d] = invCDF(seq(skip+j, d)) · inscale; shift_j = 2π·seq(skip+j, N)
+    (≙ ``QRFT_data_t::build``, QRFT_data.hpp:84-95; sequence dim = N+1).
+    Consumes no counters — reproducibility is carried by (sequence, skip).
+    """
+
+    w_dist = "normal"  # inverse-CDF target
+
+    def __init__(
+        self,
+        n: int,
+        s: int,
+        context: SketchContext,
+        inscale: float,
+        outscale: float,
+        skip: int = 0,
+    ):
+        super().__init__(n, s, context)
+        self.inscale = float(inscale)
+        self.outscale = float(outscale)
+        self.skip = int(skip)
+        self._sequence = LeapedHaltonSequence(n + 1)
+
+    def _inv_cdf(self, u):
+        if self.w_dist == "normal":
+            return jax.scipy.special.ndtri(u)
+        if self.w_dist == "cauchy":
+            return jnp.tan(jnp.pi * (u - 0.5))
+        raise ValueError(f"no inverse CDF for {self.w_dist}")
+
+    def realize(self, dtype=jnp.float32):
+        """(W, shifts): W is (S, N)."""
+        U = self._sequence.window(self.skip, self.s, dtype=dtype)  # (S, N+1)
+        W = self._inv_cdf(U[:, : self.n]) * jnp.asarray(self.inscale, dtype)
+        shifts = _TWO_PI * U[:, self.n]
+        return W.astype(dtype), shifts.astype(dtype)
+
+    def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
+        dim = Dimension.of(dim)
+        A = jnp.asarray(A)
+        dtype = A.dtype if jnp.issubdtype(A.dtype, jnp.floating) else jnp.float32
+        W, shifts = self.realize(dtype)
+        if dim is Dimension.COLUMNWISE:
+            if A.shape[0] != self.n:
+                raise ValueError(f"columnwise apply needs {self.n} rows, got {A.shape}")
+            WX = W @ A
+            WX = WX + (shifts[:, None] if WX.ndim > 1 else shifts)
+        else:
+            if A.shape[-1] != self.n:
+                raise ValueError(f"rowwise apply needs {self.n} cols, got {A.shape}")
+            WX = A @ W.T + shifts
+        return jnp.asarray(self.outscale, dtype) * jnp.cos(WX)
+
+    def _param_dict(self):
+        return {"skip": self.skip}
+
+
+@register_sketch
+class GaussianQRFT(QRFT):
+    """≙ ``GaussianQRFT_data_t`` (QRFT_data.hpp:118-140)."""
+
+    sketch_type = "GaussianQRFT"
+    w_dist = "normal"
+
+    def __init__(self, n, s, context, sigma: float = 1.0, skip: int = 0):
+        self.sigma = float(sigma)
+        super().__init__(n, s, context, 1.0 / sigma, np.sqrt(2.0 / s), skip)
+
+    def _param_dict(self):
+        return {"sigma": self.sigma, "skip": self.skip}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(d["N"], d["S"], context, sigma=d["sigma"], skip=d.get("skip", 0))
+
+
+@register_sketch
+class LaplacianQRFT(QRFT):
+    """≙ ``LaplacianQRFT_data_t``: Cauchy inverse CDF."""
+
+    sketch_type = "LaplacianQRFT"
+    w_dist = "cauchy"
+
+    def __init__(self, n, s, context, sigma: float = 1.0, skip: int = 0):
+        self.sigma = float(sigma)
+        super().__init__(n, s, context, 1.0 / sigma, np.sqrt(2.0 / s), skip)
+
+    def _param_dict(self):
+        return {"sigma": self.sigma, "skip": self.skip}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(d["N"], d["S"], context, sigma=d["sigma"], skip=d.get("skip", 0))

@@ -1,0 +1,101 @@
+"""Random Laplace feature maps for semigroup kernels (Yang et al CVPR'14).
+
+≙ ``sketch/RLT_data.hpp`` / ``sketch/RLT.hpp`` (apply:
+``Z = outscale · exp(−(W·X))``, RLT_Elemental.hpp:77) and the QMC variant
+``sketch/QRLT_data.hpp``.  ExpSemigroupRLT: W ~ standard Lévy scaled by
+β²/2, outscale √(1/S) (``RLT_data.hpp:97-115``) — features for the
+exponential semigroup kernel k(x, y) = exp(−β Σ_i √(x_i + y_i)) on
+histograms (non-negative inputs).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.context import SketchContext
+from ..core.quasirand import LeapedHaltonSequence
+from .base import Dimension, SketchTransform, register_sketch
+from .dense import DenseSketch
+
+__all__ = ["ExpSemigroupRLT", "ExpSemigroupQRLT"]
+
+
+class _UnderlyingLevy(DenseSketch):
+    dist = "levy"
+
+    def __init__(self, n, s, context, scale):
+        super().__init__(n, s, context, scale=scale)
+
+
+@register_sketch
+class ExpSemigroupRLT(SketchTransform):
+    """Z = √(1/S) · exp(−(β²/2)·(W·X)), W ~ standard Lévy."""
+
+    sketch_type = "ExpSemigroupRLT"
+
+    def __init__(self, n: int, s: int, context: SketchContext, beta: float = 1.0):
+        super().__init__(n, s, context)
+        self.beta = float(beta)
+        self.outscale = np.sqrt(1.0 / s)
+        self._underlying = _UnderlyingLevy(
+            n, s, context, scale=self.beta * self.beta / 2.0
+        )
+
+    def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
+        WX = self._underlying.apply(A, Dimension.of(dim))
+        return jnp.asarray(self.outscale, WX.dtype) * jnp.exp(-WX)
+
+    def _param_dict(self):
+        return {"beta": self.beta}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(d["N"], d["S"], context, beta=d["beta"])
+
+
+def _levy_quantile(u):
+    """Standard Lévy inverse CDF: F(x) = erfc(1/√(2x)) ⇒ x = 1/ndtri(u/2)²
+    (consistent with the counter sampler's 1/Z² construction)."""
+    z = jax.scipy.special.ndtri(u / 2.0)
+    return 1.0 / (z * z)
+
+
+@register_sketch
+class ExpSemigroupQRLT(SketchTransform):
+    """QMC variant: W rows from a leaped Halton sequence through the Lévy
+    inverse CDF (≙ ``ExpSemigroupQRLT_data_t``, QRLT_data.hpp:35+)."""
+
+    sketch_type = "ExpSemigroupQRLT"
+
+    def __init__(
+        self, n: int, s: int, context: SketchContext, beta: float = 1.0, skip: int = 0
+    ):
+        super().__init__(n, s, context)
+        self.beta = float(beta)
+        self.skip = int(skip)
+        self.outscale = np.sqrt(1.0 / s)
+        self._sequence = LeapedHaltonSequence(n)
+
+    def realize(self, dtype=jnp.float32):
+        U = self._sequence.window(self.skip, self.s, dtype=dtype)  # (S, N)
+        return (self.beta * self.beta / 2.0) * _levy_quantile(U)
+
+    def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
+        dim = Dimension.of(dim)
+        A = jnp.asarray(A)
+        dtype = A.dtype if jnp.issubdtype(A.dtype, jnp.floating) else jnp.float32
+        W = self.realize(dtype)
+        if dim is Dimension.COLUMNWISE:
+            WX = W @ A
+        else:
+            WX = A @ W.T
+        return jnp.asarray(self.outscale, dtype) * jnp.exp(-WX)
+
+    def _param_dict(self):
+        return {"beta": self.beta, "skip": self.skip}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(d["N"], d["S"], context, beta=d["beta"], skip=d.get("skip", 0))

@@ -38,7 +38,9 @@ __all__ = [
 class FasterLeastSquaresParams(Params):
     """Knobs ≙ the reference's blendenpik/lsrn params structs."""
 
-    sketch_type: str = "CWT"  # becomes "FJLT" for dense A once FJLT lands
+    # None → auto: FJLT for dense A, CWT for sparse (the reference's
+    # dense/sparse split, accelerated_...Elemental.hpp:200-250).
+    sketch_type: str | None = None
     gamma: float = 4.0  # sketch rows = gamma * n
     max_attempts: int = 3  # re-sketch retries (≙ :241-252)
     cond_threshold: float | None = None  # default 1/(10·eps^(1/2))
@@ -68,11 +70,14 @@ def faster_least_squares(
     eps = float(jnp.finfo(jnp.asarray(A).dtype if not hasattr(A, "todense") else A.data.dtype).eps)
     threshold = params.cond_threshold or 0.1 / np.sqrt(eps)
 
+    stype = params.sketch_type or (
+        "CWT" if hasattr(A, "todense") else "FJLT"
+    )
     gamma = params.gamma
     R = None
     for attempt in range(1, params.max_attempts + 1):
         s = min(int(gamma * n), m)
-        SA = _sketch_once(A, s, params.sketch_type, context)
+        SA = _sketch_once(A, s, stype, context)
         R_try = jnp.linalg.qr(SA, mode="r")
         # Condition estimate of the preconditioned system (≙ CondEst call
         # in the reference's retry loop; R is n×n so exact cond is cheap).
@@ -95,10 +100,14 @@ def lsrn_least_squares(
 ):
     """LSRN: SVD-based preconditioning — robust for rank-deficient A
     (≙ ``lsrn_tag`` branch, ``accelerated_...Elemental.hpp:96-160``)."""
-    params = params or FasterLeastSquaresParams(sketch_type="JLT")
+    params = params or FasterLeastSquaresParams()
     m, n = A.shape
     s = min(int(params.gamma * n), m)
-    SA = _sketch_once(A, s, params.sketch_type, context)
+    # LSRN wants a Gaussian-like sketch for its SVD preconditioner.
+    stype = params.sketch_type or (
+        "CWT" if hasattr(A, "todense") else "JLT"
+    )
+    SA = _sketch_once(A, s, stype, context)
     _, sv, Vt = jnp.linalg.svd(SA, full_matrices=False)
     eps = jnp.finfo(sv.dtype).eps
     cutoff = sv[0] * eps * max(SA.shape)

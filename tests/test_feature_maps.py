@@ -1,0 +1,319 @@
+"""Feature-map and FFT-family sketch tests.
+
+Oracles (reference test strategy, SURVEY §4 + statistical regression
+style):
+- WHT/DCT: orthonormality + exact small-case identity.
+- FJLT: norm preservation in expectation (JL property), JSON round-trip.
+- RFT/QRFT/FastRFT: feature inner products approximate the kernel
+  (Gaussian/Laplacian/Matérn), statistical tolerance.
+- RLT: approximates the exponential semigroup kernel on histograms.
+- PPT: approximates the polynomial kernel.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libskylark_tpu import SketchContext
+from libskylark_tpu.sketch import (
+    FJLT,
+    PPT,
+    RFUT,
+    ExpSemigroupQRLT,
+    ExpSemigroupRLT,
+    FastGaussianRFT,
+    FastMaternRFT,
+    GaussianQRFT,
+    GaussianRFT,
+    LaplacianQRFT,
+    LaplacianRFT,
+    MaternRFT,
+    dct,
+    from_json,
+    wht,
+)
+
+
+class TestWHT:
+    def test_matches_dense_hadamard(self, rng):
+        for n in (2, 8, 64, 512):
+            H = np.array([[1.0]])
+            while H.shape[0] < n:
+                H = np.block([[H, H], [H, -H]])
+            x = rng.standard_normal((n, 3))
+            np.testing.assert_allclose(
+                np.asarray(wht(jnp.asarray(x), axis=0)),
+                H @ x / np.sqrt(n),
+                rtol=1e-10,
+                atol=1e-12,
+            )
+
+    def test_orthonormal(self, rng):
+        x = jnp.asarray(rng.standard_normal((128, 5)))
+        y = wht(x, axis=0)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(y), axis=0),
+            np.linalg.norm(np.asarray(x), axis=0),
+            rtol=1e-12,
+        )
+        np.testing.assert_allclose(
+            np.asarray(wht(y, axis=0)), np.asarray(x), atol=1e-10
+        )
+
+    def test_axis1(self, rng):
+        x = jnp.asarray(rng.standard_normal((3, 16)))
+        np.testing.assert_allclose(
+            np.asarray(wht(x, axis=1)),
+            np.asarray(wht(x.T, axis=0)).T,
+            rtol=1e-12,
+        )
+
+    def test_non_pow2_raises(self, rng):
+        with pytest.raises(ValueError, match="power-of-2"):
+            wht(jnp.ones((12, 2)))
+
+
+class TestDCT:
+    def test_orthonormal(self, rng):
+        x = jnp.asarray(rng.standard_normal((60, 4)))
+        y = dct(x, axis=0)
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(y), axis=0),
+            np.linalg.norm(np.asarray(x), axis=0),
+            rtol=1e-10,
+        )
+
+
+class TestRFUT:
+    def test_norm_preserving(self, rng):
+        x = jnp.asarray(rng.standard_normal((100, 7)))
+        T = RFUT(100, SketchContext(seed=5), fut="wht")
+        y = T.apply(x, "columnwise")
+        assert y.shape == (128, 7)  # padded to pow2
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(y), axis=0),
+            np.linalg.norm(np.asarray(x), axis=0),
+            rtol=1e-10,
+        )
+
+    def test_dct_exact_size(self, rng):
+        x = jnp.asarray(rng.standard_normal((60, 3)))
+        T = RFUT(60, SketchContext(seed=6), fut="dct")
+        assert T.apply(x, "columnwise").shape == (60, 3)
+
+
+class TestFJLT:
+    @pytest.mark.parametrize("fut", ["wht", "dct"])
+    def test_norm_preservation_statistical(self, rng, fut):
+        n, s, m = 200, 64, 5
+        X = jnp.asarray(rng.standard_normal((n, m)))
+        norms = np.linalg.norm(np.asarray(X), axis=0)
+        errs = []
+        for rep in range(5):
+            S = FJLT(n, s, SketchContext(seed=rep), fut=fut)
+            SX = S.apply(X, "columnwise")
+            errs.append(np.abs(np.linalg.norm(np.asarray(SX), axis=0) - norms) / norms)
+        # average relative norm distortion ~ 1/sqrt(s); allow 3x slack
+        assert np.mean(errs) < 3.0 / np.sqrt(s)
+
+    def test_rowwise_consistent(self, rng):
+        n, s = 100, 32
+        X = jnp.asarray(rng.standard_normal((4, n)))
+        S = FJLT(n, s, SketchContext(seed=3))
+        R1 = S.apply(X, "rowwise")
+        S2 = FJLT(n, s, SketchContext(seed=3))
+        R2 = S2.apply(X.T, "columnwise").T
+        np.testing.assert_allclose(np.asarray(R1), np.asarray(R2), rtol=1e-10)
+
+    def test_json_roundtrip(self, rng):
+        S = FJLT(50, 16, SketchContext(seed=9))
+        S2 = from_json(S.to_json())
+        X = jnp.asarray(rng.standard_normal((50, 2)))
+        np.testing.assert_array_equal(
+            np.asarray(S.apply(X, "columnwise")),
+            np.asarray(S2.apply(X, "columnwise")),
+        )
+
+
+def _kernel_mse(Z, K):
+    """Mean abs error between feature inner products and kernel matrix."""
+    G = np.asarray(Z.T @ Z)
+    return np.mean(np.abs(G - K))
+
+
+def _gaussian_K(X, sigma):
+    D2 = ((X[:, None, :] - X[None, :, :]) ** 2).sum(-1)
+    return np.exp(-D2 / (2 * sigma**2))
+
+
+def _laplacian_K(X, sigma):
+    D1 = np.abs(X[:, None, :] - X[None, :, :]).sum(-1)
+    return np.exp(-D1 / sigma)
+
+
+class TestRFT:
+    def test_gaussian_kernel_approx(self, rng):
+        d, m, s, sigma = 10, 20, 4096, 2.0
+        X = rng.standard_normal((m, d))
+        K = _gaussian_K(X, sigma)
+        F = GaussianRFT(d, s, SketchContext(seed=1), sigma=sigma)
+        Z = F.apply(jnp.asarray(X.T), "columnwise")  # (s, m)
+        assert _kernel_mse(Z, K) < 0.05
+
+    def test_laplacian_kernel_approx(self, rng):
+        d, m, s, sigma = 8, 20, 8192, 3.0
+        X = rng.standard_normal((m, d))
+        K = _laplacian_K(X, sigma)
+        F = LaplacianRFT(d, s, SketchContext(seed=2), sigma=sigma)
+        Z = F.apply(jnp.asarray(X.T), "columnwise")
+        assert _kernel_mse(Z, K) < 0.08
+
+    def test_matern_features_finite_and_shaped(self, rng):
+        F = MaternRFT(6, 512, SketchContext(seed=3), nu=1.5, l=2.0)
+        Z = F.apply(jnp.asarray(rng.standard_normal((6, 9))), "columnwise")
+        assert Z.shape == (512, 9)
+        assert np.all(np.isfinite(np.asarray(Z)))
+        with pytest.raises(ValueError, match="2\\*nu"):
+            MaternRFT(6, 64, SketchContext(seed=4), nu=0.7)
+
+    def test_rowwise_matches_columnwise(self, rng):
+        d, s = 7, 128
+        X = rng.standard_normal((5, d))
+        F1 = GaussianRFT(d, s, SketchContext(seed=5), sigma=1.5)
+        F2 = GaussianRFT(d, s, SketchContext(seed=5), sigma=1.5)
+        np.testing.assert_allclose(
+            np.asarray(F1.apply(jnp.asarray(X), "rowwise")),
+            np.asarray(F2.apply(jnp.asarray(X.T), "columnwise")).T,
+            rtol=1e-6, atol=1e-8,
+        )
+
+    def test_json_roundtrip(self, rng):
+        F = GaussianRFT(5, 64, SketchContext(seed=6), sigma=0.7)
+        F2 = from_json(F.to_json())
+        X = jnp.asarray(rng.standard_normal((5, 3)))
+        np.testing.assert_array_equal(
+            np.asarray(F.apply(X, "columnwise")),
+            np.asarray(F2.apply(X, "columnwise")),
+        )
+
+
+class TestQRFT:
+    def test_gaussian_kernel_approx_qmc(self, rng):
+        # QMC should beat plain MC at equal S (or at least match).
+        d, m, s, sigma = 6, 15, 1024, 2.0
+        X = rng.standard_normal((m, d))
+        K = _gaussian_K(X, sigma)
+        F = GaussianQRFT(d, s, SketchContext(seed=1), sigma=sigma, skip=1000)
+        Z = F.apply(jnp.asarray(X.T), "columnwise")
+        assert _kernel_mse(Z, K) < 0.05
+
+    def test_laplacian_qrft_finite(self, rng):
+        F = LaplacianQRFT(5, 256, SketchContext(seed=2), sigma=1.0, skip=100)
+        Z = F.apply(jnp.asarray(rng.standard_normal((5, 4))), "columnwise")
+        assert np.all(np.isfinite(np.asarray(Z)))
+
+    def test_deterministic_in_skip(self, rng):
+        X = jnp.asarray(rng.standard_normal((5, 3)))
+        Z1 = GaussianQRFT(5, 64, SketchContext(seed=1), skip=7).apply(X)
+        Z2 = GaussianQRFT(5, 64, SketchContext(seed=99), skip=7).apply(X)
+        np.testing.assert_array_equal(np.asarray(Z1), np.asarray(Z2))
+
+
+class TestFastRFT:
+    def test_gaussian_kernel_approx(self, rng):
+        d, m, s, sigma = 16, 15, 4096, 2.0
+        X = rng.standard_normal((m, d))
+        K = _gaussian_K(X, sigma)
+        F = FastGaussianRFT(d, s, SketchContext(seed=1), sigma=sigma)
+        Z = F.apply(jnp.asarray(X.T), "columnwise")
+        assert _kernel_mse(Z, K) < 0.06
+
+    def test_matern_finite(self, rng):
+        F = FastMaternRFT(10, 256, SketchContext(seed=2), nu=1.0, l=1.5)
+        Z = F.apply(jnp.asarray(rng.standard_normal((10, 6))), "columnwise")
+        assert Z.shape == (256, 6)
+        assert np.all(np.isfinite(np.asarray(Z)))
+
+    def test_rowwise_matches_columnwise(self, rng):
+        d, s = 12, 128
+        X = rng.standard_normal((4, d))
+        F1 = FastGaussianRFT(d, s, SketchContext(seed=3), sigma=1.0)
+        F2 = FastGaussianRFT(d, s, SketchContext(seed=3), sigma=1.0)
+        np.testing.assert_allclose(
+            np.asarray(F1.apply(jnp.asarray(X), "rowwise")),
+            np.asarray(F2.apply(jnp.asarray(X.T), "columnwise")).T,
+            rtol=1e-6, atol=1e-8,
+        )
+
+    def test_json_roundtrip(self, rng):
+        F = FastGaussianRFT(9, 64, SketchContext(seed=4), sigma=1.2)
+        F2 = from_json(F.to_json())
+        X = jnp.asarray(rng.standard_normal((9, 2)))
+        np.testing.assert_array_equal(
+            np.asarray(F.apply(X, "columnwise")),
+            np.asarray(F2.apply(X, "columnwise")),
+        )
+
+
+class TestRLT:
+    def test_expsemigroup_kernel_approx(self, rng):
+        # k(x,y) = exp(-beta * sum_i sqrt(x_i + y_i)) on histograms.
+        d, m, s, beta = 5, 12, 16384, 0.3
+        X = rng.random((m, d))  # non-negative
+        K = np.exp(
+            -beta * np.sqrt(X[:, None, :] + X[None, :, :]).sum(-1)
+        )
+        F = ExpSemigroupRLT(d, s, SketchContext(seed=1), beta=beta)
+        Z = F.apply(jnp.asarray(X.T), "columnwise")
+        assert _kernel_mse(Z, K) < 0.05
+
+    def test_qrlt_finite_and_kernel(self, rng):
+        d, m, s, beta = 4, 10, 4096, 0.25
+        X = rng.random((m, d))
+        K = np.exp(-beta * np.sqrt(X[:, None, :] + X[None, :, :]).sum(-1))
+        F = ExpSemigroupQRLT(d, s, SketchContext(seed=2), beta=beta, skip=500)
+        Z = F.apply(jnp.asarray(X.T), "columnwise")
+        assert np.all(np.isfinite(np.asarray(Z)))
+        assert _kernel_mse(Z, K) < 0.1
+
+
+class TestPPT:
+    def test_polynomial_kernel_approx(self, rng):
+        d, m, s = 10, 15, 8192
+        q, c, gamma = 2, 1.0, 0.5
+        X = rng.standard_normal((m, d)) / np.sqrt(d)
+        K = (gamma * (X @ X.T) + c) ** q
+        F = PPT(d, s, SketchContext(seed=1), q=q, c=c, gamma=gamma)
+        Z = F.apply(jnp.asarray(X.T), "columnwise")
+        assert _kernel_mse(Z, K) < 0.05
+
+    def test_exact_expectation_q1(self, rng):
+        # q=1: CWT preserves inner products exactly in expectation; with
+        # the constant term the feature map satisfies E[<z(x),z(y)>] =
+        # gamma x.y + c. Sanity-check one draw loosely.
+        d, s = 8, 4096
+        x = rng.standard_normal(d)
+        y = rng.standard_normal(d)
+        F = PPT(d, s, SketchContext(seed=3), q=1, c=2.0, gamma=1.5)
+        zx = np.asarray(F.apply(jnp.asarray(x), "columnwise"))
+        zy = np.asarray(F.apply(jnp.asarray(y), "columnwise"))
+        expected = 1.5 * float(x @ y) + 2.0
+        assert abs(zx @ zy - expected) < 0.7
+
+    def test_json_roundtrip(self, rng):
+        F = PPT(6, 32, SketchContext(seed=4), q=3, c=0.5, gamma=2.0)
+        F2 = from_json(F.to_json())
+        X = jnp.asarray(rng.standard_normal((6, 3)))
+        np.testing.assert_allclose(
+            np.asarray(F.apply(X, "columnwise")),
+            np.asarray(F2.apply(X, "columnwise")),
+            rtol=1e-10,
+        )
+
+    def test_jittable(self, rng):
+        F = PPT(6, 64, SketchContext(seed=5), q=2)
+        Z = jax.jit(lambda X: F.apply(X, "columnwise"))(
+            jnp.asarray(rng.standard_normal((6, 4)))
+        )
+        assert Z.shape == (64, 4)
