@@ -10,13 +10,14 @@ collectives instead of MPI.
 
 __version__ = "0.1.0"
 
-from . import core, io, linalg, parallel, sketch, solvers
+from . import core, io, linalg, ml, parallel, sketch, solvers
 from .core import SketchContext
 
 __all__ = [
     "core",
     "io",
     "linalg",
+    "ml",
     "parallel",
     "sketch",
     "solvers",

@@ -1,0 +1,33 @@
+"""Shared helpers for the CLI drivers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["save_classes", "load_classes", "print_test_metrics"]
+
+
+def save_classes(modelfile, classes) -> None:
+    """Persist the label decoding sidecar next to a saved model."""
+    if classes is not None:
+        np.save(str(modelfile) + ".classes.npy", np.asarray(classes))
+
+
+def load_classes(modelfile):
+    try:
+        return np.load(str(modelfile) + ".classes.npy")
+    except FileNotFoundError:
+        return None
+
+
+def print_test_metrics(model, Xt, yt, regression: bool) -> None:
+    """Uniform test-set scoring block for all drivers."""
+    if regression or getattr(model, "classes", None) is None:
+        pred = np.asarray(model.predict(Xt))
+        pred = pred[:, 0] if pred.ndim > 1 else pred
+        err = np.linalg.norm(pred - yt) / max(np.linalg.norm(yt), 1e-30)
+        print(f"Test relative error: {err:.4f}")
+    else:
+        pred = np.asarray(model.predict_labels(Xt, model.classes))
+        acc = float((pred == yt).mean()) * 100
+        print(f"Test accuracy: {acc:.2f}%")

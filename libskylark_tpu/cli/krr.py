@@ -1,0 +1,127 @@
+"""skylark-krr: KRR/RLSC driver (≙ ``ml/skylark_krr.cpp:20-34,54-160``).
+
+Algorithm choices mirror the reference's -a flag:
+  0 exact kernel ridge, 1 faster (precond CG), 2 approximate (feature map),
+  3 sketched approximate, 4 large-scale (block coordinate descent).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+_ALGS = {0: "exact", 1: "faster", 2: "approximate", 3: "sketched", 4: "largescale"}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="skylark-krr")
+    p.add_argument("--trainfile", required=True)
+    p.add_argument("--testfile", default=None)
+    p.add_argument("--modelfile", default="model.json")
+    p.add_argument("--algorithm", "-a", type=int, default=1, choices=_ALGS)
+    p.add_argument("--kernel", "-k", default="gaussian",
+                   choices=["linear", "gaussian", "polynomial", "laplacian",
+                            "expsemigroup", "matern"])
+    p.add_argument("--lambda", dest="lam", type=float, default=0.01)
+    p.add_argument("--sigma", "-x", type=float, default=1.0)
+    p.add_argument("--q", type=int, default=2)
+    p.add_argument("--c", type=float, default=1.0)
+    p.add_argument("--gamma", type=float, default=1.0)
+    p.add_argument("--beta", type=float, default=1.0)
+    p.add_argument("--nu", type=float, default=1.5)
+    p.add_argument("--l", type=float, default=1.0)
+    p.add_argument("--numfeatures", "-f", type=int, default=1024)
+    p.add_argument("--seed", type=int, default=38734)
+    p.add_argument("--regression", action="store_true")
+    p.add_argument("--use-fast", action="store_true")
+    p.add_argument("--tolerance", type=float, default=1e-3)
+    p.add_argument("--max-split", type=int, default=0)
+    p.add_argument("--sparse", action="store_true")
+    p.add_argument("--x64", action="store_true")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.x64:
+        jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from ..core.context import SketchContext
+    from ..io import read_libsvm
+    from ..ml import KrrParams, kernel_by_name
+    from ..ml import krr as krr_mod
+    from ..ml import rlsc as rlsc_mod
+
+    X, y = read_libsvm(args.trainfile, sparse=args.sparse)
+    n, d = X.shape
+    kparams = {
+        "linear": {},
+        "gaussian": {"sigma": args.sigma},
+        "polynomial": {"q": args.q, "c": args.c, "gamma": args.gamma},
+        "laplacian": {"sigma": args.sigma},
+        "expsemigroup": {"beta": args.beta},
+        "matern": {"nu": args.nu, "l": args.l},
+    }[args.kernel]
+    kernel = kernel_by_name(args.kernel, d, **kparams)
+    ctx = SketchContext(seed=args.seed)
+    params = KrrParams(
+        am_i_printing=True,
+        log_level=1,
+        use_fast=args.use_fast,
+        tolerance=args.tolerance,
+        max_split=args.max_split,
+    )
+
+    Xj = X if args.sparse else jnp.asarray(X)
+    t0 = time.perf_counter()
+    alg = _ALGS[args.algorithm]
+    yj = jnp.asarray(y) if args.regression else y
+    if alg == "exact":
+        fn = krr_mod.kernel_ridge if args.regression else rlsc_mod.kernel_rlsc
+        model = fn(kernel, Xj, yj, args.lam, params)
+    elif alg == "faster":
+        fn = (krr_mod.faster_kernel_ridge if args.regression
+              else rlsc_mod.faster_kernel_rlsc)
+        model = fn(kernel, Xj, yj, args.lam, args.numfeatures, ctx, params)
+    elif alg == "approximate":
+        fn = (krr_mod.approximate_kernel_ridge if args.regression
+              else rlsc_mod.approximate_kernel_rlsc)
+        model = fn(kernel, Xj, yj, args.lam, args.numfeatures, ctx, params)
+    elif alg == "sketched":
+        fn = (krr_mod.sketched_approximate_kernel_ridge if args.regression
+              else rlsc_mod.sketched_approximate_kernel_rlsc)
+        model = fn(kernel, Xj, yj, args.lam, args.numfeatures, ctx, params)
+    else:  # largescale (regression path; classification via coded targets)
+        if args.regression:
+            model = krr_mod.large_scale_kernel_ridge(
+                kernel, Xj, yj, args.lam, args.numfeatures, ctx, params
+            )
+        else:
+            from ..ml.coding import dummy_coding
+
+            T, classes = dummy_coding(y)
+            model = krr_mod.large_scale_kernel_ridge(
+                kernel, Xj, T, args.lam, args.numfeatures, ctx, params
+            )
+            model.classes = classes
+    dt = time.perf_counter() - t0
+    print(f"Training ({alg}) took {dt:.3f} sec")
+
+    from .common import print_test_metrics, save_classes
+
+    model.save(args.modelfile)
+    save_classes(args.modelfile, getattr(model, "classes", None))
+    print(f"Model saved to {args.modelfile}")
+
+    if args.testfile:
+        Xt, yt = read_libsvm(args.testfile, n_features=d, sparse=args.sparse)
+        Xtj = Xt if args.sparse else jnp.asarray(Xt)
+        print_test_metrics(model, Xtj, yt, args.regression)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,130 @@
+"""skylark-ml: BlockADMM kernel-machine train/predict driver.
+
+≙ ``ml/skylark_ml.cpp:15-174`` + ``hilbert_options_t``
+(``ml/options.hpp:53-381``) + the GetSolver kernel×options → feature-map
+factory (``ml/hilbert.hpp:11-219``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="skylark-ml")
+    p.add_argument("--trainfile", default=None)
+    p.add_argument("--valfile", default=None)
+    p.add_argument("--testfile", default=None)
+    p.add_argument("--modelfile", default="model.json")
+    p.add_argument("--lossfunction", "-l", default="squared",
+                   choices=["squared", "lad", "hinge", "logistic"])
+    p.add_argument("--regularizer", "-r", default="l2",
+                   choices=["none", "l2", "l1"])
+    p.add_argument("--kernel", "-k", default="gaussian",
+                   choices=["linear", "gaussian", "polynomial", "laplacian",
+                            "expsemigroup", "matern"])
+    p.add_argument("--kernelparam", "-g", type=float, default=1.0,
+                   help="sigma / beta / gamma by kernel")
+    p.add_argument("--kernelparam2", type=float, default=1.0)
+    p.add_argument("--kernelparam3", type=float, default=1.0)
+    p.add_argument("--lambda", dest="lam", type=float, default=0.01)
+    p.add_argument("--rho", type=float, default=1.0)
+    p.add_argument("--maxiter", "-i", type=int, default=20)
+    p.add_argument("--numfeatures", "-f", type=int, default=1024)
+    p.add_argument("--numfeaturepartitions", "-n", type=int, default=4)
+    p.add_argument("--datapartitions", type=int, default=1)
+    p.add_argument("--regression", action="store_true")
+    p.add_argument("--usefast", action="store_true")
+    p.add_argument("--seed", "-s", type=int, default=12345)
+    p.add_argument("--sparse", action="store_true")
+    p.add_argument("--x64", action="store_true")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.x64:
+        jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from ..core.context import SketchContext
+    from ..io import read_libsvm
+    from ..ml import ADMMParams, BlockADMMSolver, FeatureMapModel, kernel_by_name
+
+    if args.trainfile is None and args.testfile is None:
+        p.error("need --trainfile (train) or --testfile + --modelfile (predict)")
+
+    if args.trainfile:
+        X, y = read_libsvm(args.trainfile, sparse=args.sparse)
+        n, d = X.shape
+        kparams = {
+            "linear": {},
+            "gaussian": {"sigma": args.kernelparam},
+            "polynomial": {"q": int(args.kernelparam), "c": args.kernelparam2,
+                           "gamma": args.kernelparam3},
+            "laplacian": {"sigma": args.kernelparam},
+            "expsemigroup": {"beta": args.kernelparam},
+            "matern": {"nu": args.kernelparam, "l": args.kernelparam2},
+        }[args.kernel]
+        kernel = kernel_by_name(args.kernel, d, **kparams)
+
+        # Split numfeatures across partitions (≙ GetSolver block creation).
+        J = max(1, args.numfeaturepartitions)
+        sizes = [args.numfeatures // J] * J
+        sizes[-1] += args.numfeatures - sum(sizes)
+        ctx = SketchContext(seed=args.seed)
+        tag = "fast" if args.usefast else "regular"
+        maps = [kernel.create_rft(sz, tag, ctx) for sz in sizes if sz > 0]
+
+        solver = BlockADMMSolver(
+            args.lossfunction,
+            args.regularizer,
+            maps,
+            ADMMParams(
+                am_i_printing=True,
+                log_level=1,
+                rho=args.rho,
+                lam=args.lam,
+                maxiter=args.maxiter,
+                data_partitions=args.datapartitions,
+            ),
+        )
+        Xv = Yv = None
+        if args.valfile:
+            Xv, Yv = read_libsvm(args.valfile, n_features=d, sparse=args.sparse)
+        t0 = time.perf_counter()
+        model = solver.train(
+            np.asarray(X) if not args.sparse else X,
+            y,
+            regression=args.regression,
+            Xv=Xv,
+            Yv=Yv,
+        )
+        print(f"Training took {time.perf_counter() - t0:.3f} sec; "
+              f"final objective {model.history[-1]:.6e}")
+        from .common import save_classes
+
+        model.save(args.modelfile)
+        save_classes(args.modelfile, getattr(model, "classes", None))
+        print(f"Model saved to {args.modelfile}")
+    else:
+        from .common import load_classes
+
+        model = FeatureMapModel.load(args.modelfile)
+        model.classes = load_classes(args.modelfile)
+
+    if args.testfile:
+        from .common import print_test_metrics
+
+        d = model.input_dim
+        Xt, yt = read_libsvm(args.testfile, n_features=d, sparse=args.sparse)
+        Xtj = Xt if args.sparse else jnp.asarray(Xt)
+        print_test_metrics(model, Xtj, yt, args.regression)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

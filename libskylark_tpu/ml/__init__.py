@@ -1,0 +1,58 @@
+"""Machine-learning layer (≙ reference ``ml/``): kernels, KRR/RLSC solver
+families, the BlockADMM kernel-machine trainer, label coding, and model
+persistence."""
+
+from .admm import ADMMParams, BlockADMMSolver
+from .coding import decode_labels, dummy_coding
+from .kernels import (
+    ExpSemigroupKernel,
+    GaussianKernel,
+    Kernel,
+    LaplacianKernel,
+    LinearKernel,
+    MaternKernel,
+    PolynomialKernel,
+    kernel_by_name,
+)
+from .krr import (
+    KrrParams,
+    approximate_kernel_ridge,
+    faster_kernel_ridge,
+    kernel_ridge,
+    large_scale_kernel_ridge,
+    sketched_approximate_kernel_ridge,
+)
+from .model import FeatureMapModel, KernelModel
+from .rlsc import (
+    approximate_kernel_rlsc,
+    faster_kernel_rlsc,
+    kernel_rlsc,
+    sketched_approximate_kernel_rlsc,
+)
+
+__all__ = [
+    "Kernel",
+    "LinearKernel",
+    "GaussianKernel",
+    "PolynomialKernel",
+    "LaplacianKernel",
+    "ExpSemigroupKernel",
+    "MaternKernel",
+    "kernel_by_name",
+    "KrrParams",
+    "kernel_ridge",
+    "approximate_kernel_ridge",
+    "sketched_approximate_kernel_ridge",
+    "faster_kernel_ridge",
+    "large_scale_kernel_ridge",
+    "kernel_rlsc",
+    "approximate_kernel_rlsc",
+    "sketched_approximate_kernel_rlsc",
+    "faster_kernel_rlsc",
+    "dummy_coding",
+    "decode_labels",
+    "ADMMParams",
+    "BlockADMMSolver",
+    "FeatureMapModel",
+    "KernelModel",
+]

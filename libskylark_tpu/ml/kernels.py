@@ -1,0 +1,281 @@
+"""Kernel library (≙ ``ml/kernels.hpp:12-1289``).
+
+``Kernel`` mirrors ``kernel_t``: ``gram(X, Y)`` computes the kernel matrix
+and ``create_rft(s, tag, context)`` builds the matching random feature map
+(tags ≙ ``ml/feature_transform_tags.hpp``: "regular", "fast", "quasi",
+"sparse" where supported).
+
+Convention: X is (n, d) with examples as **rows** (the reference's
+dirX/dirY orientation tags collapse to this fixed layout; its sketches'
+columnwise/rowwise tags are applied internally).  Gram matrices are
+computed from sharded MXU-friendly primitives: squared-distance via the
+‖x‖² + ‖y‖² − 2·X·Yᵀ expansion (≙ ``base/distance.hpp``), L1 distance via
+broadcast (documented O(n·m·d) memory like the reference).
+"""
+
+from __future__ import annotations
+
+import abc
+import json
+import math
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..core.context import SketchContext
+
+__all__ = [
+    "Kernel",
+    "LinearKernel",
+    "GaussianKernel",
+    "PolynomialKernel",
+    "LaplacianKernel",
+    "ExpSemigroupKernel",
+    "MaternKernel",
+    "kernel_by_name",
+]
+
+
+def _sqdist(X, Y):
+    """Pairwise squared euclidean distances, (n, m) — one big matmul."""
+    xx = jnp.sum(X * X, axis=1)[:, None]
+    yy = jnp.sum(Y * Y, axis=1)[None, :]
+    return jnp.maximum(xx + yy - 2.0 * (X @ Y.T), 0.0)
+
+
+def _l1dist(X, Y):
+    """Pairwise L1 distances (broadcast; O(n·m·d) like base/distance.hpp)."""
+    return jnp.sum(jnp.abs(X[:, None, :] - Y[None, :, :]), axis=-1)
+
+
+class Kernel(abc.ABC):
+    """≙ ``kernel_t`` (``ml/kernels.hpp:12-70``)."""
+
+    kernel_type: str = "abstract"
+
+    def __init__(self, n: int):
+        self.n = int(n)  # input dimension (≙ _N)
+
+    @abc.abstractmethod
+    def gram(self, X, Y=None):
+        """K[i, j] = k(X[i], Y[j]); Y=None means Y=X (symmetric_gram)."""
+
+    @abc.abstractmethod
+    def create_rft(self, s: int, tag: str, context: SketchContext):
+        """Feature map with s features approximating this kernel."""
+
+    # -- serialization (≙ kernel_t::to_ptree) -------------------------------
+
+    def _param_dict(self) -> dict[str, Any]:
+        return {}
+
+    def to_dict(self):
+        d = {"kernel_type": self.kernel_type, "N": self.n}
+        d.update(self._param_dict())
+        return d
+
+    def to_json(self):
+        return json.dumps(self.to_dict())
+
+    def __repr__(self):
+        params = ", ".join(f"{k}={v}" for k, v in self._param_dict().items())
+        return f"{type(self).__name__}(N={self.n}{', ' + params if params else ''})"
+
+
+class LinearKernel(Kernel):
+    """k(x, y) = xᵀy (≙ ``linear_t``, ml/kernels.hpp:156)."""
+
+    kernel_type = "linear"
+
+    def gram(self, X, Y=None):
+        Y = X if Y is None else Y
+        return X @ Y.T
+
+    def create_rft(self, s, tag, context):
+        from ..sketch import CWT, FJLT, JLT
+
+        # ≙ linear_t::create_rft: JLT regular / FJLT fast / CWT sparse.
+        if tag == "regular":
+            return JLT(self.n, s, context)
+        if tag == "fast":
+            return FJLT(self.n, s, context)
+        if tag == "sparse":
+            return CWT(self.n, s, context)
+        raise ValueError(f"linear kernel has no {tag!r} feature transform")
+
+
+class GaussianKernel(Kernel):
+    """k(x, y) = exp(−‖x−y‖²/(2σ²)) (≙ ``gaussian_t``, ml/kernels.hpp:320)."""
+
+    kernel_type = "gaussian"
+
+    def __init__(self, n: int, sigma: float):
+        super().__init__(n)
+        self.sigma = float(sigma)
+
+    def gram(self, X, Y=None):
+        Y = X if Y is None else Y
+        return jnp.exp(-_sqdist(X, Y) / (2.0 * self.sigma**2))
+
+    def create_rft(self, s, tag, context):
+        from ..sketch import FastGaussianRFT, GaussianQRFT, GaussianRFT
+
+        if tag == "regular":
+            return GaussianRFT(self.n, s, context, sigma=self.sigma)
+        if tag == "fast":
+            return FastGaussianRFT(self.n, s, context, sigma=self.sigma)
+        if tag == "quasi":
+            return GaussianQRFT(self.n, s, context, sigma=self.sigma)
+        raise ValueError(f"gaussian kernel has no {tag!r} feature transform")
+
+    def _param_dict(self):
+        return {"sigma": self.sigma}
+
+
+class PolynomialKernel(Kernel):
+    """k(x, y) = (γ·xᵀy + c)^q (≙ ``polynomial_t``, ml/kernels.hpp:495)."""
+
+    kernel_type = "polynomial"
+
+    def __init__(self, n: int, q: int = 2, c: float = 1.0, gamma: float = 1.0):
+        super().__init__(n)
+        self.q = int(q)
+        self.c = float(c)
+        self.gamma = float(gamma)
+
+    def gram(self, X, Y=None):
+        Y = X if Y is None else Y
+        return (self.gamma * (X @ Y.T) + self.c) ** self.q
+
+    def create_rft(self, s, tag, context):
+        from ..sketch import PPT
+
+        if tag in ("regular", "fast"):
+            return PPT(self.n, s, context, q=self.q, c=self.c, gamma=self.gamma)
+        raise ValueError(f"polynomial kernel has no {tag!r} feature transform")
+
+    def _param_dict(self):
+        return {"q": self.q, "c": self.c, "gamma": self.gamma}
+
+
+class LaplacianKernel(Kernel):
+    """k(x, y) = exp(−‖x−y‖₁/σ) (≙ ``laplacian_t``, ml/kernels.hpp:671)."""
+
+    kernel_type = "laplacian"
+
+    def __init__(self, n: int, sigma: float):
+        super().__init__(n)
+        self.sigma = float(sigma)
+
+    def gram(self, X, Y=None):
+        Y = X if Y is None else Y
+        return jnp.exp(-_l1dist(X, Y) / self.sigma)
+
+    def create_rft(self, s, tag, context):
+        from ..sketch import LaplacianQRFT, LaplacianRFT
+
+        if tag == "regular":
+            return LaplacianRFT(self.n, s, context, sigma=self.sigma)
+        if tag == "quasi":
+            return LaplacianQRFT(self.n, s, context, sigma=self.sigma)
+        raise ValueError(f"laplacian kernel has no {tag!r} feature transform")
+
+    def _param_dict(self):
+        return {"sigma": self.sigma}
+
+
+class ExpSemigroupKernel(Kernel):
+    """k(x, y) = exp(−β·Σ_i √(x_i + y_i)) on histograms
+    (≙ ``expsemigroup_t``, ml/kernels.hpp:844)."""
+
+    kernel_type = "expsemigroup"
+
+    def __init__(self, n: int, beta: float):
+        super().__init__(n)
+        self.beta = float(beta)
+
+    def gram(self, X, Y=None):
+        Y = X if Y is None else Y
+        s = jnp.sum(jnp.sqrt(jnp.maximum(X[:, None, :] + Y[None, :, :], 0.0)), axis=-1)
+        return jnp.exp(-self.beta * s)
+
+    def create_rft(self, s, tag, context):
+        from ..sketch import ExpSemigroupQRLT, ExpSemigroupRLT
+
+        if tag == "regular":
+            return ExpSemigroupRLT(self.n, s, context, beta=self.beta)
+        if tag == "quasi":
+            return ExpSemigroupQRLT(self.n, s, context, beta=self.beta)
+        raise ValueError(f"expsemigroup kernel has no {tag!r} feature transform")
+
+    def _param_dict(self):
+        return {"beta": self.beta}
+
+
+class MaternKernel(Kernel):
+    """Matérn(ν, ℓ) kernel for half-integer ν (closed forms; ν = p + ½)
+    (≙ ``matern_t``, ml/kernels.hpp:1010)."""
+
+    kernel_type = "matern"
+
+    def __init__(self, n: int, nu: float = 0.5, l: float = 1.0):
+        super().__init__(n)
+        two_nu = 2.0 * nu
+        if abs(two_nu - round(two_nu)) > 1e-9 or round(two_nu) % 2 != 1:
+            raise ValueError(
+                f"MaternKernel gram supports half-integer nu (0.5, 1.5, ...), got {nu}"
+            )
+        self.nu = float(nu)
+        self.l = float(l)
+
+    def gram(self, X, Y=None):
+        Y = X if Y is None else Y
+        r = jnp.sqrt(_sqdist(X, Y))
+        p = int(round(self.nu - 0.5))
+        arg = math.sqrt(2.0 * self.nu) * r / self.l
+        # k(r) = exp(−arg)·(p!/(2p)!)·Σ_{i=0}^p ((p+i)!/(i!(p−i)!))(2·arg)^(p−i)
+        total = jnp.zeros_like(arg)
+        for i in range(p + 1):
+            coef = (
+                math.factorial(p + i)
+                / (math.factorial(i) * math.factorial(p - i))
+            )
+            total = total + coef * (2.0 * arg) ** (p - i)
+        scale = math.factorial(p) / math.factorial(2 * p)
+        return jnp.exp(-arg) * scale * total
+
+    def create_rft(self, s, tag, context):
+        from ..sketch import FastMaternRFT, MaternRFT
+
+        if tag == "regular":
+            return MaternRFT(self.n, s, context, nu=self.nu, l=self.l)
+        if tag == "fast":
+            return FastMaternRFT(self.n, s, context, nu=self.nu, l=self.l)
+        raise ValueError(f"matern kernel has no {tag!r} feature transform")
+
+    def _param_dict(self):
+        return {"nu": self.nu, "l": self.l}
+
+
+_KERNELS = {
+    "linear": LinearKernel,
+    "gaussian": GaussianKernel,
+    "polynomial": PolynomialKernel,
+    "laplacian": LaplacianKernel,
+    "expsemigroup": ExpSemigroupKernel,
+    "matern": MaternKernel,
+}
+
+
+def kernel_by_name(name: str, n: int, **params) -> Kernel:
+    """String-typed kernel factory (≙ the C API's kernel creation)."""
+    if name not in _KERNELS:
+        raise ValueError(f"unknown kernel {name!r}; known: {sorted(_KERNELS)}")
+    return _KERNELS[name](n, **params)
+
+
+def from_dict(d: dict) -> Kernel:
+    d = dict(d)
+    name = d.pop("kernel_type")
+    n = d.pop("N")
+    return kernel_by_name(name, n, **d)

@@ -1,0 +1,269 @@
+"""Kernel ridge regression — the five solver strategies of ``ml/krr.hpp``.
+
+1. ``kernel_ridge``: exact — Gram + Cholesky solve (≙ ``KernelRidge``,
+   krr.hpp:49-92).
+2. ``approximate_kernel_ridge``: feature map + ridge solve in feature
+   space (≙ ``ApproximateKernelRidge``, krr.hpp:94-197).
+3. ``sketched_approximate_kernel_ridge``: additionally sketches the
+   feature-space ridge problem down to t rows (≙
+   ``SketchedApproximateKernelRidge``, krr.hpp:199-310).
+4. ``faster_kernel_ridge``: CG on the full Gram with the random-feature
+   covariance preconditioner (≙ ``FasterKernelRidge`` +
+   ``feature_map_precond_t``, krr.hpp:312-543).
+5. ``large_scale_kernel_ridge``: memory-bounded block coordinate descent
+   over feature-map chunks with cached Cholesky factors (≙
+   ``LargeScaleKernelRidge``, krr.hpp:546-727).
+
+Convention: X (n, d) rows-as-examples; Y (n,) or (n, t).  Feature-space
+solvers return ``FeatureMapModel``; kernel-space ones ``KernelModel``.
+
+TPU notes: Gram assembly, feature application, and the covariance HERK are
+the MXU ops and shard over the examples axis; the s×s factorizations are
+replicated-small (≙ the reference's ``[*,*]`` / ``[STAR,STAR]`` choices).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.linalg import cho_factor, cho_solve, solve_triangular
+
+from ..core.context import SketchContext
+from ..core.params import Params
+from ..parallel.mesh import fully_replicated
+from ..sketch.base import Dimension, create_sketch
+from ..solvers.krylov import KrylovParams, cg
+from .kernels import Kernel
+from .model import FeatureMapModel, KernelModel
+
+__all__ = [
+    "KrrParams",
+    "kernel_ridge",
+    "approximate_kernel_ridge",
+    "sketched_approximate_kernel_ridge",
+    "faster_kernel_ridge",
+    "large_scale_kernel_ridge",
+]
+
+
+@dataclass
+class KrrParams(Params):
+    """≙ ``krr_params_t`` (krr.hpp:8-46)."""
+
+    use_fast: bool = False          # fast feature transforms (Fastfood)
+    sketched_rr: bool = False       # sketch the feature ridge problem
+    sketch_size: int = -1           # -1 → 4·s (krr.hpp:146)
+    fast_sketch: bool = False       # CWT instead of FJLT for the sketch
+    tolerance: float = 1e-3         # iterative tolerance
+    res_print: int = 10
+    iter_lim: int = 1000
+    max_split: int = 0              # feature chunk size (large-scale)
+
+
+def _as2d(Y):
+    Y = jnp.asarray(Y)
+    return (Y[:, None], True) if Y.ndim == 1 else (Y, False)
+
+
+def _dense(X):
+    """Densify BCOO for Gram-matrix paths (kernel matrices are dense
+    anyway); leave dense arrays untouched."""
+    return X.todense() if hasattr(X, "todense") else jnp.asarray(X)
+
+
+def _maybe_sparse(X):
+    """Keep BCOO as-is for feature-map paths (the sketches handle it)."""
+    return X if hasattr(X, "todense") else jnp.asarray(X)
+
+
+def _tag(params: KrrParams) -> str:
+    return "fast" if params.use_fast else "regular"
+
+
+def kernel_ridge(kernel: Kernel, X, Y, lam: float, params: KrrParams | None = None):
+    """Exact KRR: solve (K + λI)·A = Y; returns a ``KernelModel``."""
+    params = params or KrrParams()
+    X = _dense(X)
+    Y2, _ = _as2d(Y)
+    K = kernel.gram(X)
+    n = K.shape[0]
+    Kl = fully_replicated(K + lam * jnp.eye(n, dtype=K.dtype))
+    A = cho_solve(cho_factor(Kl, lower=True), Y2)
+    return KernelModel(kernel, X, A)
+
+
+def approximate_kernel_ridge(
+    kernel: Kernel,
+    X,
+    Y,
+    lam: float,
+    s: int,
+    context: SketchContext,
+    params: KrrParams | None = None,
+):
+    """Feature map Z = S(X) (n, s), then ridge: (ZᵀZ + λI)W = ZᵀY.
+
+    ≙ ``ApproximateKernelRidge`` (krr.hpp:94-197; its ``El::Ridge`` is the
+    same normal-equations solve).  Returns a ``FeatureMapModel``.
+    """
+    params = params or KrrParams()
+    X = _maybe_sparse(X)
+    Y2, _ = _as2d(Y)
+    S = kernel.create_rft(s, _tag(params), context)
+    Z = S.apply(X, Dimension.ROWWISE)  # (n, s)
+    if params.sketched_rr:
+        return _solve_sketched_ridge(S, Z, Y2, lam, s, context, params)
+    G = fully_replicated(Z.T @ Z + lam * jnp.eye(s, dtype=Z.dtype))
+    W = cho_solve(cho_factor(G, lower=True), Z.T @ Y2)
+    return FeatureMapModel([S], W)
+
+
+def _solve_sketched_ridge(S, Z, Y2, lam, s, context, params):
+    """Sketch the (n, s) ridge problem down to t rows (krr.hpp:135-180)."""
+    n = Z.shape[0]
+    t = params.sketch_size if params.sketch_size != -1 else min(4 * s, n)
+    sk_type = "CWT" if params.fast_sketch else "FJLT"
+    R = create_sketch(sk_type, n, t, context)
+    SZ = R.apply(Z, Dimension.COLUMNWISE)  # (t, s)
+    SY = R.apply(Y2, Dimension.COLUMNWISE)  # (t, k)
+    G = fully_replicated(SZ.T @ SZ + lam * jnp.eye(s, dtype=Z.dtype))
+    W = cho_solve(cho_factor(G, lower=True), SZ.T @ SY)
+    return FeatureMapModel([S], W)
+
+
+def sketched_approximate_kernel_ridge(
+    kernel, X, Y, lam, s, context, params: KrrParams | None = None
+):
+    """≙ ``SketchedApproximateKernelRidge`` (krr.hpp:199-310)."""
+    params = dataclasses.replace(params or KrrParams(), sketched_rr=True)
+    return approximate_kernel_ridge(kernel, X, Y, lam, s, context, params)
+
+
+class _FeatureMapPrecond:
+    """(ZᵀZ + λI)⁻¹ as a preconditioner for (K + λI), via Woodbury.
+
+    ≙ ``feature_map_precond_t`` (krr.hpp:312-450): U = Z (s, n) features;
+    C = I + U·Uᵀ/λ, L = chol(C), Ũ = L⁻¹U/λ; apply(B) = B/λ − Ũᵀ(Ũ·B).
+    """
+
+    def __init__(self, kernel, lam, X, s, context, params):
+        S = kernel.create_rft(s, _tag(params), context)
+        U = S.apply(jnp.asarray(X), Dimension.ROWWISE).T  # (s, n)
+        lam = jnp.asarray(lam, U.dtype)
+        C = fully_replicated(
+            jnp.eye(s, dtype=U.dtype) + (U @ U.T) / lam
+        )
+        L = jnp.linalg.cholesky(C)
+        self.U = solve_triangular(L, U, lower=True) / lam
+        self.lam = lam
+
+    def apply(self, B):
+        return B / self.lam - self.U.T @ (self.U @ B)
+
+    def apply_adjoint(self, B):
+        return self.apply(B)
+
+
+def faster_kernel_ridge(
+    kernel: Kernel,
+    X,
+    Y,
+    lam: float,
+    s: int,
+    context: SketchContext,
+    params: KrrParams | None = None,
+):
+    """CG on (K + λI)·A = Y preconditioned by the random-feature
+    covariance (≙ ``FasterKernelRidge``, krr.hpp:452-543)."""
+    params = params or KrrParams()
+    X = _dense(X)
+    Y2, _ = _as2d(Y)
+    K = kernel.gram(X)
+    n = K.shape[0]
+    Kl = K + lam * jnp.eye(n, dtype=K.dtype)
+    P = _FeatureMapPrecond(kernel, lam, X, s, context, params)
+    A, info = cg(
+        Kl,
+        Y2,
+        precond=P,
+        params=KrylovParams(
+            tolerance=params.tolerance, iter_lim=params.iter_lim
+        ),
+    )
+    model = KernelModel(kernel, X, A)
+    model.info = info
+    return model
+
+
+def large_scale_kernel_ridge(
+    kernel: Kernel,
+    X,
+    Y,
+    lam: float,
+    s: int,
+    context: SketchContext,
+    params: KrrParams | None = None,
+):
+    """Memory-bounded block coordinate descent over feature chunks.
+
+    ≙ ``LargeScaleKernelRidge`` (krr.hpp:546-727): chunk the s features
+    into C transforms of ~max_split/2 each; iterate
+      ZR = Z_c·R − λ·W_c;  δ = (Z_cZ_cᵀ + λI)⁻¹·ZR  (cached Cholesky);
+      W_c += δ;  R −= Z_cᵀ·δ
+    until the relative update is below tolerance.
+    """
+    params = params or KrrParams()
+    X = _maybe_sparse(X)
+    Y2, _ = _as2d(Y)
+    n, d = X.shape
+
+    # Chunk sizes (krr.hpp:573-592).
+    sinc = d if params.max_split == 0 else max(1, params.max_split // 2)
+    sizes = []
+    remains = s
+    while remains > 0:
+        this = remains if remains <= 2 * sinc else sinc
+        sizes.append(this)
+        remains -= this
+    maps = [kernel.create_rft(sz, _tag(params), context) for sz in sizes]
+
+    Zs = [S.apply(X, Dimension.ROWWISE).T for S in maps]  # (sz, n) each
+    dtype = Zs[0].dtype
+    lam_ = jnp.asarray(lam, dtype)
+    t = Y2.shape[1]
+    Ws = [jnp.zeros((sz, t), dtype) for sz in sizes]
+    R = Y2.astype(dtype)
+
+    # First sweep builds the cached factors (krr.hpp:608-660).
+    factors = []
+    for c, Z in enumerate(Zs):
+        G = fully_replicated(Z @ Z.T + lam_ * jnp.eye(Z.shape[0], dtype=dtype))
+        Lc = cho_factor(G, lower=True)
+        factors.append(Lc)
+        ZR = Z @ R - lam_ * Ws[c]
+        delta = cho_solve(Lc, ZR)
+        Ws[c] = Ws[c] + delta
+        R = R - Z.T @ delta
+
+    # More sweeps (krr.hpp:668-727).
+    for it in range(1, params.iter_lim):
+        delsize = 0.0
+        for c, Z in enumerate(Zs):
+            ZR = Z @ R - lam_ * Ws[c]
+            delta = cho_solve(factors[c], ZR)
+            Ws[c] = Ws[c] + delta
+            R = R - Z.T @ delta
+            delsize += float(jnp.sum(delta * delta))
+        wnorm = float(
+            jnp.sqrt(sum(jnp.sum(W * W) for W in Ws))
+        )
+        reldel = (delsize**0.5) / max(wnorm, 1e-30)
+        params.log(2, f"iteration {it}, relupdate = {reldel:.2e}")
+        if reldel < params.tolerance:
+            break
+
+    W = jnp.concatenate(Ws, axis=0)
+    return FeatureMapModel(maps, W)

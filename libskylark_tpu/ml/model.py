@@ -1,0 +1,156 @@
+"""Model persistence and prediction (≙ ``ml/model.hpp``).
+
+- ``FeatureMapModel`` ≙ ``hilbert_model_t`` (model.hpp:50-276): a chain of
+  serialized feature maps + a coefficient matrix; ``predict`` re-applies
+  the maps.  JSON save/load reconstructs the maps through the sketch
+  registry (all randomness is counter-derived, so a model is a few KB of
+  JSON + the coefficients).
+- ``KernelModel`` ≙ the kernel models that hold the training X
+  (model.hpp:278-1255): predict via k(X_train, X_test)ᵀ·A.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..sketch.base import Dimension, from_dict as sketch_from_dict
+
+__all__ = ["FeatureMapModel", "KernelModel"]
+
+_SERIAL_VERSION = 1
+
+
+class FeatureMapModel:
+    """Coefficients W over concatenated feature-map outputs.
+
+    ``maps`` may be empty (linear model on raw features, ≙ hilbert model
+    with no transforms).  ``scale_maps`` applies the reference's
+    ``sqrt(sj/d)`` block scaling (``BlockADMM.hpp:425-426``).
+    """
+
+    def __init__(self, maps: Sequence, W, scale_maps: bool = False, input_dim=None):
+        self.maps = list(maps)
+        self.W = jnp.asarray(W)
+        self.scale_maps = bool(scale_maps)
+        self.input_dim = input_dim or (self.maps[0].n if self.maps else None)
+
+    def features(self, X):
+        """Concatenated (n, D) feature matrix for X (n, d)."""
+        X = jnp.asarray(X)
+        if not self.maps:
+            return X
+        blocks = []
+        for S in self.maps:
+            Z = S.apply(X, Dimension.ROWWISE)
+            if self.scale_maps:
+                Z = Z * jnp.asarray(
+                    np.sqrt(Z.shape[-1] / X.shape[-1]), Z.dtype
+                )
+            blocks.append(Z)
+        return jnp.concatenate(blocks, axis=-1)
+
+    def predict(self, X):
+        """(n, k) outputs (decision values / regression predictions)."""
+        Z = self.features(X)
+        return Z @ self.W.astype(Z.dtype)
+
+    def predict_labels(self, X, classes=None):
+        O = self.predict(X)
+        idx = jnp.argmax(O, axis=-1)
+        if classes is not None:
+            return jnp.asarray(classes)[idx]
+        return idx
+
+    # -- persistence (≙ hilbert_model_t::save / load) -----------------------
+
+    def to_dict(self):
+        return {
+            "skylark_object_type": "model",
+            "skylark_version": _SERIAL_VERSION,
+            "model_type": "feature_map",
+            "scale_maps": self.scale_maps,
+            "input_dim": self.input_dim,
+            "maps": [S.to_dict() for S in self.maps],
+            "coef_shape": list(self.W.shape),
+        }
+
+    def save(self, path: str):
+        """JSON metadata + .npy coefficients next to it (the reference
+        embeds the dense coefficient text in the JSON; .npy is the
+        faithful-but-binary equivalent)."""
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=1)
+        np.save(self._coef_path(path), np.asarray(self.W))
+
+    @classmethod
+    def load(cls, path: str):
+        with open(path) as f:
+            d = json.load(f)
+        if d.get("model_type") != "feature_map":
+            raise ValueError(f"not a feature_map model: {d.get('model_type')}")
+        W = np.load(cls._coef_path(path))
+        maps = [sketch_from_dict(md) for md in d["maps"]]
+        return cls(maps, jnp.asarray(W), scale_maps=d.get("scale_maps", False),
+                   input_dim=d.get("input_dim"))
+
+    @staticmethod
+    def _coef_path(path):
+        return os.fspath(path) + ".coef.npy"
+
+
+class KernelModel:
+    """Kernel-space model: predict = k(X_test, X_train) @ A."""
+
+    def __init__(self, kernel, X_train, A):
+        self.kernel = kernel
+        self.X_train = jnp.asarray(X_train)
+        self.A = jnp.asarray(A)
+        self.info = None
+
+    def predict(self, X):
+        K = self.kernel.gram(jnp.asarray(X), self.X_train)  # (m, n)
+        return K @ self.A
+
+    def predict_labels(self, X, classes=None):
+        O = self.predict(X)
+        idx = jnp.argmax(O, axis=-1)
+        if classes is not None:
+            return jnp.asarray(classes)[idx]
+        return idx
+
+    def save(self, path: str):
+        from .kernels import Kernel  # noqa: F401
+
+        d = {
+            "skylark_object_type": "model",
+            "skylark_version": _SERIAL_VERSION,
+            "model_type": "kernel",
+            "kernel": self.kernel.to_dict(),
+        }
+        with open(path, "w") as f:
+            json.dump(d, f, indent=1)
+        np.savez(
+            os.fspath(path) + ".data.npz",
+            X_train=np.asarray(self.X_train),
+            A=np.asarray(self.A),
+        )
+
+    @classmethod
+    def load(cls, path: str):
+        from .kernels import from_dict as kernel_from_dict
+
+        with open(path) as f:
+            d = json.load(f)
+        if d.get("model_type") != "kernel":
+            raise ValueError(f"not a kernel model: {d.get('model_type')}")
+        data = np.load(os.fspath(path) + ".data.npz")
+        return cls(
+            kernel_from_dict(d["kernel"]),
+            jnp.asarray(data["X_train"]),
+            jnp.asarray(data["A"]),
+        )

@@ -42,6 +42,7 @@ class SquaredLoss:
     """½‖O − Y‖² (≙ ``squaredloss_t``, loss.hpp:26-105)."""
 
     name = "squared"
+    label_based = False  # takes numeric targets (coded ±1 for classes)
 
     def evaluate(self, O, Y):
         return 0.5 * jnp.sum((O - Y) ** 2)
@@ -56,6 +57,7 @@ class LadLoss:
     loss.hpp:107-201)."""
 
     name = "lad"
+    label_based = False
 
     def evaluate(self, O, Y):
         return jnp.sum(jnp.abs(O - Y))
@@ -75,6 +77,7 @@ class HingeLoss:
     """
 
     name = "hinge"
+    label_based = True  # takes class indices (multiclass) or ±1 (binary)
 
     def _code(self, O, Y):
         if O.ndim >= 2 and O.shape[0] > 1:
@@ -102,6 +105,7 @@ class LogisticLoss:
     point, jit-compatible)."""
 
     name = "logistic"
+    label_based = True
 
     def __init__(self, newton_steps: int = 20):
         self.newton_steps = newton_steps

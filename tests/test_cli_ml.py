@@ -1,0 +1,112 @@
+"""CLI driver tests for skylark-krr and skylark-ml + graft entry points."""
+
+import numpy as np
+import pytest
+
+from libskylark_tpu.io import write_libsvm
+
+
+@pytest.fixture
+def blob_files(tmp_path, rng):
+    d = 4
+    X0 = rng.standard_normal((40, d)) - 1.5
+    X1 = rng.standard_normal((40, d)) + 1.5
+    X = np.vstack([X0, X1])
+    y = np.array([1] * 40 + [2] * 40)
+    perm = rng.permutation(80)
+    X, y = X[perm], y[perm]
+    write_libsvm(tmp_path / "train", X[:64], y[:64])
+    write_libsvm(tmp_path / "test", X[64:], y[64:])
+    return tmp_path
+
+
+class TestKrrCLI:
+    @pytest.mark.parametrize("alg", [0, 1, 2])
+    def test_classification(self, blob_files, alg, capsys):
+        from libskylark_tpu.cli.krr import main
+
+        rc = main([
+            "--trainfile", str(blob_files / "train"),
+            "--testfile", str(blob_files / "test"),
+            "--modelfile", str(blob_files / "m.json"),
+            "-a", str(alg), "--sigma", "2.0", "-f", "256",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        acc = float(out.split("Test accuracy:")[1].split("%")[0])
+        assert acc > 85.0
+
+    def test_regression(self, tmp_path, rng, capsys):
+        from libskylark_tpu.cli.krr import main
+
+        X = rng.standard_normal((100, 3))
+        y = X.sum(1)
+        write_libsvm(tmp_path / "train", X, y)
+        write_libsvm(tmp_path / "test", X[:20], y[:20])
+        rc = main([
+            "--trainfile", str(tmp_path / "train"),
+            "--testfile", str(tmp_path / "test"),
+            "--modelfile", str(tmp_path / "m.json"),
+            "-a", "2", "--regression", "--sigma", "3.0", "-f", "512",
+            "--lambda", "0.001",
+        ])
+        assert rc == 0
+        err = float(capsys.readouterr().out.split("relative error:")[1])
+        assert err < 0.2
+
+
+class TestMlCLI:
+    def test_train_and_predict(self, blob_files, capsys):
+        from libskylark_tpu.cli.ml import main
+
+        rc = main([
+            "--trainfile", str(blob_files / "train"),
+            "--testfile", str(blob_files / "test"),
+            "--modelfile", str(blob_files / "admm.json"),
+            "-l", "hinge", "-g", "2.0", "-f", "256", "-n", "2",
+            "-i", "25", "--lambda", "0.005",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        acc = float(out.split("Test accuracy:")[1].split("%")[0])
+        assert acc > 85.0
+
+    def test_predict_from_saved_model(self, blob_files, capsys):
+        from libskylark_tpu.cli.ml import main
+
+        main([
+            "--trainfile", str(blob_files / "train"),
+            "--modelfile", str(blob_files / "admm2.json"),
+            "-l", "squared", "-g", "2.0", "-f", "128", "-n", "2", "-i", "15",
+        ])
+        capsys.readouterr()
+        rc = main([
+            "--testfile", str(blob_files / "test"),
+            "--modelfile", str(blob_files / "admm2.json"),
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        acc = float(out.split("Test accuracy:")[1].split("%")[0])
+        assert acc > 85.0
+
+
+class TestGraftEntry:
+    def test_entry_compiles(self):
+        import sys
+
+        sys.path.insert(0, "/root/repo")
+        import __graft_entry__ as g
+        import jax
+
+        fn, args = g.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape == (256, 10)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+    def test_dryrun_multichip_8(self):
+        import sys
+
+        sys.path.insert(0, "/root/repo")
+        import __graft_entry__ as g
+
+        g.dryrun_multichip(8)

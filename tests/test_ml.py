@@ -1,0 +1,281 @@
+"""ML layer tests: kernels, KRR family, RLSC, BlockADMM, model persistence.
+
+Oracles: exact KRR vs direct solve; approximate/faster/large-scale KRR vs
+exact KRR predictions; RLSC classification accuracy on separable data;
+ADMM objective decrease + accuracy; model JSON round-trips (reference test
+style: ``python-skylark/skylark/tests/ml/*``, SURVEY §4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libskylark_tpu import SketchContext
+from libskylark_tpu.ml import (
+    ADMMParams,
+    BlockADMMSolver,
+    FeatureMapModel,
+    GaussianKernel,
+    KernelModel,
+    KrrParams,
+    LaplacianKernel,
+    LinearKernel,
+    MaternKernel,
+    PolynomialKernel,
+    approximate_kernel_ridge,
+    approximate_kernel_rlsc,
+    dummy_coding,
+    faster_kernel_ridge,
+    kernel_by_name,
+    kernel_ridge,
+    kernel_rlsc,
+    large_scale_kernel_ridge,
+    sketched_approximate_kernel_ridge,
+)
+
+
+def two_blobs(rng, n_per, d, sep=3.0):
+    X0 = rng.standard_normal((n_per, d)) - sep / 2
+    X1 = rng.standard_normal((n_per, d)) + sep / 2
+    X = np.vstack([X0, X1])
+    y = np.array([0] * n_per + [1] * n_per)
+    perm = rng.permutation(len(y))
+    return X[perm], y[perm]
+
+
+class TestKernels:
+    def test_gaussian_gram(self, rng):
+        X = rng.standard_normal((10, 4))
+        K = np.asarray(GaussianKernel(4, 2.0).gram(jnp.asarray(X)))
+        D2 = ((X[:, None] - X[None, :]) ** 2).sum(-1)
+        np.testing.assert_allclose(K, np.exp(-D2 / 8.0), rtol=1e-10)
+
+    def test_linear_polynomial_laplacian(self, rng):
+        X = rng.standard_normal((8, 3))
+        Xj = jnp.asarray(X)
+        np.testing.assert_allclose(
+            np.asarray(LinearKernel(3).gram(Xj)), X @ X.T, rtol=1e-12
+        )
+        np.testing.assert_allclose(
+            np.asarray(PolynomialKernel(3, q=2, c=1.0, gamma=0.5).gram(Xj)),
+            (0.5 * X @ X.T + 1.0) ** 2,
+            rtol=1e-12,
+        )
+        D1 = np.abs(X[:, None] - X[None, :]).sum(-1)
+        np.testing.assert_allclose(
+            np.asarray(LaplacianKernel(3, 2.0).gram(Xj)), np.exp(-D1 / 2.0),
+            rtol=1e-12,
+        )
+
+    def test_matern_halfinteger_forms(self, rng):
+        X = rng.standard_normal((6, 3))
+        r = np.sqrt(np.maximum(((X[:, None] - X[None, :]) ** 2).sum(-1), 0))
+        # nu=0.5 -> exp(-r/l)
+        K = np.asarray(MaternKernel(3, nu=0.5, l=1.5).gram(jnp.asarray(X)))
+        np.testing.assert_allclose(K, np.exp(-r / 1.5), rtol=1e-6)
+        # nu=1.5 -> (1+sqrt(3)r/l)exp(-sqrt(3)r/l)
+        K = np.asarray(MaternKernel(3, nu=1.5, l=2.0).gram(jnp.asarray(X)))
+        a = np.sqrt(3) * r / 2.0
+        np.testing.assert_allclose(K, (1 + a) * np.exp(-a), rtol=1e-6)
+
+    def test_gram_rft_consistency(self, rng):
+        # feature map inner products approximate the gram matrix
+        X = jnp.asarray(rng.standard_normal((12, 5)))
+        k = GaussianKernel(5, 2.0)
+        S = k.create_rft(4096, "regular", SketchContext(seed=1))
+        Z = S.apply(X, "rowwise")  # (n, s)
+        assert float(jnp.mean(jnp.abs(Z @ Z.T - k.gram(X)))) < 0.05
+
+    def test_factory(self):
+        k = kernel_by_name("gaussian", 7, sigma=1.5)
+        assert isinstance(k, GaussianKernel) and k.sigma == 1.5
+
+
+class TestKRR:
+    def test_exact_matches_direct(self, rng):
+        X = jnp.asarray(rng.standard_normal((40, 5)))
+        y = jnp.asarray(rng.standard_normal(40))
+        k = GaussianKernel(5, 1.5)
+        m = kernel_ridge(k, X, y, 0.1)
+        K = np.asarray(k.gram(X))
+        a_ref = np.linalg.solve(K + 0.1 * np.eye(40), np.asarray(y))
+        np.testing.assert_allclose(np.asarray(m.A)[:, 0], a_ref, rtol=1e-6, atol=1e-9)
+        # predictions on train ~ K a
+        np.testing.assert_allclose(
+            np.asarray(m.predict(X))[:, 0], K @ a_ref, rtol=1e-6, atol=1e-8
+        )
+
+    def test_approximate_close_to_exact(self, rng):
+        X = jnp.asarray(rng.standard_normal((150, 6)))
+        y = jnp.asarray(np.sin(np.asarray(X).sum(1)))
+        k = GaussianKernel(6, 2.0)
+        exact = kernel_ridge(k, X, y, 0.05)
+        approx = approximate_kernel_ridge(
+            k, X, y, 0.05, 2048, SketchContext(seed=2)
+        )
+        pe = np.asarray(exact.predict(X))[:, 0]
+        pa = np.asarray(approx.predict(X))[:, 0]
+        assert np.mean(np.abs(pe - pa)) < 0.1
+
+    def test_sketched_approximate(self, rng):
+        X = jnp.asarray(rng.standard_normal((300, 4)))
+        y = jnp.asarray(np.asarray(X).sum(1))
+        k = GaussianKernel(4, 3.0)
+        m = sketched_approximate_kernel_ridge(
+            k, X, y, 0.05, 256, SketchContext(seed=3)
+        )
+        pred = np.asarray(m.predict(X))[:, 0]
+        assert np.corrcoef(pred, np.asarray(y))[0, 1] > 0.9
+
+    def test_faster_matches_exact(self, rng):
+        X = jnp.asarray(rng.standard_normal((120, 5)))
+        y = jnp.asarray(rng.standard_normal(120))
+        k = GaussianKernel(5, 2.0)
+        exact = kernel_ridge(k, X, y, 0.1)
+        fast = faster_kernel_ridge(
+            k, X, y, 0.1, 512, SketchContext(seed=4),
+            KrrParams(tolerance=1e-10, iter_lim=500),
+        )
+        np.testing.assert_allclose(
+            np.asarray(fast.A), np.asarray(exact.A), rtol=1e-4, atol=1e-6
+        )
+
+    def test_large_scale_close_to_approximate(self, rng):
+        X = jnp.asarray(rng.standard_normal((200, 6)))
+        y = jnp.asarray(np.sin(np.asarray(X).sum(1)))
+        k = GaussianKernel(6, 2.0)
+        m = large_scale_kernel_ridge(
+            k, X, y, 0.1, 512, SketchContext(seed=5),
+            KrrParams(max_split=256, iter_lim=50, tolerance=1e-8),
+        )
+        assert len(m.maps) > 1  # actually chunked
+        pred = np.asarray(m.predict(X))[:, 0]
+        assert np.corrcoef(pred, np.asarray(y))[0, 1] > 0.9
+
+    def test_multi_target(self, rng):
+        X = jnp.asarray(rng.standard_normal((60, 4)))
+        Y = jnp.asarray(rng.standard_normal((60, 3)))
+        m = kernel_ridge(GaussianKernel(4, 1.0), X, Y, 0.5)
+        assert m.predict(X).shape == (60, 3)
+
+
+class TestRLSC:
+    def test_kernel_rlsc_separable(self, rng):
+        X, y = two_blobs(rng, 40, 4)
+        m = kernel_rlsc(GaussianKernel(4, 2.0), jnp.asarray(X), y, 0.01)
+        pred = np.asarray(m.predict_labels(jnp.asarray(X), m.classes))
+        assert (pred == y).mean() > 0.95
+
+    def test_approximate_rlsc(self, rng):
+        X, y = two_blobs(rng, 50, 5)
+        m = approximate_kernel_rlsc(
+            GaussianKernel(5, 2.0), jnp.asarray(X), y, 0.01, 1024,
+            SketchContext(seed=7),
+        )
+        pred = np.asarray(m.predict_labels(jnp.asarray(X), m.classes))
+        assert (pred == y).mean() > 0.95
+
+    def test_dummy_coding(self):
+        T, classes = dummy_coding(np.array([2, 0, 1, 0]))
+        np.testing.assert_array_equal(classes, [0, 1, 2])
+        np.testing.assert_array_equal(
+            np.asarray(T),
+            [[-1, -1, 1], [1, -1, -1], [-1, 1, -1], [1, -1, -1]],
+        )
+
+
+class TestBlockADMM:
+    def _maps(self, d, blocks, s_each, seed=11, sigma=2.0):
+        ctx = SketchContext(seed=seed)
+        k = GaussianKernel(d, sigma)
+        return [k.create_rft(s_each, "regular", ctx) for _ in range(blocks)]
+
+    def test_objective_decreases(self, rng):
+        X, y = two_blobs(rng, 32, 4)
+        solver = BlockADMMSolver(
+            "squared", "l2", self._maps(4, 2, 64),
+            ADMMParams(rho=1.0, lam=0.01, maxiter=15),
+        )
+        m = solver.train(X, y)
+        h = m.history
+        assert h[-1] <= h[0]
+
+    def test_classification_accuracy(self, rng):
+        X, y = two_blobs(rng, 40, 4)
+        solver = BlockADMMSolver(
+            "hinge", "l2", self._maps(4, 2, 128),
+            ADMMParams(rho=1.0, lam=0.005, maxiter=30),
+        )
+        m = solver.train(X, y)
+        pred = np.asarray(m.predict_labels(jnp.asarray(X), m.classes))
+        assert (pred == y).mean() > 0.9
+
+    def test_data_partitions_invariance(self, rng):
+        # P=1 vs P=4 run the *block-split* algorithm — results differ
+        # slightly (different splitting), but both must train well; and
+        # the P axis must divide n.
+        X, y = two_blobs(rng, 32, 3)
+        for P in (1, 4):
+            solver = BlockADMMSolver(
+                "squared", "l2", self._maps(3, 1, 64, seed=5),
+                ADMMParams(rho=1.0, lam=0.01, maxiter=25, data_partitions=P),
+            )
+            m = solver.train(X, y)
+            pred = np.asarray(m.predict_labels(jnp.asarray(X), m.classes))
+            assert (pred == y).mean() > 0.9, f"P={P}"
+
+    def test_regression_mode(self, rng):
+        X = rng.standard_normal((64, 3))
+        y = X.sum(1) + 0.01 * rng.standard_normal(64)
+        solver = BlockADMMSolver(
+            "squared", "l2", self._maps(3, 1, 256, sigma=3.0),
+            ADMMParams(rho=1.0, lam=1e-4, maxiter=40),
+        )
+        m = solver.train(X, y, regression=True)
+        pred = np.asarray(m.predict(jnp.asarray(X)))[:, 0]
+        assert np.corrcoef(pred, y)[0, 1] > 0.95
+
+    def test_logistic_multiclass(self, rng):
+        # 3-class blobs
+        d = 3
+        X = np.vstack([
+            rng.standard_normal((30, d)) + off
+            for off in ([-4, 0, 0], [4, 0, 0], [0, 4, 0])
+        ])
+        y = np.repeat([0, 1, 2], 30)
+        solver = BlockADMMSolver(
+            "logistic", "l2", self._maps(d, 2, 128, sigma=3.0),
+            ADMMParams(rho=1.0, lam=0.003, maxiter=30),
+        )
+        m = solver.train(X, y)
+        pred = np.asarray(m.predict_labels(jnp.asarray(X), m.classes))
+        assert (pred == y).mean() > 0.9
+
+
+class TestModelPersistence:
+    def test_feature_map_model_roundtrip(self, tmp_path, rng):
+        X, y = two_blobs(rng, 30, 4)
+        m = approximate_kernel_rlsc(
+            GaussianKernel(4, 2.0), jnp.asarray(X), y, 0.01, 256,
+            SketchContext(seed=8),
+        )
+        path = tmp_path / "model.json"
+        m.save(path)
+        m2 = FeatureMapModel.load(path)
+        np.testing.assert_allclose(
+            np.asarray(m.predict(jnp.asarray(X))),
+            np.asarray(m2.predict(jnp.asarray(X))),
+            rtol=1e-6,
+        )
+
+    def test_kernel_model_roundtrip(self, tmp_path, rng):
+        X = jnp.asarray(rng.standard_normal((25, 3)))
+        y = jnp.asarray(rng.standard_normal(25))
+        m = kernel_ridge(GaussianKernel(3, 1.0), X, y, 0.1)
+        path = tmp_path / "km.json"
+        m.save(path)
+        m2 = KernelModel.load(path)
+        np.testing.assert_allclose(
+            np.asarray(m.predict(X)), np.asarray(m2.predict(X)), rtol=1e-8
+        )
