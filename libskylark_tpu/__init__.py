@@ -10,11 +10,12 @@ collectives instead of MPI.
 
 __version__ = "0.1.0"
 
-from . import core, io, linalg, ml, parallel, sketch, solvers
+from . import core, graph, io, linalg, ml, parallel, sketch, solvers
 from .core import SketchContext
 
 __all__ = [
     "core",
+    "graph",
     "io",
     "linalg",
     "ml",

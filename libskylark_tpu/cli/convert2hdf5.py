@@ -1,0 +1,26 @@
+"""skylark-convert2hdf5: LIBSVM → HDF5 converter
+(≙ ``ml/skylark_convert2hdf5.cpp``)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="skylark-convert2hdf5")
+    p.add_argument("input", help="LIBSVM file")
+    p.add_argument("output", help="HDF5 file")
+    p.add_argument("--sparse", action="store_true")
+    args = p.parse_args(argv)
+
+    from ..io import read_libsvm, write_hdf5
+
+    X, y = read_libsvm(args.input, sparse=args.sparse)
+    write_hdf5(args.output, X, y, sparse=args.sparse)
+    print(f"Wrote {args.output}: X {X.shape}, Y {y.shape}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

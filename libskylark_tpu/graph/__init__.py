@@ -1,0 +1,15 @@
+"""Graph analytics (≙ reference ``ml/graph/``): adjacency spectral
+embedding and seed-set local community detection."""
+
+from .ase import ASEParams, approximate_ase
+from .community import find_local_cluster, time_dependent_ppr
+from .graph import SimpleGraph, read_arc_list
+
+__all__ = [
+    "SimpleGraph",
+    "read_arc_list",
+    "ASEParams",
+    "approximate_ase",
+    "time_dependent_ppr",
+    "find_local_cluster",
+]

@@ -1,0 +1,47 @@
+"""Approximate adjacency spectral embedding (ASE).
+
+≙ ``ApproximateASE`` (``ml/graph/spectral_embedding.hpp:19-94``, Lyzinski
+et al): randomized symmetric SVD of the adjacency matrix, embeddings
+``X = V·diag(√|λ|)``.  The SVD is the TPU-heavy part and reuses
+``approximate_symmetric_svd`` (sharded subspace iteration).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..core.context import SketchContext
+from ..linalg.svd import SVDParams, approximate_symmetric_svd
+from .graph import SimpleGraph
+
+__all__ = ["ASEParams", "approximate_ase"]
+
+
+@dataclass
+class ASEParams(SVDParams):
+    """≙ ``approximate_ase_params_t`` (inherits the SVD oversampling/
+    iteration knobs)."""
+
+    sparse: bool = False  # use BCOO adjacency
+
+
+def approximate_ase(
+    G,
+    k: int,
+    context: SketchContext,
+    params: ASEParams | None = None,
+):
+    """Returns (X, lam): X (n, k) embeddings, lam the eigenvalues.
+
+    ``G`` may be a ``SimpleGraph`` or an (n, n) adjacency array/BCOO.
+    """
+    params = params or ASEParams()
+    if isinstance(G, SimpleGraph):
+        A = G.adjacency_bcoo() if params.sparse else jnp.asarray(G.adjacency())
+    else:
+        A = G
+    V, lam = approximate_symmetric_svd(A, k, context, params)
+    X = V * jnp.sqrt(jnp.abs(lam))[None, :]
+    return X, lam

@@ -1,0 +1,95 @@
+"""Simple undirected graph container built from an arc list.
+
+≙ ``simple_unweighted_graph_t`` (``ml/skylark_graph_se.cpp``) and the
+arc-list reader (``utility/io``): text lines ``u v`` (comments ``#``/``%``),
+symmetrized, self-loops dropped, duplicate edges collapsed.  Vertex names
+may be arbitrary hashables; ``index`` maps name → contiguous id.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["SimpleGraph", "read_arc_list"]
+
+
+class SimpleGraph:
+    def __init__(self, edges):
+        """edges: iterable of (u, v) pairs (strings or ints)."""
+        names = {}
+        pairs = set()
+        for u, v in edges:
+            if u == v:
+                continue
+            for w in (u, v):
+                if w not in names:
+                    names[w] = len(names)
+            a, b = names[u], names[v]
+            pairs.add((min(a, b), max(a, b)))
+        self.vertices = list(names)
+        self.index = names
+        n = len(names)
+        rows = np.empty(2 * len(pairs), dtype=np.int64)
+        cols = np.empty(2 * len(pairs), dtype=np.int64)
+        for i, (a, b) in enumerate(pairs):
+            rows[2 * i], cols[2 * i] = a, b
+            rows[2 * i + 1], cols[2 * i + 1] = b, a
+        order = np.lexsort((cols, rows))
+        rows, cols = rows[order], cols[order]
+        self.indptr = np.zeros(n + 1, dtype=np.int64)
+        np.add.at(self.indptr, rows + 1, 1)
+        self.indptr = np.cumsum(self.indptr)
+        self.indices = cols
+        self.n = n
+
+    # -- accessors (≙ the GraphType concept used by the algorithms) ---------
+
+    def degree(self, i: int) -> int:
+        return int(self.indptr[i + 1] - self.indptr[i])
+
+    @property
+    def degrees(self) -> np.ndarray:
+        return np.diff(self.indptr)
+
+    def neighbors(self, i: int) -> np.ndarray:
+        return self.indices[self.indptr[i] : self.indptr[i + 1]]
+
+    @property
+    def volume(self) -> int:
+        """Total volume Σ deg = 2·|E| (≙ ``G.num_edges()`` as used in the
+        conductance denominator)."""
+        return int(self.indices.size)
+
+    def adjacency(self, dtype=np.float64):
+        """Dense (n, n) adjacency (for moderate graphs / ASE input)."""
+        A = np.zeros((self.n, self.n), dtype=dtype)
+        A[np.repeat(np.arange(self.n), self.degrees), self.indices] = 1.0
+        return A
+
+    def adjacency_bcoo(self, dtype=None):
+        """Sparse BCOO adjacency."""
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+
+        dtype = dtype or jnp.asarray(0.0).dtype
+        rows = np.repeat(np.arange(self.n), self.degrees)
+        idx = np.stack([rows, self.indices], axis=1).astype(np.int32)
+        data = np.ones(self.indices.size)
+        return jsparse.BCOO(
+            (jnp.asarray(data, dtype), jnp.asarray(idx)),
+            shape=(self.n, self.n),
+        )
+
+
+def read_arc_list(path) -> SimpleGraph:
+    edges = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line[0] in "#%":
+                continue
+            parts = line.split()
+            if len(parts) < 2:
+                continue
+            edges.append((parts[0], parts[1]))
+    return SimpleGraph(edges)

@@ -1,0 +1,78 @@
+"""HDF5 dataset IO (≙ ``ml/io.hpp`` ReadHDF5/WriteHDF5 paths).
+
+Layout matches the reference's skylark_ml HDF5 format: dense data in
+datasets ``X`` (n × d) and ``Y`` (n,); sparse data in CSR-style datasets
+``dimensions``/``indptr``/``indices``/``values`` + ``Y``
+(``ml/io.hpp:256-520``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["read_hdf5", "write_hdf5"]
+
+
+def write_hdf5(path, X, y, sparse: bool = False) -> None:
+    import h5py
+
+    with h5py.File(path, "w") as f:
+        y = np.asarray(y)
+        if sparse or hasattr(X, "todense"):
+            if hasattr(X, "todense"):  # BCOO
+                idx = np.asarray(X.indices)
+                data = np.asarray(X.data)
+                n, d = X.shape
+                order = np.lexsort((idx[:, 1], idx[:, 0]))
+                rows, cols = idx[order, 0], idx[order, 1]
+                vals = data[order]
+            else:
+                Xd = np.asarray(X)
+                rows, cols = np.nonzero(Xd)
+                vals = Xd[rows, cols]
+                n, d = Xd.shape
+            indptr = np.zeros(n + 1, dtype=np.int64)
+            np.add.at(indptr, rows + 1, 1)
+            indptr = np.cumsum(indptr)
+            # Reference order: [num_features, num_examples, nnz]
+            # (ml/io.hpp writes dimensions[0]=height=d, [1]=width=n; indptr
+            # runs over examples in both layouts).
+            f.create_dataset("dimensions", data=np.asarray([d, n, len(vals)]))
+            f.create_dataset("indptr", data=indptr)
+            f.create_dataset("indices", data=cols.astype(np.int64))
+            f.create_dataset("values", data=vals)
+        else:
+            f.create_dataset("X", data=np.asarray(X))
+        f.create_dataset("Y", data=y)
+
+
+def read_hdf5(path, sparse: bool | None = None):
+    """Returns (X, y); X is BCOO if the file holds sparse data (or
+    ``sparse=True`` forces conversion of dense data)."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        y = np.asarray(f["Y"])
+        if "X" in f:
+            X = np.asarray(f["X"])
+            if sparse:
+                import jax.numpy as jnp
+                from jax.experimental import sparse as jsparse
+
+                return jsparse.BCOO.fromdense(jnp.asarray(X)), y
+            return X, y
+        d, n, nnz = (int(v) for v in f["dimensions"][:])
+        indptr = np.asarray(f["indptr"])
+        indices = np.asarray(f["indices"])
+        values = np.asarray(f["values"])
+    rows = np.repeat(np.arange(n), np.diff(indptr))
+    if sparse is False:
+        X = np.zeros((n, d), dtype=values.dtype)
+        X[rows, indices] = values
+        return X, y
+    import jax.numpy as jnp
+    from jax.experimental import sparse as jsparse
+
+    idx = np.stack([rows, indices], axis=1).astype(np.int32)
+    X = jsparse.BCOO((jnp.asarray(values), jnp.asarray(idx)), shape=(n, d))
+    return X, y

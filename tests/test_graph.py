@@ -1,0 +1,187 @@
+"""Graph analytics tests: ASE recovers block structure; community
+detection finds planted communities; spectral utilities match reference
+formulas; HDF5/arc-list IO round-trips."""
+
+import numpy as np
+import pytest
+
+from libskylark_tpu import SketchContext
+from libskylark_tpu.graph import (
+    ASEParams,
+    SimpleGraph,
+    approximate_ase,
+    find_local_cluster,
+    read_arc_list,
+    time_dependent_ppr,
+)
+from libskylark_tpu.io import read_hdf5, write_hdf5
+from libskylark_tpu.linalg.spectral import chebyshev_diff_matrix, chebyshev_points
+
+
+def two_community_graph(rng, n_per=30, p_in=0.5, p_out=0.02):
+    n = 2 * n_per
+    edges = []
+    for i in range(n):
+        for j in range(i + 1, n):
+            same = (i < n_per) == (j < n_per)
+            if rng.random() < (p_in if same else p_out):
+                edges.append((i, j))
+    return SimpleGraph(edges), n_per
+
+
+class TestSpectralUtils:
+    def test_chebyshev_points_range(self):
+        x = chebyshev_points(9, 0.0, 5.0)
+        assert x[0] == 5.0 and x[-1] == 0.0
+        assert x[4] == 2.5  # midpoint exact
+        assert np.all(np.diff(x) < 0)
+
+    def test_diff_matrix_differentiates_polynomials(self):
+        N = 12
+        D, x = chebyshev_diff_matrix(N, 0.0, 2.0)
+        p = x**3 - 2 * x
+        dp = 3 * x**2 - 2
+        np.testing.assert_allclose(D @ p, dp, rtol=1e-8, atol=1e-8)
+
+    def test_diff_matrix_standard_interval(self):
+        D, x = chebyshev_diff_matrix(8)
+        p = np.exp(x)
+        np.testing.assert_allclose(D @ p, p, rtol=1e-3)
+
+
+class TestSimpleGraph:
+    def test_build_and_accessors(self):
+        G = SimpleGraph([("a", "b"), ("b", "c"), ("a", "b"), ("c", "c")])
+        assert G.n == 3
+        assert G.volume == 4  # 2 edges * 2
+        b = G.index["b"]
+        assert G.degree(b) == 2
+
+    def test_arc_list_io(self, tmp_path):
+        (tmp_path / "g").write_text("# comment\n1 2\n2 3\n3 1\n")
+        G = read_arc_list(tmp_path / "g")
+        assert G.n == 3 and G.volume == 6
+
+    def test_adjacency_forms_match(self, rng):
+        G, _ = two_community_graph(rng, 10)
+        Ad = G.adjacency()
+        Ab = np.asarray(G.adjacency_bcoo().todense())
+        np.testing.assert_array_equal(Ad, Ab)
+        np.testing.assert_array_equal(Ad, Ad.T)
+
+
+class TestASE:
+    def test_recovers_two_blocks(self, rng):
+        G, n_per = two_community_graph(rng, 30, p_in=0.7, p_out=0.02)
+        X, lam = approximate_ase(
+            G, 2, SketchContext(seed=1), ASEParams(num_iterations=3)
+        )
+        X = np.asarray(X)
+        # 2-means on the embedding should separate the blocks: use the
+        # sign of the dim best correlated with membership.
+        labels = np.array([0] * n_per + [1] * n_per)
+        # vertices are insertion-ordered ints 0..n-1
+        order = np.argsort([G.index[i] for i in sorted(G.index)])
+        sep = 0
+        for dim in range(2):
+            pred = (X[:, dim] > np.median(X[:, dim])).astype(int)
+            acc = max((pred == labels).mean(), (pred != labels).mean())
+            sep = max(sep, acc)
+        assert sep > 0.9
+
+    def test_sparse_adjacency_path(self, rng):
+        G, _ = two_community_graph(rng, 15)
+        Xd, _ = approximate_ase(G, 2, SketchContext(seed=2))
+        Xs, _ = approximate_ase(
+            G, 2, SketchContext(seed=2), ASEParams(sparse=True)
+        )
+        np.testing.assert_allclose(
+            np.abs(np.asarray(Xd)), np.abs(np.asarray(Xs)), rtol=1e-4, atol=1e-6
+        )
+
+
+class TestCommunity:
+    def test_ppr_mass_concentrates_near_seed(self, rng):
+        G, n_per = two_community_graph(rng, 25)
+        times, Y = time_dependent_ppr(G, {0: 1.0})
+        assert Y.shape[0] == 4
+        in_mass = Y[:, :n_per].sum(axis=1)
+        out_mass = Y[:, n_per:].sum(axis=1)
+        assert np.all(in_mass > out_mass)
+
+    def test_finds_planted_community(self, rng):
+        G, n_per = two_community_graph(rng, 25)
+        cluster, cond = find_local_cluster(G, [0, 1])
+        inside = sum(1 for v in cluster if v < n_per)
+        assert inside / max(len(cluster), 1) > 0.8
+        assert cond < 0.5
+
+    def test_recursive_no_worse(self, rng):
+        G, n_per = two_community_graph(rng, 20)
+        _, c1 = find_local_cluster(G, [0])
+        _, c2 = find_local_cluster(G, [0], recursive=True)
+        assert c2 <= c1 + 1e-12
+
+
+class TestHDF5:
+    def test_dense_roundtrip(self, tmp_path, rng):
+        X = rng.standard_normal((20, 6))
+        y = rng.standard_normal(20)
+        write_hdf5(tmp_path / "d.h5", X, y)
+        X2, y2 = read_hdf5(tmp_path / "d.h5")
+        np.testing.assert_allclose(X2, X)
+        np.testing.assert_allclose(y2, y)
+
+    def test_sparse_roundtrip(self, tmp_path, rng):
+        X = rng.standard_normal((15, 8))
+        X[rng.random((15, 8)) < 0.6] = 0
+        y = rng.integers(0, 2, 15).astype(float)
+        write_hdf5(tmp_path / "s.h5", X, y, sparse=True)
+        Xs, y2 = read_hdf5(tmp_path / "s.h5")
+        np.testing.assert_allclose(np.asarray(Xs.todense()), X)
+        Xd, _ = read_hdf5(tmp_path / "s.h5", sparse=False)
+        np.testing.assert_allclose(Xd, X)
+
+
+class TestGraphCLIs:
+    def test_graph_se_cli(self, tmp_path, rng, monkeypatch, capsys):
+        from libskylark_tpu.cli.graph_se import main
+
+        G, _ = two_community_graph(rng, 15)
+        lines = []
+        for i in range(G.n):
+            for j in G.neighbors(i):
+                if i < j:
+                    lines.append(f"{i} {j}")
+        (tmp_path / "g").write_text("\n".join(lines) + "\n")
+        monkeypatch.chdir(tmp_path)
+        rc = main([str(tmp_path / "g"), "-k", "2", "--prefix", "emb"])
+        assert rc == 0
+        X = np.load(tmp_path / "emb.X.npy")
+        assert X.shape[1] == 2
+
+    def test_community_cli(self, tmp_path, rng, capsys):
+        from libskylark_tpu.cli.community import main
+
+        G, _ = two_community_graph(rng, 15)
+        lines = []
+        for i in range(G.n):
+            for j in G.neighbors(i):
+                if i < j:
+                    lines.append(f"{i} {j}")
+        (tmp_path / "g").write_text("\n".join(lines) + "\n")
+        rc = main([str(tmp_path / "g"), "--seed", "0"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Conductance:" in out and "Cluster:" in out
+
+    def test_convert2hdf5_cli(self, tmp_path, rng):
+        from libskylark_tpu.cli.convert2hdf5 import main
+        from libskylark_tpu.io import write_libsvm
+
+        X = rng.standard_normal((10, 4))
+        write_libsvm(tmp_path / "f", X, np.ones(10))
+        rc = main([str(tmp_path / "f"), str(tmp_path / "f.h5")])
+        assert rc == 0
+        X2, y2 = read_hdf5(tmp_path / "f.h5")
+        np.testing.assert_allclose(X2, X, rtol=1e-15)
