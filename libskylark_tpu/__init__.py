@@ -10,7 +10,7 @@ collectives instead of MPI.
 
 __version__ = "0.1.0"
 
-from . import core, graph, io, linalg, ml, parallel, sketch, solvers
+from . import core, graph, io, linalg, ml, parallel, sketch, solvers, utils
 from .core import SketchContext
 
 __all__ = [
@@ -22,6 +22,7 @@ __all__ = [
     "parallel",
     "sketch",
     "solvers",
+    "utils",
     "SketchContext",
     "__version__",
 ]

@@ -164,6 +164,7 @@ def _uniform(hi, lo, dtype, low=0.0, high=1.0):
 
 def _normal(hi, lo, dtype):
     # Inverse-CDF sampling; exact distribution, one counter per sample.
+    dtype = jnp.dtype(dtype)
     u = _uniform01(hi, lo, jnp.float64 if dtype == jnp.float64 else jnp.float32)
     return jax.scipy.special.ndtri(u).astype(dtype)
 

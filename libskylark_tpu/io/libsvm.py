@@ -29,35 +29,57 @@ def read_libsvm(
     dense ndarray.  ``n_features`` pads/clips the feature dimension (the
     reference's ``min_d`` flag, ``ml/io.hpp:534``).  Indices are 1-based in
     the file (LIBSVM standard, matching the reference reader).
+
+    Parsing uses the native multithreaded C++ parser when built
+    (``libskylark_tpu.native``, ≙ the reference's native chunked reader);
+    otherwise the pure-Python path below.
     """
-    labels: list[float] = []
-    rows: list[int] = []
-    cols: list[int] = []
-    vals: list[float] = []
-    max_col = 0
-    with open(path, "r") as f:
-        for line in f:
-            line = line.split("#", 1)[0].strip()
-            if not line:
-                continue
-            parts = line.split()
-            labels.append(float(parts[0]))
-            r = len(labels) - 1
-            for tok in parts[1:]:
-                idx, val = tok.split(":", 1)
-                c = int(idx) - 1
-                if c < 0:
-                    raise ValueError(f"bad LIBSVM index {idx!r} (1-based)")
-                max_col = max(max_col, c + 1)
-                rows.append(r)
-                cols.append(c)
-                vals.append(float(val))
-    n = len(labels)
-    d = n_features if n_features is not None else max_col
-    y = np.asarray(labels, dtype=dtype)
-    rows_a = np.asarray(rows, dtype=np.int64)
-    cols_a = np.asarray(cols, dtype=np.int64)
-    vals_a = np.asarray(vals, dtype=dtype)
+    from .. import native
+
+    parsed = None
+    if native.available():
+        with open(path, "rb") as f:
+            data = f.read()
+        try:
+            parsed = native.parse_libsvm_bytes(data)
+        except Exception:
+            parsed = None  # malformed for the fast path; strict parser below
+    if parsed is not None:
+        y_all, rows_a, cols_a, vals_a = parsed[:4]
+        n = len(y_all)
+        max_col = int(cols_a.max()) + 1 if len(cols_a) else 0
+        d = n_features if n_features is not None else max_col
+        y = y_all.astype(dtype)
+        vals_a = vals_a.astype(dtype)
+    else:
+        labels: list[float] = []
+        rows: list[int] = []
+        cols: list[int] = []
+        vals: list[float] = []
+        max_col = 0
+        with open(path, "r") as f:
+            for line in f:
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                parts = line.split()
+                labels.append(float(parts[0]))
+                r = len(labels) - 1
+                for tok in parts[1:]:
+                    idx, val = tok.split(":", 1)
+                    c = int(idx) - 1
+                    if c < 0:
+                        raise ValueError(f"bad LIBSVM index {idx!r} (1-based)")
+                    max_col = max(max_col, c + 1)
+                    rows.append(r)
+                    cols.append(c)
+                    vals.append(float(val))
+        n = len(labels)
+        d = n_features if n_features is not None else max_col
+        y = np.asarray(labels, dtype=dtype)
+        rows_a = np.asarray(rows, dtype=np.int64)
+        cols_a = np.asarray(cols, dtype=np.int64)
+        vals_a = np.asarray(vals, dtype=dtype)
     keep = cols_a < d
     rows_a, cols_a, vals_a = rows_a[keep], cols_a[keep], vals_a[keep]
     if sparse:

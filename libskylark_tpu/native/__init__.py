@@ -1,0 +1,187 @@
+"""Native C core loader (≙ the reference's ``capi/`` shared library).
+
+Builds ``libskylark_native.so`` from ``src/skylark_native.cpp`` on first
+use (g++, cached by mtime) and exposes it through ctypes.  Everything
+degrades gracefully: ``available()`` is False when no compiler exists and
+all Python paths fall back to pure JAX/numpy.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+__all__ = ["available", "lib", "parse_libsvm_bytes", "NativeSketch", "NativeContext"]
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "src", "skylark_native.cpp")
+_SO = os.path.join(_DIR, "libskylark_native.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        if os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+            return True
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-fopenmp", "-std=c++17",
+            _SRC, "-o", _SO,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def lib():
+    """The loaded CDLL, or None if unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        L = ctypes.CDLL(_SO)
+        L.sl_create_context.restype = ctypes.c_void_p
+        L.sl_create_context.argtypes = [ctypes.c_uint64]
+        L.sl_free_context.argtypes = [ctypes.c_void_p]
+        L.sl_context_counter.restype = ctypes.c_uint64
+        L.sl_context_counter.argtypes = [ctypes.c_void_p]
+        L.sl_create_sketch_transform.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_long,
+            ctypes.c_double, ctypes.POINTER(ctypes.c_void_p),
+        ]
+        L.sl_free_sketch_transform.argtypes = [ctypes.c_void_p]
+        L.sl_apply_sketch_transform.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            ctypes.c_long, ctypes.c_long, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ]
+        L.sl_serialize_sketch_transform.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p)
+        ]
+        L.sl_deserialize_sketch_transform.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)
+        ]
+        L.sl_free_str.argtypes = [ctypes.c_char_p]
+        L.sl_error_string.restype = ctypes.c_char_p
+        L.sl_error_string.argtypes = [ctypes.c_int]
+        L.sl_sample.argtypes = [
+            ctypes.c_uint64, ctypes.c_uint64, ctypes.c_long, ctypes.c_int,
+            ctypes.c_uint32,
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ]
+        L.sl_libsvm_count.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long), ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        L.sl_libsvm_parse.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+        ]
+        _lib = L
+        return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def _check(code: int):
+    if code:
+        from ..utils.exceptions import SkylarkError
+
+        msg = lib().sl_error_string(code).decode()
+        raise SkylarkError(f"native error {code}: {msg}")
+
+
+def parse_libsvm_bytes(data: bytes):
+    """(labels, rows, cols, vals, n_features) from LIBSVM text bytes."""
+    L = lib()
+    n_rows = ctypes.c_long()
+    n_nnz = ctypes.c_long()
+    max_col = ctypes.c_long()
+    _check(L.sl_libsvm_count(data, len(data), ctypes.byref(n_rows),
+                             ctypes.byref(n_nnz), ctypes.byref(max_col)))
+    labels = np.empty(n_rows.value, np.float64)
+    rows = np.empty(n_nnz.value, np.int64)
+    cols = np.empty(n_nnz.value, np.int64)
+    vals = np.empty(n_nnz.value, np.float64)
+    _check(L.sl_libsvm_parse(data, len(data), labels, rows, cols, vals))
+    return labels, rows, cols, vals, int(max_col.value)
+
+
+class NativeContext:
+    """≙ ``sl_create_context`` handle."""
+
+    def __init__(self, seed: int):
+        self._h = lib().sl_create_context(seed)
+
+    @property
+    def counter(self) -> int:
+        return int(lib().sl_context_counter(self._h))
+
+    def __del__(self):
+        if getattr(self, "_h", None) and lib() is not None:
+            lib().sl_free_context(self._h)
+            self._h = None
+
+
+class NativeSketch:
+    """≙ ``sl_create_sketch_transform`` + apply/serialize handles."""
+
+    def __init__(self, handle, n, s):
+        self._h = handle
+        self.n, self.s = n, s
+
+    @classmethod
+    def create(cls, ctx: NativeContext, sketch_type: str, n: int, s: int,
+               param: float = 0.0):
+        out = ctypes.c_void_p()
+        _check(lib().sl_create_sketch_transform(
+            ctx._h, sketch_type.encode(), n, s, param, ctypes.byref(out)))
+        return cls(out, n, s)
+
+    @classmethod
+    def from_json(cls, js: str):
+        out = ctypes.c_void_p()
+        _check(lib().sl_deserialize_sketch_transform(js.encode(), ctypes.byref(out)))
+        import json
+
+        d = json.loads(js)
+        return cls(out, int(d["N"]), int(d["S"]))
+
+    def apply(self, A: np.ndarray, dim: str = "columnwise") -> np.ndarray:
+        A = np.ascontiguousarray(A, np.float64)
+        cw = dim == "columnwise"
+        if cw:
+            out = np.empty((self.s, A.shape[1]), np.float64)
+        else:
+            out = np.empty((A.shape[0], self.s), np.float64)
+        _check(lib().sl_apply_sketch_transform(
+            self._h, A, A.shape[0], A.shape[1], 0 if cw else 1, out))
+        return out
+
+    def to_json(self) -> str:
+        out = ctypes.c_char_p()
+        _check(lib().sl_serialize_sketch_transform(self._h, ctypes.byref(out)))
+        s = out.value.decode()
+        lib().sl_free_str(out)
+        return s
+
+    def __del__(self):
+        if getattr(self, "_h", None) and lib() is not None:
+            lib().sl_free_sketch_transform(self._h)
+            self._h = None

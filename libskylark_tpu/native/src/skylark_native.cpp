@@ -1,0 +1,653 @@
+// Native C core: counter-based RNG, local sketch applies, C API, LIBSVM
+// parser.
+//
+// TPU-native framework's counterpart of the reference's C API layer
+// (capi/sketchc.hpp:21-54, capi/basec.hpp:36-58) and chunked LIBSVM
+// reader (utility/io/libsvm_io.hpp:529+).  The compute path of the
+// framework is JAX/XLA; this library provides (a) a standalone C entry
+// point for host applications (the reference's capi is the same bridge),
+// and (b) a fast multithreaded parser feeding the Python IO layer.
+//
+// RNG compatibility contract: Threefry-2x32 with the same key schedule and
+// counter layout as libskylark_tpu.core.random (sample i of a stream is a
+// pure function of (seed, lane, base+i)); integer-derived draws
+// (rademacher, uniform_int, uniform bits) are BIT-identical to the JAX
+// path, transcendental ones (normal via Cephes ndtri, cauchy, exp) match
+// to ~1 ulp in float64.
+//
+// Build: g++ -O3 -shared -fPIC -fopenmp (see ../build.py).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <string>
+#include <vector>
+#include <algorithm>
+#include <thread>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Threefry-2x32 (matches jax.extend.random.threefry_2x32)
+// ---------------------------------------------------------------------------
+
+static inline uint32_t rotl32(uint32_t x, int r) {
+    return (x << r) | (x >> (32 - r));
+}
+
+static void threefry2x32(uint32_t k0, uint32_t k1, uint32_t c0, uint32_t c1,
+                         uint32_t* o0, uint32_t* o1) {
+    static const int rot[8] = {13, 15, 26, 6, 17, 29, 16, 24};
+    uint32_t ks2 = k0 ^ k1 ^ 0x1BD11BDAu;
+    uint32_t x0 = c0 + k0, x1 = c1 + k1;
+
+#define SK_ROUND4(a, b, c, d)                                                 \
+    x0 += x1; x1 = rotl32(x1, a); x1 ^= x0;                                   \
+    x0 += x1; x1 = rotl32(x1, b); x1 ^= x0;                                   \
+    x0 += x1; x1 = rotl32(x1, c); x1 ^= x0;                                   \
+    x0 += x1; x1 = rotl32(x1, d); x1 ^= x0;
+
+    SK_ROUND4(rot[0], rot[1], rot[2], rot[3]);
+    x0 += k1; x1 += ks2 + 1u;
+    SK_ROUND4(rot[4], rot[5], rot[6], rot[7]);
+    x0 += ks2; x1 += k0 + 2u;
+    SK_ROUND4(rot[0], rot[1], rot[2], rot[3]);
+    x0 += k0; x1 += k1 + 3u;
+    SK_ROUND4(rot[4], rot[5], rot[6], rot[7]);
+    x0 += k1; x1 += ks2 + 4u;
+    SK_ROUND4(rot[0], rot[1], rot[2], rot[3]);
+    x0 += ks2; x1 += k0 + 5u;
+#undef SK_ROUND4
+
+    *o0 = x0;
+    *o1 = x1;
+}
+
+static const uint32_t SK_GOLDEN = 0x9E3779B9u;
+
+// 64 random bits for counter `ctr` under (seed, lane).
+static inline void sk_bits(uint64_t seed, uint32_t lane, uint64_t ctr,
+                           uint32_t* hi, uint32_t* lo) {
+    uint32_t k0 = (uint32_t)(seed & 0xFFFFFFFFu);
+    uint32_t k1 = (uint32_t)((seed >> 32) ^ (uint64_t)(lane * SK_GOLDEN));
+    threefry2x32(k0, k1, (uint32_t)(ctr >> 32), (uint32_t)(ctr & 0xFFFFFFFFu),
+                 hi, lo);
+}
+
+// ---------------------------------------------------------------------------
+// bits -> distributions (matching core/random.py)
+// ---------------------------------------------------------------------------
+
+static inline double sk_uniform01(uint32_t hi, uint32_t lo) {
+    uint64_t top = (uint64_t)(hi >> 7);   // 25 bits
+    uint64_t bot = (uint64_t)(lo >> 5);   // 27 bits
+    uint64_t k = (top << 27) | bot;       // 52 bits
+    return ((double)k + 0.5) * 0x1p-52;
+}
+
+static inline float sk_uniform01_f32(uint32_t lo) {
+    uint32_t k = lo >> 8;  // 24 bits
+    return ((float)k + 0.5f) * 0x1p-24f;
+}
+
+// Cephes ndtri (inverse normal CDF) — same algorithm jax.scipy.special
+// uses, so float64 values agree to ~1 ulp.
+static double sk_ndtri(double y0) {
+    static const double P0[5] = {
+        -5.99633501014107895267e1, 9.80010754185999661536e1,
+        -5.66762857469070293439e1, 1.39312609387279679503e1,
+        -1.23916583867381258016e0};
+    static const double Q0[8] = {
+        1.95448858338141759834e0, 4.67627912898881538453e0,
+        8.63602421390890590575e1, -2.25462687854119370527e2,
+        2.00260212380060660359e2, -8.20372256168538034e1,
+        1.59056225126211695515e1, -1.18331621121330003142e0};
+    static const double P1[9] = {
+        4.05544892305962419923e0, 3.15251094599893866154e1,
+        5.71628192246421288162e1, 4.408050738932008347e1,
+        1.46849561928858024014e1, 2.18663306850790267539e0,
+        -1.40256079171354495875e-1, -3.50424626827848203418e-2,
+        -8.57456785154685413611e-4};
+    static const double Q1[8] = {
+        1.57799883256466749731e1, 4.53907635128879210584e1,
+        4.13172038254672030440e1, 1.50425385692907503408e1,
+        2.50464946208309415979e0, -1.42182922854787788574e-1,
+        -3.80806407691578277194e-2, -9.33259480895457427372e-4};
+    static const double P2[9] = {
+        3.23774891776946035970e0, 6.91522889068984211695e0,
+        3.93881025292474443415e0, 1.33303460815807542389e0,
+        2.01485389549179081538e-1, 1.23716634817820021358e-2,
+        3.01581553508235416007e-4, 2.65806974686737550832e-6,
+        6.23974539184983651783e-9};
+    static const double Q2[8] = {
+        6.02427039364742014255e0, 3.67983563856160859403e0,
+        1.37702099489081330271e0, 2.16236993594496635890e-1,
+        1.34204006088543189037e-2, 3.28014464682127739104e-4,
+        2.89247864745380683936e-6, 6.79019408009981274425e-9};
+
+    const double s2pi = 2.50662827463100050242;
+    if (y0 <= 0.0) return -INFINITY;
+    if (y0 >= 1.0) return INFINITY;
+    int code = 1;
+    double y = y0;
+    if (y > 1.0 - 0.13533528323661269189) {  // 1 - exp(-2)
+        y = 1.0 - y;
+        code = 0;
+    }
+    if (y > 0.13533528323661269189) {
+        y = y - 0.5;
+        double y2 = y * y;
+        double num = P0[0], den = 1.0;
+        for (int i = 1; i < 5; i++) num = num * y2 + P0[i];
+        for (int i = 0; i < 8; i++) den = den * y2 + Q0[i];
+        double x = y + y * (y2 * num / den);
+        return x * s2pi;
+    }
+    double x = std::sqrt(-2.0 * std::log(y));
+    double x0 = x - std::log(x) / x;
+    double z = 1.0 / x;
+    double x1;
+    if (x < 8.0) {
+        double num = P1[0], den = 1.0;
+        for (int i = 1; i < 9; i++) num = num * z + P1[i];
+        for (int i = 0; i < 8; i++) den = den * z + Q1[i];
+        x1 = z * num / den;
+    } else {
+        double num = P2[0], den = 1.0;
+        for (int i = 1; i < 9; i++) num = num * z + P2[i];
+        for (int i = 0; i < 8; i++) den = den * z + Q2[i];
+        x1 = z * num / den;
+    }
+    x = x0 - x1;
+    if (code) x = -x;
+    return x;
+}
+
+static inline uint32_t sk_uniform_int(uint32_t hi, uint32_t lo, uint32_t lo_b,
+                                      uint32_t hi_b) {
+    uint64_t span = (uint64_t)(hi_b - lo_b) + 1;
+    uint64_t p1 = (uint64_t)hi * span;
+    uint64_t p2 = (uint64_t)lo * span;
+    uint64_t s = p1 + (p2 >> 32);
+    return lo_b + (uint32_t)(s >> 32);
+}
+
+// dist codes
+enum { SK_DIST_NORMAL = 0, SK_DIST_CAUCHY = 1, SK_DIST_RADEMACHER = 2,
+       SK_DIST_EXP = 3, SK_DIST_UNIFORM = 4 };
+
+static inline double sk_draw(int dist, uint32_t hi, uint32_t lo) {
+    switch (dist) {
+        case SK_DIST_NORMAL: return sk_ndtri(sk_uniform01(hi, lo));
+        case SK_DIST_CAUCHY: return std::tan(M_PI * (sk_uniform01(hi, lo) - 0.5));
+        case SK_DIST_RADEMACHER: return (lo & 1u) ? 1.0 : -1.0;
+        case SK_DIST_EXP: return -std::log(sk_uniform01(hi, lo));
+        default: return sk_uniform01(hi, lo);
+    }
+}
+
+int sl_sample(uint64_t seed, uint64_t base, long num, int dist, uint32_t lane,
+              double* out) {
+#pragma omp parallel for schedule(static)
+    for (long i = 0; i < num; i++) {
+        uint32_t hi, lo;
+        sk_bits(seed, lane, base + (uint64_t)i, &hi, &lo);
+        out[i] = sk_draw(dist, hi, lo);
+    }
+    return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Context + sketch transforms (C API ≙ capi/sketchc.hpp)
+// ---------------------------------------------------------------------------
+
+struct sl_context_t {
+    uint64_t seed;
+    uint64_t counter;
+};
+
+enum sl_type_t { SL_JLT = 0, SL_CT = 1, SL_CWT = 2, SL_MMT = 3, SL_WZT = 4,
+                 SL_UST = 5 };
+
+struct sl_sketch_t {
+    int type;
+    long n, s;
+    uint64_t seed;
+    uint64_t ctx_counter;  // creation-time counter (serialization)
+    // reserved counter bases
+    uint64_t base0, base1, base2;
+    double param;  // CT: C, WZT: p, UST: replace (1/0)
+};
+
+void* sl_create_context(uint64_t seed) {
+    sl_context_t* c = new sl_context_t{seed, 0};
+    return c;
+}
+
+void sl_free_context(void* ctx) { delete (sl_context_t*)ctx; }
+
+uint64_t sl_context_counter(void* ctx) {
+    return ((sl_context_t*)ctx)->counter;
+}
+
+static int sk_type_from_name(const char* name) {
+    if (!strcmp(name, "JLT")) return SL_JLT;
+    if (!strcmp(name, "CT")) return SL_CT;
+    if (!strcmp(name, "CWT")) return SL_CWT;
+    if (!strcmp(name, "MMT")) return SL_MMT;
+    if (!strcmp(name, "WZT")) return SL_WZT;
+    if (!strcmp(name, "UST")) return SL_UST;
+    return -1;
+}
+
+static const char* sk_name_from_type(int t) {
+    static const char* names[6] = {"JLT", "CT", "CWT", "MMT", "WZT", "UST"};
+    return (t >= 0 && t < 6) ? names[t] : "?";
+}
+
+// Reservation schedule mirrors the Python classes exactly.
+static void sk_reserve(sl_sketch_t* t, sl_context_t* ctx) {
+    switch (t->type) {
+        case SL_JLT:
+        case SL_CT:
+            t->base0 = ctx->counter;
+            ctx->counter += (uint64_t)t->n * t->s;
+            break;
+        case SL_CWT:
+        case SL_MMT:
+            t->base0 = ctx->counter; ctx->counter += t->n;  // idx
+            t->base1 = ctx->counter; ctx->counter += t->n;  // val
+            break;
+        case SL_WZT:
+            t->base0 = ctx->counter; ctx->counter += t->n;
+            t->base1 = ctx->counter; ctx->counter += t->n;
+            t->base2 = ctx->counter; ctx->counter += t->n;  // rademacher
+            break;
+        case SL_UST:
+            t->base0 = ctx->counter;
+            ctx->counter += (t->param != 0.0) ? t->s : t->n;
+            break;
+    }
+}
+
+int sl_create_sketch_transform(void* ctx_, const char* type, long n, long s,
+                               double param, void** out) {
+    int ty = sk_type_from_name(type);
+    if (ty < 0) return 103;  // SketchError
+    sl_context_t* ctx = (sl_context_t*)ctx_;
+    sl_sketch_t* t = new sl_sketch_t();
+    t->type = ty;
+    t->n = n;
+    t->s = s;
+    t->seed = ctx->seed;
+    t->ctx_counter = ctx->counter;
+    t->param = param;
+    if (ty == SL_UST && param == 0.0 && s > n) { delete t; return 102; }
+    sk_reserve(t, ctx);
+    *out = t;
+    return 0;
+}
+
+void sl_free_sketch_transform(void* t) { delete (sl_sketch_t*)t; }
+
+// Dense columnwise apply: out (s, m) = Omega (s, n) @ A (n, m), row-major.
+static void sk_apply_dense_cw(const sl_sketch_t* t, const double* A, long m,
+                              double* out) {
+    const long n = t->n, s = t->s;
+    const int dist = (t->type == SL_JLT) ? SK_DIST_NORMAL : SK_DIST_CAUCHY;
+    const double scale =
+        (t->type == SL_JLT) ? 1.0 / std::sqrt((double)s) : t->param / (double)s;
+#pragma omp parallel for schedule(static)
+    for (long i = 0; i < s; i++) {
+        double* orow = out + i * m;
+        for (long c = 0; c < m; c++) orow[c] = 0.0;
+        for (long j = 0; j < n; j++) {
+            uint32_t hi, lo;
+            sk_bits(t->seed, 0, t->base0 + (uint64_t)(i * n + j), &hi, &lo);
+            double w = sk_draw(dist, hi, lo) * scale;
+            const double* arow = A + j * m;
+            for (long c = 0; c < m; c++) orow[c] += w * arow[c];
+        }
+    }
+}
+
+static double sk_hash_value(const sl_sketch_t* t, long i) {
+    uint32_t hi, lo;
+    switch (t->type) {
+        case SL_CWT:
+            sk_bits(t->seed, 0, t->base1 + (uint64_t)i, &hi, &lo);
+            return (lo & 1u) ? 1.0 : -1.0;
+        case SL_MMT:
+            sk_bits(t->seed, 0, t->base1 + (uint64_t)i, &hi, &lo);
+            return std::tan(M_PI * (sk_uniform01(hi, lo) - 0.5));
+        case SL_WZT: {
+            sk_bits(t->seed, 0, t->base1 + (uint64_t)i, &hi, &lo);
+            double e = -std::log(sk_uniform01(hi, lo));
+            uint32_t h2, l2;
+            sk_bits(t->seed, 0, t->base2 + (uint64_t)i, &h2, &l2);
+            double pm = (l2 & 1u) ? 1.0 : -1.0;
+            return pm * std::pow(1.0 / e, 1.0 / t->param);
+        }
+    }
+    return 0.0;
+}
+
+static void sk_apply_hash_cw(const sl_sketch_t* t, const double* A, long m,
+                             double* out) {
+    const long n = t->n, s = t->s;
+    std::memset(out, 0, sizeof(double) * s * m);
+    for (long i = 0; i < n; i++) {
+        uint32_t hi, lo;
+        sk_bits(t->seed, 0, t->base0 + (uint64_t)i, &hi, &lo);
+        long b = (long)sk_uniform_int(hi, lo, 0, (uint32_t)(s - 1));
+        double v = sk_hash_value(t, i);
+        const double* arow = A + i * m;
+        double* orow = out + b * m;
+        for (long c = 0; c < m; c++) orow[c] += v * arow[c];
+    }
+}
+
+static void sk_ust_samples(const sl_sketch_t* t, std::vector<long>& idx) {
+    idx.resize(t->s);
+    if (t->param != 0.0) {  // with replacement
+        for (long i = 0; i < t->s; i++) {
+            uint32_t hi, lo;
+            sk_bits(t->seed, 0, t->base0 + (uint64_t)i, &hi, &lo);
+            idx[i] = (long)sk_uniform_int(hi, lo, 0, (uint32_t)(t->n - 1));
+        }
+    } else {  // argsort of n f32 keys, keep first s (matches UST)
+        std::vector<std::pair<float, long>> keys(t->n);
+        for (long i = 0; i < t->n; i++) {
+            uint32_t hi, lo;
+            sk_bits(t->seed, 0, t->base0 + (uint64_t)i, &hi, &lo);
+            keys[i] = {sk_uniform01_f32(lo), i};
+        }
+        std::stable_sort(keys.begin(), keys.end(),
+                         [](const std::pair<float, long>& a,
+                            const std::pair<float, long>& b) {
+                             return a.first < b.first;
+                         });
+        for (long i = 0; i < t->s; i++) idx[i] = keys[i].second;
+    }
+}
+
+static void sk_apply_ust_cw(const sl_sketch_t* t, const double* A, long m,
+                            double* out) {
+    std::vector<long> idx;
+    sk_ust_samples(t, idx);
+    for (long i = 0; i < t->s; i++)
+        std::memcpy(out + i * m, A + idx[i] * m, sizeof(double) * m);
+}
+
+// dim: 0 = columnwise (A (n, m) -> (s, m)), 1 = rowwise (A (m, n) -> (m, s)).
+int sl_apply_sketch_transform(void* t_, const double* A, long rows, long cols,
+                              int dim, double* out) {
+    const sl_sketch_t* t = (sl_sketch_t*)t_;
+    if (dim == 0) {
+        if (rows != t->n) return 102;
+        switch (t->type) {
+            case SL_JLT: case SL_CT: sk_apply_dense_cw(t, A, cols, out); break;
+            case SL_UST: sk_apply_ust_cw(t, A, cols, out); break;
+            default: sk_apply_hash_cw(t, A, cols, out); break;
+        }
+        return 0;
+    }
+    if (cols != t->n) return 102;
+    // rowwise = columnwise on the transpose.
+    std::vector<double> AT((size_t)rows * cols), OT((size_t)t->s * rows);
+    for (long r = 0; r < rows; r++)
+        for (long c = 0; c < cols; c++) AT[(size_t)c * rows + r] = A[(size_t)r * cols + c];
+    int rc = sl_apply_sketch_transform((void*)t, AT.data(), cols, rows, 0,
+                                       OT.data());
+    if (rc) return rc;
+    for (long r = 0; r < rows; r++)
+        for (long i = 0; i < t->s; i++)
+            out[(size_t)r * t->s + i] = OT[(size_t)i * rows + r];
+    return 0;
+}
+
+// JSON schema identical to sketch.base.SketchTransform.to_dict().
+int sl_serialize_sketch_transform(void* t_, char** out) {
+    const sl_sketch_t* t = (sl_sketch_t*)t_;
+    char extra[96] = "";
+    if (t->type == SL_CT)
+        snprintf(extra, sizeof extra, ", \"C\": %.17g", t->param);
+    else if (t->type == SL_WZT)
+        snprintf(extra, sizeof extra, ", \"P\": %.17g", t->param);
+    else if (t->type == SL_UST)
+        snprintf(extra, sizeof extra, ", \"replace\": %s",
+                 t->param != 0.0 ? "true" : "false");
+    char* buf = (char*)malloc(512);
+    snprintf(buf, 512,
+             "{\"skylark_object_type\": \"sketch\", \"skylark_version\": 1, "
+             "\"sketch_type\": \"%s\", \"N\": %ld, \"S\": %ld, "
+             "\"creation_context\": {\"skylark_object_type\": \"context\", "
+             "\"skylark_version\": 1, \"seed\": %llu, \"counter\": %llu}%s}",
+             sk_name_from_type(t->type), t->n, t->s,
+             (unsigned long long)t->seed, (unsigned long long)t->ctx_counter,
+             extra);
+    *out = buf;
+    return 0;
+}
+
+void sl_free_str(char* s) { free(s); }
+
+// Minimal JSON field extraction (flat schema written by ourselves/Python).
+static bool js_find_num(const char* js, const char* key, double* val) {
+    std::string pat = std::string("\"") + key + "\":";
+    const char* p = strstr(js, pat.c_str());
+    if (!p) return false;
+    p += pat.size();
+    *val = strtod(p, nullptr);
+    return true;
+}
+
+static bool js_find_str(const char* js, const char* key, char* out, size_t cap) {
+    std::string pat = std::string("\"") + key + "\":";
+    const char* p = strstr(js, pat.c_str());
+    if (!p) return false;
+    p += pat.size();
+    while (*p == ' ') p++;
+    if (*p != '"') return false;
+    p++;
+    size_t i = 0;
+    while (*p && *p != '"' && i + 1 < cap) out[i++] = *p++;
+    out[i] = 0;
+    return true;
+}
+
+int sl_deserialize_sketch_transform(const char* json, void** out) {
+    // Python json.dumps uses ", " / ": " separators; normalize spaces.
+    std::string norm;
+    norm.reserve(strlen(json));
+    for (const char* p = json; *p; p++)
+        if (*p != ' ' && *p != '\n') norm.push_back(*p);
+    char type[32];
+    double n, s, seed, counter;
+    if (!js_find_str(norm.c_str(), "sketch_type", type, sizeof type) ||
+        !js_find_num(norm.c_str(), "N", &n) ||
+        !js_find_num(norm.c_str(), "S", &s) ||
+        !js_find_num(norm.c_str(), "seed", &seed) ||
+        !js_find_num(norm.c_str(), "counter", &counter))
+        return 103;
+    double param = 0.0;
+    if (!strcmp(type, "CT")) { js_find_num(norm.c_str(), "C", &param); if (param == 0) param = 1.0; }
+    else if (!strcmp(type, "WZT")) { js_find_num(norm.c_str(), "P", &param); if (param == 0) param = 2.0; }
+    else if (!strcmp(type, "UST")) {
+        param = strstr(norm.c_str(), "\"replace\":false") ? 0.0 : 1.0;
+    }
+    sl_context_t ctx{(uint64_t)seed, (uint64_t)counter};
+    return sl_create_sketch_transform(&ctx, type, (long)n, (long)s, param, out);
+}
+
+const char* sl_error_string(int code) {
+    switch (code) {
+        case 0: return "ok";
+        case 100: return "skylark error";
+        case 101: return "allocation error";
+        case 102: return "invalid parameters";
+        case 103: return "sketch error";
+        case 104: return "unsupported";
+        case 105: return "io error";
+    }
+    return "unknown";
+}
+
+// ---------------------------------------------------------------------------
+// LIBSVM parser (multithreaded two-pass; ≙ utility/io/libsvm_io.hpp)
+// ---------------------------------------------------------------------------
+
+struct sk_chunk_stats { long rows, nnz, max_col; };
+
+static void sk_count_chunk(const char* buf, size_t lo, size_t hi,
+                           sk_chunk_stats* st) {
+    long rows = 0, nnz = 0, max_col = 0;
+    for (size_t i = lo; i < hi;) {
+        // one line
+        size_t eol = i;
+        while (eol < hi && buf[eol] != '\n') eol++;
+        // skip blank / comment-only
+        size_t j = i;
+        while (j < eol && (buf[j] == ' ' || buf[j] == '\t')) j++;
+        if (j < eol && buf[j] != '#') {
+            rows++;
+            for (size_t p = j; p < eol; p++) {
+                if (buf[p] == '#') break;
+                if (buf[p] == ':') {
+                    nnz++;
+                    // walk back to read the column index
+                    size_t q = p;
+                    while (q > j && buf[q - 1] >= '0' && buf[q - 1] <= '9') q--;
+                    long col = strtol(buf + q, nullptr, 10);
+                    if (col > max_col) max_col = col;
+                }
+            }
+        }
+        i = eol + 1;
+    }
+    st->rows = rows;
+    st->nnz = nnz;
+    st->max_col = max_col;
+}
+
+int sl_libsvm_count(const char* buf, long len, long* n_rows, long* n_nnz,
+                    long* max_col) {
+    int nt = std::max(1u, std::thread::hardware_concurrency());
+    if (len < 1 << 16) nt = 1;
+    std::vector<size_t> bounds(nt + 1, 0);
+    bounds[nt] = (size_t)len;
+    for (int t = 1; t < nt; t++) {
+        size_t pos = (size_t)len * t / nt;
+        while (pos < (size_t)len && buf[pos] != '\n') pos++;
+        bounds[t] = pos < (size_t)len ? pos + 1 : (size_t)len;
+    }
+    std::vector<sk_chunk_stats> stats(nt);
+    std::vector<std::thread> th;
+    for (int t = 0; t < nt; t++)
+        th.emplace_back(sk_count_chunk, buf, bounds[t], bounds[t + 1],
+                        &stats[t]);
+    for (auto& x : th) x.join();
+    long rows = 0, nnz = 0, mc = 0;
+    for (auto& s : stats) {
+        rows += s.rows;
+        nnz += s.nnz;
+        mc = std::max(mc, s.max_col);
+    }
+    *n_rows = rows;
+    *n_nnz = nnz;
+    *max_col = mc;
+    return 0;
+}
+
+// Parse into preallocated arrays.  Row order is file order; two passes
+// (count per chunk, then fill with per-chunk offsets).
+struct sk_parse_job {
+    const char* buf;
+    size_t lo, hi;
+    long row0, nnz0;
+    double* labels;
+    long* rows;
+    long* cols;
+    double* vals;
+    long expect_nnz;
+    int* status;  // 0 ok, nonzero = malformed chunk
+};
+
+static void sk_parse_chunk(sk_parse_job job) {
+    long r = job.row0, k = job.nnz0;
+    const char* buf = job.buf;
+    int bad = 0;
+    for (size_t i = job.lo; i < job.hi;) {
+        size_t eol = i;
+        while (eol < job.hi && buf[eol] != '\n') eol++;
+        size_t j = i;
+        while (j < eol && (buf[j] == ' ' || buf[j] == '\t')) j++;
+        if (j < eol && buf[j] != '#') {
+            char* end;
+            job.labels[r] = strtod(buf + j, &end);
+            const char* p = end;
+            while (p < buf + eol) {
+                while (p < buf + eol && (*p == ' ' || *p == '\t')) p++;
+                if (p >= buf + eol || *p == '#') break;
+                long col = strtol(p, &end, 10);
+                if (end == p) { bad = 1; break; }  // non-numeric token
+                p = end;
+                if (*p != ':') { bad = 1; break; }
+                if (col < 1) bad = 1;  // 1-based indices only
+                p++;
+                double v = strtod(p, &end);
+                p = end;
+                job.rows[k] = r;
+                job.cols[k] = col - 1;
+                job.vals[k] = v;
+                k++;
+            }
+            r++;
+        }
+        i = eol + 1;
+    }
+    // Any count/parse disagreement (malformed tokens) invalidates the
+    // chunk: the caller falls back to the strict Python parser.
+    if (k - job.nnz0 != job.expect_nnz) bad = 1;
+    *job.status = bad;
+}
+
+int sl_libsvm_parse(const char* buf, long len, double* labels, long* rows,
+                    long* cols, double* vals) {
+    int nt = std::max(1u, std::thread::hardware_concurrency());
+    if (len < 1 << 16) nt = 1;
+    std::vector<size_t> bounds(nt + 1, 0);
+    bounds[nt] = (size_t)len;
+    for (int t = 1; t < nt; t++) {
+        size_t pos = (size_t)len * t / nt;
+        while (pos < (size_t)len && buf[pos] != '\n') pos++;
+        bounds[t] = pos < (size_t)len ? pos + 1 : (size_t)len;
+    }
+    std::vector<sk_chunk_stats> stats(nt);
+    {
+        std::vector<std::thread> th;
+        for (int t = 0; t < nt; t++)
+            th.emplace_back(sk_count_chunk, buf, bounds[t], bounds[t + 1],
+                            &stats[t]);
+        for (auto& x : th) x.join();
+    }
+    std::vector<std::thread> th;
+    std::vector<int> status(nt, 0);
+    long row0 = 0, nnz0 = 0;
+    for (int t = 0; t < nt; t++) {
+        sk_parse_job job{buf,  bounds[t], bounds[t + 1], row0, nnz0,
+                         labels, rows,     cols,          vals,
+                         stats[t].nnz, &status[t]};
+        th.emplace_back(sk_parse_chunk, job);
+        row0 += stats[t].rows;
+        nnz0 += stats[t].nnz;
+    }
+    for (auto& x : th) x.join();
+    for (int t = 0; t < nt; t++)
+        if (status[t]) return 105;  // IO error -> caller falls back
+    return 0;
+}
+
+}  // extern "C"

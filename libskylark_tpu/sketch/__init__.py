@@ -20,7 +20,7 @@ from .dense import CT, JLT, DenseSketch
 from .fjlt import FJLT
 from .frft import FastGaussianRFT, FastMaternRFT, FastRFT
 from .fut import RFUT, dct, next_pow2, wht
-from .hash import CWT, MMT, WZT, HashSketch
+from .hash import CWT, MMT, SJLT, WZT, HashSketch
 from .ppt import PPT
 from .rft import (
     RFT,
@@ -50,6 +50,7 @@ __all__ = [
     "CWT",
     "MMT",
     "WZT",
+    "SJLT",
     "UST",
     "NURST",
     "RFUT",

@@ -31,27 +31,36 @@ from ..core.context import SketchContext
 from ..core.random import sample
 from .base import Dimension, SketchTransform, register_sketch
 
-__all__ = ["HashSketch", "CWT", "MMT", "WZT"]
+__all__ = ["HashSketch", "CWT", "MMT", "WZT", "SJLT"]
 
 
 class HashSketch(SketchTransform):
-    """Base engine: bucket ~ uniform_int(0, S-1), value ~ ``value_dist``."""
+    """Base engine: bucket ~ uniform_int(0, S-1), value ~ ``value_dist``.
+
+    ``nnz`` hash functions per input coordinate generalize the engine from
+    CountSketch (nnz=1) to OSNAP/SJLT (nnz>1): coordinate i contributes at
+    nnz hashed slots.  The counter layout is (nnz·N indices, nnz·N values)
+    — identical to the reference's two reserved blocks for nnz=1
+    (``hash_transform_data.hpp:66-73``).
+    """
 
     value_dist: str = "rademacher"
 
-    def __init__(self, n: int, s: int, context: SketchContext):
+    def __init__(self, n: int, s: int, context: SketchContext, nnz: int = 1):
+        if nnz < 1:
+            raise ValueError(f"hash sketch needs nnz >= 1, got {nnz}")
+        self.nnz = int(nnz)
         super().__init__(n, s, context)
         self._seed = context.seed
-        # ≙ hash_transform_data_t::build: two generate_random_samples_array(N)
-        # calls (idx then value), hash_transform_data.hpp:66-73.
-        self._idx_base = context.reserve(n)
-        self._val_base = context.reserve(n)
+        self._idx_base = context.reserve(self.nnz * n)
+        self._val_base = context.reserve(self.nnz * n)
 
     # -- counter-derived hash arrays ---------------------------------------
 
     def buckets(self, start: int = 0, num: int | None = None):
-        """bucket[i] for i in [start, start+num) — shard-local computable."""
-        num = self.n - start if num is None else num
+        """bucket[i] for i in [start, start+num) of the flat (nnz·N)
+        layout — shard-local computable."""
+        num = self.nnz * self.n - start if num is None else num
         return sample(
             "uniform_int",
             self._seed,
@@ -63,7 +72,7 @@ class HashSketch(SketchTransform):
         )
 
     def values(self, dtype=jnp.float32, start: int = 0, num: int | None = None):
-        num = self.n - start if num is None else num
+        num = self.nnz * self.n - start if num is None else num
         return sample(self.value_dist, self._seed, self._val_base + start, num, dtype=dtype)
 
     # -- apply --------------------------------------------------------------
@@ -86,29 +95,31 @@ class HashSketch(SketchTransform):
 
     def _apply_dense(self, A, dim: Dimension):
         dtype = A.dtype if jnp.issubdtype(A.dtype, jnp.floating) else jnp.float32
-        buckets = self.buckets()
-        values = self.values(dtype)
+        b = self.buckets().reshape(self.nnz, self.n)
+        v = self.values(dtype).reshape(self.nnz, self.n)
         if dim is Dimension.COLUMNWISE:
             if A.shape[0] != self.n:
                 raise ValueError(
                     f"columnwise apply needs A with {self.n} rows, got {A.shape}"
                 )
-            # SA[r, c] = sum_{i: b[i]=r} v[i] A[i, c]  — one XLA scatter-add.
+            # SA[r, c] = Σ_{h,i: b[h,i]=r} v[h,i]·A[i, c] — one scatter-add.
+            stacked = (v[:, :, None] * A[None, :, :]).reshape(-1, A.shape[1])
             return jax.ops.segment_sum(
-                values[:, None] * A, buckets, num_segments=self.s
+                stacked, b.reshape(-1), num_segments=self.s
             )
         if A.shape[-1] != self.n:
             raise ValueError(
                 f"rowwise apply needs A with {self.n} columns, got {A.shape}"
             )
-        # AS[r, c] = sum_{j: b[j]=c} v[j] A[r, j]: segment over columns.
+        stacked = (A[:, None, :] * v[None, :, :]).reshape(A.shape[0], -1)
         return jax.ops.segment_sum(
-            (A * values[None, :]).T, buckets, num_segments=self.s
+            stacked.T, b.reshape(-1), num_segments=self.s
         ).T
 
     def _apply_sparse(self, A: jsparse.BCOO, dim: Dimension):
-        """BCOO → BCOO: relabel hashed indices, scale data, sum duplicates
-        (≙ the local CSC build of hash_transform_local_sparse.hpp:88-152)."""
+        """BCOO → BCOO: relabel hashed indices per hash function, scale
+        data, sum duplicates (≙ the queue-then-finalize CSC build of
+        hash_transform_local_sparse.hpp:88-152)."""
         dtype = A.data.dtype
         axis = 0 if dim is Dimension.COLUMNWISE else 1
         if A.shape[axis] != self.n:
@@ -116,11 +127,15 @@ class HashSketch(SketchTransform):
                 f"{dim.value} apply needs A with {self.n} on axis {axis}, "
                 f"got {A.shape}"
             )
-        buckets = self.buckets()
-        values = self.values(dtype)
+        b = self.buckets().reshape(self.nnz, self.n)
+        v = self.values(dtype).reshape(self.nnz, self.n)
         hashed = A.indices[:, axis]
-        new_idx = A.indices.at[:, axis].set(buckets[hashed])
-        new_data = A.data * values[hashed]
+        idx_parts, data_parts = [], []
+        for h in range(self.nnz):
+            idx_parts.append(A.indices.at[:, axis].set(b[h][hashed]))
+            data_parts.append(A.data * v[h][hashed])
+        new_idx = jnp.concatenate(idx_parts, axis=0)
+        new_data = jnp.concatenate(data_parts, axis=0)
         shape = (
             (self.s, A.shape[1]) if axis == 0 else (A.shape[0], self.s)
         )
@@ -135,6 +150,34 @@ class CWT(HashSketch):
 
     sketch_type = "CWT"
     value_dist = "rademacher"
+
+
+@register_sketch
+class SJLT(HashSketch):
+    """Sparse JLT / OSNAP with ``nnz`` nonzeros per column: coordinate i
+    contributes ±1/√nnz at nnz hashed output slots.
+
+    ≙ python-skylark's pure-Python SJLT (``python-skylark/skylark/
+    sketch.py``, not in the C API); CWT is the nnz=1, unscaled special
+    case of the same hash engine.
+    """
+
+    sketch_type = "SJLT"
+    value_dist = "rademacher"
+
+    def __init__(self, n: int, s: int, context: SketchContext, nnz: int = 4):
+        super().__init__(n, s, context, nnz=nnz)
+
+    def values(self, dtype=jnp.float32, start: int = 0, num: int | None = None):
+        v = super().values(dtype, start, num)
+        return v / jnp.sqrt(jnp.asarray(float(self.nnz), dtype))
+
+    def _param_dict(self):
+        return {"nnz": self.nnz}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(d["N"], d["S"], context, nnz=d.get("nnz", 4))
 
 
 @register_sketch

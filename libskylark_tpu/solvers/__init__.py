@@ -13,11 +13,13 @@
 """
 
 from .accelerated import FasterLeastSquaresParams, faster_least_squares, lsrn_least_squares
+from .asynch import asy_fcg
 from .cond_est import cond_est
 from .gauss_seidel import randomized_block_gauss_seidel
 from .krylov import KrylovParams, cg, chebyshev, flexible_cg, lsqr
 from .precond import IdPrecond, MatPrecond, TriInversePrecond
 from .prox import LOSSES, REGULARIZERS, get_loss, get_regularizer
+from .regression import RegressionProblem, solve_regression
 
 __all__ = [
     "KrylovParams",
@@ -37,4 +39,7 @@ __all__ = [
     "REGULARIZERS",
     "get_loss",
     "get_regularizer",
+    "asy_fcg",
+    "RegressionProblem",
+    "solve_regression",
 ]

@@ -1,0 +1,49 @@
+"""Randomized-preconditioned flexible CG (≙ ``algorithms/asynch/``).
+
+``AsyFCG`` in the reference pairs FlexibleCG with an *asynchronous*
+randomized Gauss-Seidel inner solve as a (varying) preconditioner
+(``AsyFCG.hpp:8``, ``asynch/precond.hpp:7-22``).  On TPU the asynchrony
+has no analogue (SURVEY §2.7 P9); the math — FCG with an inexact,
+iteration-varying randomized GS preconditioner — is preserved with the
+synchronous randomized sweeps of ``gauss_seidel``.  Determinism: the
+sweep schedule is counter-derived per outer iteration.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.context import SketchContext
+from .gauss_seidel import randomized_block_gauss_seidel
+from .krylov import KrylovParams, flexible_cg
+
+__all__ = ["asy_fcg"]
+
+
+def asy_fcg(
+    A,
+    B,
+    context: SketchContext,
+    params: KrylovParams | None = None,
+    inner_sweeps: int = 2,
+    block_size: int = 64,
+):
+    """Solve SPD ``A X = B`` by FCG with a randomized block-GS inner
+    preconditioner.  Returns ``(X, info)``."""
+    A = jnp.asarray(A)
+    # One reserved block drives the inner sweeps' schedule.  The schedule
+    # is fixed across outer iterations (its length must be trace-static);
+    # the preconditioner still varies because GS runs from the current
+    # residual — which is what makes FCG (not plain CG) necessary.
+    seed = context.seed
+    nblocks = (A.shape[0] + block_size - 1) // block_size
+    base = context.reserve(inner_sweeps * nblocks)
+
+    def precond(R, it):
+        inner_ctx = SketchContext(seed=seed, counter=base)
+        Z, _ = randomized_block_gauss_seidel(
+            A, R, inner_ctx, block_size=block_size, sweeps=inner_sweeps
+        )
+        return Z
+
+    return flexible_cg(A, B, precond=precond, params=params)

@@ -79,7 +79,11 @@ def lsqr(A, B, precond=None, params: KrylovParams | None = None, x0=None):
     eps = jnp.finfo(dtype).eps
     atol = btol = jnp.asarray(max(params.tolerance, float(eps)), dtype)
 
-    U = B if x0 is None else B - matvec0(jnp.asarray(x0))
+    if x0 is not None:
+        x0 = jnp.asarray(x0)
+        if x0.ndim == 1:
+            x0 = x0[:, None]
+    U = B if x0 is None else B - matvec0(x0)
     beta = _colnorm(U)
     U = U / jnp.where(beta > 0, beta, 1)
     V = rmatvec(U)
@@ -169,7 +173,7 @@ def lsqr(A, B, precond=None, params: KrylovParams | None = None, x0=None):
     s = lax.while_loop(cond, body, state)
     X = N.apply(s["Y"])
     if x0 is not None:
-        X = X + jnp.asarray(x0).reshape(X.shape)
+        X = X + x0
     info = {
         "iterations": s["it"],
         "flag": jnp.where(jnp.all(s["done"]), 0, 1),

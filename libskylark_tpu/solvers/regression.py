@@ -1,0 +1,126 @@
+"""Regression problem/solver framework (≙ ``algorithms/regression/``).
+
+The reference's template-tag system — ``regression_problem_t<Input,
+RegressionType, PenaltyType, RegularizationType>`` with solver tags
+(``regression_problem.hpp:10-89``, ``regression_solver.hpp``) — collapses
+to a dataclass + string enums + a dispatching ``solve``:
+
+- penalty "l2" exact     → QR/SNE/NE/SVD (``linearl2_regression_solver``)
+- penalty "l2" sketched  → sketch-and-solve (``sketched_regression_solver``)
+- penalty "l2" accelerated → Blendenpik / LSRN
+  (``accelerated_regression_solver``)
+- penalty "l1" sketched  → l1 sketch-and-solve via a Cauchy/MMT sketch +
+  IRLS on the reduced problem (the reference frames l1 tags in the same
+  system; its concrete l1 solvers run sketched problems through an LP —
+  here IRLS, documented deviation)
+
+``Ridge`` regularization adds λ via the augmented system (the standard
+[A; √λI] stacking), matching ``El::Ridge`` semantics used by the
+reference's KRR path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..core.context import SketchContext
+from ..linalg.least_squares import LeastSquaresParams, approximate_least_squares, exact_least_squares
+from .accelerated import FasterLeastSquaresParams, faster_least_squares, lsrn_least_squares
+
+__all__ = ["RegressionProblem", "solve_regression"]
+
+
+@dataclass
+class RegressionProblem:
+    """≙ ``regression_problem_t``: (m, n, A) + penalty/regularization."""
+
+    A: Any
+    penalty: str = "l2"  # "l2" | "l1"
+    regularization: str = "none"  # "none" | "ridge"
+    lam: float = 0.0
+
+    @property
+    def shape(self):
+        return self.A.shape
+
+
+def _augment_ridge(A, B, lam):
+    m, n = A.shape
+    sq = jnp.sqrt(jnp.asarray(lam, A.dtype))
+    A_aug = jnp.concatenate([A, sq * jnp.eye(n, dtype=A.dtype)], axis=0)
+    B = jnp.asarray(B)
+    pad_shape = (n,) + B.shape[1:]
+    B_aug = jnp.concatenate([B, jnp.zeros(pad_shape, B.dtype)], axis=0)
+    return A_aug, B_aug
+
+
+def _irls_l1(A, B, iters=30, eps=1e-6):
+    """IRLS for min ‖Ax − b‖₁ on a small (sketched) problem, per column."""
+    B = jnp.asarray(B)
+    squeeze = B.ndim == 1
+    if squeeze:
+        B = B[:, None]
+
+    def one(b):
+        x = exact_least_squares(A, b)
+        for _ in range(iters):
+            r = A @ x - b
+            w = 1.0 / jnp.sqrt(jnp.abs(r) + eps)
+            x = exact_least_squares(w[:, None] * A, w * b)
+        return x
+
+    X = jnp.stack([one(B[:, j]) for j in range(B.shape[1])], axis=1)
+    return X[:, 0] if squeeze else X
+
+
+def solve_regression(
+    problem: RegressionProblem,
+    B,
+    solver: str = "exact",
+    context: SketchContext | None = None,
+    alg: str = "qr",
+    params: Any = None,
+):
+    """Dispatch ≙ the regression_solver_t specializations.
+
+    solver ∈ {"exact", "sketched", "accelerated", "lsrn"}.
+    Returns X (and (X, info) for iterative solvers).
+    """
+    A = problem.A
+    if problem.regularization == "ridge" and problem.lam > 0:
+        A, B = _augment_ridge(jnp.asarray(A), B, problem.lam)
+
+    if problem.penalty == "l1":
+        if context is None:
+            raise ValueError("l1 regression needs a SketchContext")
+        from ..sketch.base import Dimension
+        from ..sketch.hash import MMT
+
+        m, n = A.shape
+        s = min(max(4 * n, 64), m)
+        # Cauchy-value sketch preserves l1 geometry (MMT, Meng-Mahoney).
+        S = MMT(m, s, context)
+        SA = S.apply(jnp.asarray(A), Dimension.COLUMNWISE)
+        SB = S.apply(jnp.asarray(B), Dimension.COLUMNWISE)
+        return _irls_l1(SA, SB)
+
+    if solver == "exact":
+        return exact_least_squares(A, B, alg=alg)
+    if solver == "sketched":
+        if context is None:
+            raise ValueError("sketched solver needs a SketchContext")
+        return approximate_least_squares(
+            A, B, context, params or LeastSquaresParams(), alg=alg
+        )
+    if solver == "accelerated":
+        if context is None:
+            raise ValueError("accelerated solver needs a SketchContext")
+        return faster_least_squares(A, B, context, params)
+    if solver == "lsrn":
+        if context is None:
+            raise ValueError("lsrn solver needs a SketchContext")
+        return lsrn_least_squares(A, B, context, params)
+    raise ValueError(f"unknown solver {solver!r}")

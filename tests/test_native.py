@@ -1,0 +1,153 @@
+"""Native C core tests: bit/tolerance parity with the JAX path, C API
+round-trips, LIBSVM parser equivalence and speed sanity."""
+
+import json
+
+import numpy as np
+import pytest
+
+from libskylark_tpu import SketchContext, native
+from libskylark_tpu.core.random import sample
+from libskylark_tpu.sketch import CWT, JLT, UST, WZT, from_json
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable (no g++)"
+)
+
+
+class TestRNGParity:
+    def test_integer_draws_bit_identical(self):
+        # rademacher: exact parity with the JAX threefry stream.
+        out = np.empty(1000, np.float64)
+        native.lib().sl_sample(12345, 777, 1000, 2, 0, out)
+        ref = np.asarray(sample("rademacher", 12345, 777, 1000, dtype="float64"))
+        np.testing.assert_array_equal(out, ref)
+
+    def test_uniform_bit_identical(self):
+        out = np.empty(500, np.float64)
+        native.lib().sl_sample(9, 0, 500, 4, 0, out)
+        ref = np.asarray(sample("uniform", 9, 0, 500, dtype="float64"))
+        np.testing.assert_array_equal(out, ref)
+
+    def test_normal_cauchy_close(self):
+        for dist, code in [("normal", 0), ("cauchy", 1), ("exponential", 3)]:
+            out = np.empty(2000, np.float64)
+            native.lib().sl_sample(42, 100, 2000, code, 0, out)
+            ref = np.asarray(sample(dist, 42, 100, 2000, dtype="float64"))
+            np.testing.assert_allclose(out, ref, rtol=1e-12, atol=1e-12)
+
+
+class TestCAPI:
+    def test_context_counter_matches_python(self):
+        nctx = native.NativeContext(5)
+        pctx = SketchContext(seed=5)
+        ns = native.NativeSketch.create(nctx, "JLT", 30, 10)
+        ps = JLT(30, 10, pctx)
+        assert nctx.counter == pctx.counter
+        ns2 = native.NativeSketch.create(nctx, "CWT", 30, 10)
+        ps2 = CWT(30, 10, pctx)
+        assert nctx.counter == pctx.counter
+
+    @pytest.mark.parametrize("stype,cls,param", [
+        ("JLT", JLT, 0.0), ("CWT", CWT, 0.0),
+    ])
+    def test_apply_matches_python(self, rng, stype, cls, param):
+        n, s, m = 40, 12, 7
+        A = rng.standard_normal((n, m))
+        nctx = native.NativeContext(3)
+        ns = native.NativeSketch.create(nctx, stype, n, s, param)
+        ps = cls(n, s, SketchContext(seed=3))
+        out_n = ns.apply(A, "columnwise")
+        out_p = np.asarray(ps.apply(A, "columnwise"))
+        np.testing.assert_allclose(out_n, out_p, rtol=1e-9, atol=1e-11)
+        out_n = ns.apply(A.T, "rowwise")
+        out_p = np.asarray(ps.apply(A.T, "rowwise"))
+        np.testing.assert_allclose(out_n, out_p, rtol=1e-9, atol=1e-11)
+
+    def test_wzt_and_ust_match(self, rng):
+        n, s, m = 30, 8, 4
+        A = rng.standard_normal((n, m))
+        nctx = native.NativeContext(8)
+        ns = native.NativeSketch.create(nctx, "WZT", n, s, 1.5)
+        from libskylark_tpu.sketch import WZT
+
+        ps = WZT(n, s, SketchContext(seed=8), p=1.5)
+        np.testing.assert_allclose(
+            ns.apply(A), np.asarray(ps.apply(A)), rtol=1e-9, atol=1e-11
+        )
+        nctx2 = native.NativeContext(9)
+        nu = native.NativeSketch.create(nctx2, "UST", n, s, 0.0)  # no-replace
+        pu = UST(n, s, SketchContext(seed=9), replace=False)
+        np.testing.assert_allclose(
+            nu.apply(A), np.asarray(pu.apply(A)), rtol=1e-12
+        )
+
+    def test_serialization_cross_language(self, rng):
+        # native JSON → Python reconstruction → same sketch; and back.
+        n, s = 25, 6
+        nctx = native.NativeContext(4)
+        ns = native.NativeSketch.create(nctx, "JLT", n, s)
+        js = ns.to_json()
+        ps = from_json(js)
+        A = rng.standard_normal((n, 3))
+        np.testing.assert_allclose(
+            ns.apply(A), np.asarray(ps.apply(A)), rtol=1e-9, atol=1e-11
+        )
+        # Python JSON → native
+        ps2 = JLT(n, s, SketchContext(seed=4))
+        ns2 = native.NativeSketch.from_json(ps2.to_json())
+        np.testing.assert_allclose(
+            ns2.apply(A), np.asarray(ps2.apply(A)), rtol=1e-9, atol=1e-11
+        )
+
+    def test_error_codes(self):
+        nctx = native.NativeContext(1)
+        from libskylark_tpu.utils.exceptions import SkylarkError
+
+        with pytest.raises(SkylarkError):
+            native.NativeSketch.create(nctx, "NOPE", 5, 3)
+
+
+class TestLibsvmParser:
+    def test_matches_python_parser(self, tmp_path, rng):
+        from libskylark_tpu.io import read_libsvm, write_libsvm
+
+        X = rng.standard_normal((50, 12))
+        X[rng.random((50, 12)) < 0.4] = 0
+        y = rng.integers(0, 5, 50).astype(float)
+        write_libsvm(tmp_path / "f", X, y)
+        # native path (if enabled) vs forced-python path must agree
+        X1, y1 = read_libsvm(tmp_path / "f", n_features=12)
+        data = (tmp_path / "f").read_bytes()
+        labels, rows, cols, vals, max_col = native.parse_libsvm_bytes(data)
+        X2 = np.zeros((len(labels), 12))
+        X2[rows, cols] = vals
+        np.testing.assert_allclose(X2, X, rtol=1e-15)
+        np.testing.assert_allclose(labels, y)
+        np.testing.assert_allclose(X1, X, rtol=1e-15)
+
+    def test_comments_and_blanks(self):
+        data = b"# header\n\n1 1:2.5 3:1 # trailing\n-1 2:0.5\n"
+        labels, rows, cols, vals, max_col = native.parse_libsvm_bytes(data)
+        np.testing.assert_allclose(labels, [1, -1])
+        assert max_col == 3
+        np.testing.assert_array_equal(cols, [0, 2, 1])
+        np.testing.assert_allclose(vals, [2.5, 1.0, 0.5])
+
+    def test_large_file_multithreaded(self, tmp_path, rng):
+        # >64KiB triggers the threaded path.
+        lines = []
+        for i in range(5000):
+            feats = " ".join(
+                f"{j+1}:{rng.standard_normal():.6f}" for j in rng.choice(100, 8)
+            )
+            lines.append(f"{i % 3} {feats}")
+        (tmp_path / "big").write_text("\n".join(lines) + "\n")
+        data = (tmp_path / "big").read_bytes()
+        assert len(data) > (1 << 16)
+        labels, rows, cols, vals, max_col = native.parse_libsvm_bytes(data)
+        assert len(labels) == 5000
+        assert len(vals) == 5000 * 8
+        # row indices must be globally consistent (file order)
+        assert rows[0] == 0 and rows[-1] == 4999
+        np.testing.assert_allclose(labels[:3], [0, 1, 2])

@@ -1,0 +1,187 @@
+"""Tests for parity-gap extras: regression framework, AsyFCG, SJLT,
+timers, exceptions, solver checkpoint/resume."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libskylark_tpu import SketchContext
+from libskylark_tpu.sketch import SJLT, from_json
+from libskylark_tpu.solvers import (
+    KrylovParams,
+    RegressionProblem,
+    asy_fcg,
+    lsqr,
+    solve_regression,
+)
+from libskylark_tpu.utils import (
+    PhaseTimer,
+    SkylarkError,
+    SketchError,
+    load_solver_state,
+    save_solver_state,
+)
+
+
+def spd(rng, n, cond=100.0):
+    Q = np.linalg.qr(rng.standard_normal((n, n)))[0]
+    lam = np.logspace(0, -np.log10(cond), n)
+    return jnp.asarray(Q @ np.diag(lam) @ Q.T)
+
+
+class TestRegressionFramework:
+    def test_exact_dispatch(self, rng):
+        A = jnp.asarray(rng.standard_normal((80, 10)))
+        b = jnp.asarray(rng.standard_normal(80))
+        x = solve_regression(RegressionProblem(A), b, solver="exact")
+        x_ref = np.linalg.lstsq(np.asarray(A), np.asarray(b), rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(x), x_ref, rtol=1e-8, atol=1e-10)
+
+    def test_ridge_augmentation(self, rng):
+        A = jnp.asarray(rng.standard_normal((60, 8)))
+        b = jnp.asarray(rng.standard_normal(60))
+        lam = 0.5
+        x = solve_regression(
+            RegressionProblem(A, regularization="ridge", lam=lam), b
+        )
+        x_ref = np.linalg.solve(
+            np.asarray(A.T @ A) + lam * np.eye(8), np.asarray(A.T @ b)
+        )
+        np.testing.assert_allclose(np.asarray(x), x_ref, rtol=1e-7, atol=1e-9)
+
+    def test_accelerated_dispatch(self, rng):
+        A = jnp.asarray(rng.standard_normal((500, 12)))
+        b = jnp.asarray(rng.standard_normal(500))
+        x, info = solve_regression(
+            RegressionProblem(A), b, solver="accelerated",
+            context=SketchContext(seed=1),
+        )
+        x_ref = np.linalg.lstsq(np.asarray(A), np.asarray(b), rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(x), x_ref, rtol=1e-6, atol=1e-8)
+
+    def test_l1_regression_robust_to_outliers(self, rng):
+        # l1 should shrug off gross outliers that wreck l2.
+        m, n = 3000, 5
+        A = rng.standard_normal((m, n))
+        x_true = rng.standard_normal(n)
+        b = A @ x_true
+        idx = rng.choice(m, 100, replace=False)
+        b[idx] += 100 * rng.standard_normal(100)
+        x1 = solve_regression(
+            RegressionProblem(jnp.asarray(A), penalty="l1"),
+            jnp.asarray(b),
+            context=SketchContext(seed=2),
+        )
+        x2 = np.linalg.lstsq(A, b, rcond=None)[0]
+        e1 = np.linalg.norm(np.asarray(x1) - x_true)
+        e2 = np.linalg.norm(x2 - x_true)
+        assert e1 < e2
+
+    def test_sketched_dispatch(self, rng):
+        A = jnp.asarray(rng.standard_normal((800, 10)))
+        b = jnp.asarray(rng.standard_normal(800))
+        x = solve_regression(
+            RegressionProblem(A), b, solver="sketched",
+            context=SketchContext(seed=3),
+        )
+        assert np.all(np.isfinite(np.asarray(x)))
+
+
+class TestAsyFCG:
+    def test_spd_solve(self, rng):
+        A = spd(rng, 96, cond=1e3)
+        b = jnp.asarray(rng.standard_normal(96))
+        x, info = asy_fcg(
+            A, b, SketchContext(seed=4),
+            KrylovParams(iter_lim=100, tolerance=1e-9),
+            inner_sweeps=2, block_size=32,
+        )
+        np.testing.assert_allclose(
+            np.asarray(A @ x), np.asarray(b), rtol=1e-5, atol=1e-6
+        )
+        # preconditioning should beat plain FCG iteration count
+        from libskylark_tpu.solvers import flexible_cg
+
+        _, info_plain = flexible_cg(
+            A, b, params=KrylovParams(iter_lim=100, tolerance=1e-9)
+        )
+        assert int(info["iterations"]) <= int(info_plain["iterations"])
+
+
+class TestSJLT:
+    def test_norm_preservation_statistical(self, rng):
+        n, s = 300, 100
+        X = jnp.asarray(rng.standard_normal((n, 6)))
+        norms = np.linalg.norm(np.asarray(X), axis=0)
+        errs = []
+        for rep in range(5):
+            S = SJLT(n, s, SketchContext(seed=rep), nnz=4)
+            SX = S.apply(X, "columnwise")
+            errs.append(np.abs(np.linalg.norm(np.asarray(SX), axis=0) - norms) / norms)
+        assert np.mean(errs) < 3.0 / np.sqrt(s)
+
+    def test_rowwise_matches_columnwise(self, rng):
+        n, s = 50, 20
+        X = rng.standard_normal((7, n))
+        S1 = SJLT(n, s, SketchContext(seed=5), nnz=3)
+        S2 = SJLT(n, s, SketchContext(seed=5), nnz=3)
+        np.testing.assert_allclose(
+            np.asarray(S1.apply(jnp.asarray(X), "rowwise")),
+            np.asarray(S2.apply(jnp.asarray(X.T), "columnwise")).T,
+            rtol=1e-6,
+        )
+
+    def test_cwt_is_nnz1_special_case_shape(self, rng):
+        S = SJLT(40, 16, SketchContext(seed=6), nnz=1)
+        out = S.apply(jnp.asarray(rng.standard_normal((40, 3))))
+        assert out.shape == (16, 3)
+
+    def test_json_roundtrip(self, rng):
+        S = SJLT(30, 10, SketchContext(seed=7), nnz=2)
+        S2 = from_json(S.to_json())
+        X = jnp.asarray(rng.standard_normal((30, 2)))
+        np.testing.assert_array_equal(
+            np.asarray(S.apply(X)), np.asarray(S2.apply(X))
+        )
+
+
+class TestUtils:
+    def test_phase_timer(self):
+        t = PhaseTimer()
+        with t.phase("a"):
+            sum(range(1000))
+        with t.phase("a"):
+            pass
+        rep = t.report()
+        assert "a" in rep and t.counts["a"] == 2
+
+    def test_exception_codes(self):
+        assert issubclass(SketchError, SkylarkError)
+        assert SketchError.code == 103
+        with pytest.raises(SkylarkError):
+            raise SketchError("boom")
+
+    def test_checkpoint_roundtrip(self, tmp_path, rng):
+        state = {
+            "X": jnp.asarray(rng.standard_normal((5, 3))),
+            "it": jnp.asarray(7),
+            "nested": [jnp.asarray([1.0, 2.0])],
+        }
+        save_solver_state(tmp_path / "ck", state, {"iter": 7})
+        state2, meta = load_solver_state(tmp_path / "ck", like=state)
+        assert meta["iter"] == 7
+        np.testing.assert_allclose(state2["X"], np.asarray(state["X"]))
+        np.testing.assert_allclose(state2["nested"][0], [1.0, 2.0])
+
+    def test_checkpoint_resume_lsqr(self, tmp_path, rng):
+        # Save x mid-solve, resume via x0, match the uninterrupted solve.
+        A = jnp.asarray(rng.standard_normal((100, 12)))
+        b = jnp.asarray(rng.standard_normal(100))
+        x_partial, _ = lsqr(A, b, params=KrylovParams(iter_lim=4))
+        save_solver_state(tmp_path / "lsqr", {"x": x_partial})
+        st, _ = load_solver_state(tmp_path / "lsqr", like={"x": x_partial})
+        x_resumed, _ = lsqr(
+            A, b, params=KrylovParams(iter_lim=300), x0=jnp.asarray(st["x"])
+        )
+        x_ref = np.linalg.lstsq(np.asarray(A), np.asarray(b), rcond=None)[0]
+        np.testing.assert_allclose(np.asarray(x_resumed), x_ref, rtol=1e-6, atol=1e-8)
