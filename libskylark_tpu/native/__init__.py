@@ -48,7 +48,21 @@ def lib():
         _tried = True
         if not _build():
             return None
-        L = ctypes.CDLL(_SO)
+        try:
+            L = ctypes.CDLL(_SO)
+        except OSError:
+            # Corrupt/stale/incompatible cached .so: rebuild once, then
+            # degrade gracefully.
+            try:
+                os.remove(_SO)
+            except OSError:
+                pass
+            if not _build():
+                return None
+            try:
+                L = ctypes.CDLL(_SO)
+            except OSError:
+                return None
         L.sl_create_context.restype = ctypes.c_void_p
         L.sl_create_context.argtypes = [ctypes.c_uint64]
         L.sl_free_context.argtypes = [ctypes.c_void_p]
@@ -127,16 +141,20 @@ class NativeContext:
     """≙ ``sl_create_context`` handle."""
 
     def __init__(self, seed: int):
-        self._h = lib().sl_create_context(seed)
+        L = lib()
+        self._h = L.sl_create_context(seed)
+        # Cache the free function: module globals may already be cleared
+        # when __del__ runs at interpreter shutdown.
+        self._free = L.sl_free_context
 
     @property
     def counter(self) -> int:
         return int(lib().sl_context_counter(self._h))
 
     def __del__(self):
-        if getattr(self, "_h", None) and lib() is not None:
-            lib().sl_free_context(self._h)
-            self._h = None
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._free(h)
 
 
 class NativeSketch:
@@ -145,6 +163,7 @@ class NativeSketch:
     def __init__(self, handle, n, s):
         self._h = handle
         self.n, self.s = n, s
+        self._free = lib().sl_free_sketch_transform
 
     @classmethod
     def create(cls, ctx: NativeContext, sketch_type: str, n: int, s: int,
@@ -182,6 +201,6 @@ class NativeSketch:
         return s
 
     def __del__(self):
-        if getattr(self, "_h", None) and lib() is not None:
-            lib().sl_free_sketch_transform(self._h)
-            self._h = None
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._free(h)

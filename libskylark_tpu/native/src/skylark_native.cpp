@@ -12,7 +12,7 @@
 // counter layout as libskylark_tpu.core.random (sample i of a stream is a
 // pure function of (seed, lane, base+i)); integer-derived draws
 // (rademacher, uniform_int, uniform bits) are BIT-identical to the JAX
-// path, transcendental ones (normal via Cephes ndtri, cauchy, exp) match
+// path, transcendental ones (normal via Box-Muller, cauchy, exp) match
 // to ~1 ulp in float64.
 //
 // Build: g++ -O3 -shared -fPIC -fopenmp (see ../build.py).
@@ -92,79 +92,6 @@ static inline float sk_uniform01_f32(uint32_t lo) {
     return ((float)k + 0.5f) * 0x1p-24f;
 }
 
-// Cephes ndtri (inverse normal CDF) — same algorithm jax.scipy.special
-// uses, so float64 values agree to ~1 ulp.
-static double sk_ndtri(double y0) {
-    static const double P0[5] = {
-        -5.99633501014107895267e1, 9.80010754185999661536e1,
-        -5.66762857469070293439e1, 1.39312609387279679503e1,
-        -1.23916583867381258016e0};
-    static const double Q0[8] = {
-        1.95448858338141759834e0, 4.67627912898881538453e0,
-        8.63602421390890590575e1, -2.25462687854119370527e2,
-        2.00260212380060660359e2, -8.20372256168538034e1,
-        1.59056225126211695515e1, -1.18331621121330003142e0};
-    static const double P1[9] = {
-        4.05544892305962419923e0, 3.15251094599893866154e1,
-        5.71628192246421288162e1, 4.408050738932008347e1,
-        1.46849561928858024014e1, 2.18663306850790267539e0,
-        -1.40256079171354495875e-1, -3.50424626827848203418e-2,
-        -8.57456785154685413611e-4};
-    static const double Q1[8] = {
-        1.57799883256466749731e1, 4.53907635128879210584e1,
-        4.13172038254672030440e1, 1.50425385692907503408e1,
-        2.50464946208309415979e0, -1.42182922854787788574e-1,
-        -3.80806407691578277194e-2, -9.33259480895457427372e-4};
-    static const double P2[9] = {
-        3.23774891776946035970e0, 6.91522889068984211695e0,
-        3.93881025292474443415e0, 1.33303460815807542389e0,
-        2.01485389549179081538e-1, 1.23716634817820021358e-2,
-        3.01581553508235416007e-4, 2.65806974686737550832e-6,
-        6.23974539184983651783e-9};
-    static const double Q2[8] = {
-        6.02427039364742014255e0, 3.67983563856160859403e0,
-        1.37702099489081330271e0, 2.16236993594496635890e-1,
-        1.34204006088543189037e-2, 3.28014464682127739104e-4,
-        2.89247864745380683936e-6, 6.79019408009981274425e-9};
-
-    const double s2pi = 2.50662827463100050242;
-    if (y0 <= 0.0) return -INFINITY;
-    if (y0 >= 1.0) return INFINITY;
-    int code = 1;
-    double y = y0;
-    if (y > 1.0 - 0.13533528323661269189) {  // 1 - exp(-2)
-        y = 1.0 - y;
-        code = 0;
-    }
-    if (y > 0.13533528323661269189) {
-        y = y - 0.5;
-        double y2 = y * y;
-        double num = P0[0], den = 1.0;
-        for (int i = 1; i < 5; i++) num = num * y2 + P0[i];
-        for (int i = 0; i < 8; i++) den = den * y2 + Q0[i];
-        double x = y + y * (y2 * num / den);
-        return x * s2pi;
-    }
-    double x = std::sqrt(-2.0 * std::log(y));
-    double x0 = x - std::log(x) / x;
-    double z = 1.0 / x;
-    double x1;
-    if (x < 8.0) {
-        double num = P1[0], den = 1.0;
-        for (int i = 1; i < 9; i++) num = num * z + P1[i];
-        for (int i = 0; i < 8; i++) den = den * z + Q1[i];
-        x1 = z * num / den;
-    } else {
-        double num = P2[0], den = 1.0;
-        for (int i = 1; i < 9; i++) num = num * z + P2[i];
-        for (int i = 0; i < 8; i++) den = den * z + Q2[i];
-        x1 = z * num / den;
-    }
-    x = x0 - x1;
-    if (code) x = -x;
-    return x;
-}
-
 static inline uint32_t sk_uniform_int(uint32_t hi, uint32_t lo, uint32_t lo_b,
                                       uint32_t hi_b) {
     uint64_t span = (uint64_t)(hi_b - lo_b) + 1;
@@ -178,9 +105,17 @@ static inline uint32_t sk_uniform_int(uint32_t hi, uint32_t lo, uint32_t lo_b,
 enum { SK_DIST_NORMAL = 0, SK_DIST_CAUCHY = 1, SK_DIST_RADEMACHER = 2,
        SK_DIST_EXP = 3, SK_DIST_UNIFORM = 4 };
 
+// Box-Muller normal from the two counter words (matches core/random.py
+// _normal: f64 path, 32 uniform bits per word).
+static inline double sk_normal(uint32_t hi, uint32_t lo) {
+    double u1 = ((double)hi + 0.5) * 0x1p-32;
+    double u2 = ((double)lo + 0.5) * 0x1p-32;
+    return std::sqrt(-2.0 * std::log(u1)) * std::cos(2.0 * M_PI * u2);
+}
+
 static inline double sk_draw(int dist, uint32_t hi, uint32_t lo) {
     switch (dist) {
-        case SK_DIST_NORMAL: return sk_ndtri(sk_uniform01(hi, lo));
+        case SK_DIST_NORMAL: return sk_normal(hi, lo);
         case SK_DIST_CAUCHY: return std::tan(M_PI * (sk_uniform01(hi, lo) - 0.5));
         case SK_DIST_RADEMACHER: return (lo & 1u) ? 1.0 : -1.0;
         case SK_DIST_EXP: return -std::log(sk_uniform01(hi, lo));
@@ -444,6 +379,16 @@ static bool js_find_num(const char* js, const char* key, double* val) {
     return true;
 }
 
+// Full 64-bit precision (seed/counter can exceed 2^53).
+static bool js_find_u64(const char* js, const char* key, uint64_t* val) {
+    std::string pat = std::string("\"") + key + "\":";
+    const char* p = strstr(js, pat.c_str());
+    if (!p) return false;
+    p += pat.size();
+    *val = strtoull(p, nullptr, 10);
+    return true;
+}
+
 static bool js_find_str(const char* js, const char* key, char* out, size_t cap) {
     std::string pat = std::string("\"") + key + "\":";
     const char* p = strstr(js, pat.c_str());
@@ -465,12 +410,13 @@ int sl_deserialize_sketch_transform(const char* json, void** out) {
     for (const char* p = json; *p; p++)
         if (*p != ' ' && *p != '\n') norm.push_back(*p);
     char type[32];
-    double n, s, seed, counter;
+    double n, s;
+    uint64_t seed, counter;
     if (!js_find_str(norm.c_str(), "sketch_type", type, sizeof type) ||
         !js_find_num(norm.c_str(), "N", &n) ||
         !js_find_num(norm.c_str(), "S", &s) ||
-        !js_find_num(norm.c_str(), "seed", &seed) ||
-        !js_find_num(norm.c_str(), "counter", &counter))
+        !js_find_u64(norm.c_str(), "seed", &seed) ||
+        !js_find_u64(norm.c_str(), "counter", &counter))
         return 103;
     double param = 0.0;
     if (!strcmp(type, "CT")) { js_find_num(norm.c_str(), "C", &param); if (param == 0) param = 1.0; }
@@ -478,7 +424,7 @@ int sl_deserialize_sketch_transform(const char* json, void** out) {
     else if (!strcmp(type, "UST")) {
         param = strstr(norm.c_str(), "\"replace\":false") ? 0.0 : 1.0;
     }
-    sl_context_t ctx{(uint64_t)seed, (uint64_t)counter};
+    sl_context_t ctx{seed, counter};
     return sl_create_sketch_transform(&ctx, type, (long)n, (long)s, param, out);
 }
 

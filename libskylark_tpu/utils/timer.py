@@ -1,11 +1,12 @@
-"""Phase timers with cross-host aggregation.
+"""Phase timers (local, per-process).
 
 ≙ ``SKYLARK_TIMER_{DECLARE,INITIALIZE,RESTART,ACCUMULATE,PRINT}``
-(``utility/timer.hpp:6-70``): named accumulating wall timers; the PRINT
-reduction (min/max/avg over MPI ranks) becomes a min/max/avg over hosts
-via ``jax.process_count``-aware psums when distributed, or a plain local
-report single-host.  Device work is made observable with
-``block_until_ready`` at phase boundaries (the reference's barrier).
+(``utility/timer.hpp:6-70``): named accumulating wall timers.  The
+reference's PRINT reduces min/max/avg over MPI ranks; here each process
+reports locally — under ``jax.distributed`` the launcher aggregates logs
+(there is no in-band host-to-host reduction for wall-clock scalars in
+JAX).  Device work is made observable by assigning the phase handle's
+``result`` (blocked on at phase exit — the reference's barrier).
 """
 
 from __future__ import annotations
@@ -61,12 +62,7 @@ class PhaseTimer:
 
 
 def timer_report(totals, counts=None) -> str:
-    """min/max/avg-across-hosts shaped report (≙ timer.hpp PRINT).
-
-    Single-process runs report local values in all three columns; under
-    ``jax.distributed`` each host prints its own line-set (the reference
-    reduces to rank 0 — with JAX the driver aggregates logs instead).
-    """
+    """Local total/calls/avg report (≙ timer.hpp PRINT, per-process)."""
     lines = [f"{'phase':<24}{'total(s)':>12}{'calls':>8}{'avg(s)':>12}"]
     for name in sorted(totals):
         total = totals[name]
