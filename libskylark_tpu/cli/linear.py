@@ -1,0 +1,56 @@
+"""skylark-linear: accelerated least-squares driver
+(≙ ``nla/skylark_linear.cpp:1-201``): reads a problem, runs
+``faster_least_squares`` (Blendenpik), writes the solution."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="skylark-linear")
+    p.add_argument("inputfile", help="LIBSVM file: features = A, labels = b")
+    p.add_argument("--solution", default="solution.npy")
+    p.add_argument("--seed", type=int, default=38734)
+    p.add_argument("--solver", default="accelerated",
+                   choices=["exact", "sketched", "accelerated", "lsrn"])
+    p.add_argument("--sparse", action="store_true")
+    p.add_argument("--x64", action="store_true")
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.x64:
+        jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from ..core.context import SketchContext
+    from ..io import read_libsvm
+    from ..solvers import RegressionProblem, solve_regression
+
+    A, b = read_libsvm(args.inputfile, sparse=args.sparse)
+    Aj = A if args.sparse else jnp.asarray(A)
+    t0 = time.perf_counter()
+    result = solve_regression(
+        RegressionProblem(Aj),
+        jnp.asarray(b),
+        solver=args.solver,
+        context=SketchContext(seed=args.seed),
+    )
+    x = result[0] if isinstance(result, tuple) else result
+    x = np.asarray(x)
+    dt = time.perf_counter() - t0
+    r = np.linalg.norm(np.asarray(Aj @ jnp.asarray(x)) - b)
+    print(f"Solved {A.shape[0]}x{A.shape[1]} ({args.solver}) in {dt:.3f}s; "
+          f"residual {r:.6e}")
+    np.save(args.solution, x)
+    print(f"Solution -> {args.solution}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,8 +103,8 @@ def window_bits(
     seed: int,
     base: int,
     full_cols: int,
-    row0: int,
-    col0: int,
+    row0,
+    col0,
     rows: int,
     cols: int,
     lane: int = 0,
@@ -115,13 +115,22 @@ def window_bits(
     the same contract as ``dense_transform_data_t::realize_matrix_view``
     (``sketch/dense_transform_data.hpp:79-152``), so a sharded realization is
     bit-identical to the single-host one.
+
+    ``row0``/``col0`` may be traced scalars (shard-dependent offsets under
+    ``shard_map``); ``rows``/``cols``/``base``/``full_cols`` must be
+    static.  All counter math is uint32-pair with explicit carries, so
+    windows crossing 2^32 counter boundaries stay exact.
     """
     i = jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 0)
     j = jax.lax.broadcasted_iota(jnp.uint32, (rows, cols), 1)
-    b_hi, b_lo = _split64(base + row0 * full_cols + col0)
-    # counter = base' + i*full_cols + j   (all uint32-pair arithmetic)
-    r_hi, r_lo = _mul_u32(jnp.uint32(0), i, full_cols)
-    hi, lo = _add64(r_hi, r_lo, jnp.uint32(0), j)
+    if isinstance(row0, int):
+        row0 = np.uint32(row0 % (1 << 32))
+    if isinstance(col0, int):
+        col0 = np.uint32(col0 % (1 << 32))
+    # counter = base + (row0+i)*full_cols + (col0+j), uint32-pair math.
+    r_hi, r_lo = _mul_u32(jnp.uint32(0), i + jnp.uint32(row0), full_cols)
+    hi, lo = _add64(r_hi, r_lo, jnp.uint32(0), j + jnp.uint32(col0))
+    b_hi, b_lo = _split64(base)
     hi, lo = _add64(hi, lo, jnp.uint32(b_hi), jnp.uint32(b_lo))
     out = threefry_2x32(
         _key(seed, lane), jnp.concatenate([hi.ravel(), lo.ravel()])

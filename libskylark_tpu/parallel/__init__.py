@@ -11,6 +11,7 @@ XLA from sharding constraints, or explicitly under ``shard_map`` where an
 invariant must be enforced by hand.
 """
 
+from .collectives import columnwise_sharded, rowwise_sharded
 from .mesh import (
     ROWS,
     COLS,
@@ -35,4 +36,6 @@ __all__ = [
     "shard_cols",
     "shard_rows",
     "sharding",
+    "rowwise_sharded",
+    "columnwise_sharded",
 ]

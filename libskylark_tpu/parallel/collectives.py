@@ -1,0 +1,99 @@
+"""Explicit shard_map sketch-apply schedules.
+
+Most of the framework lets GSPMD choose communication (SURVEY §2.7 P4).
+This module keeps the two schedules the reference treats as *invariants*
+explicit, as `shard_map` programs:
+
+- ``rowwise_sharded``: A sharded over rows (``[VC,*]``), sketch along the
+  replicated feature axis — **communication-free** by construction
+  (≙ ``doc/sphinx/sketching.rst:104-118``; the sketch operand is realized
+  shard-locally from the counter stream, P5, and no collective is ever
+  emitted — guaranteed here rather than hoped from the partitioner).
+- ``columnwise_sharded``: A sharded over rows, sketched *along* the
+  sharded axis: each shard sketches its row block with its own counter
+  window of Omega, then one ``psum`` (or ``psum_scatter``) combines —
+  the reduce-scatter schedule of
+  ``sketch/dense_transform_Elemental_mc_mr.hpp:179,302,599``.
+
+Works for any transform whose apply is local given the right counter
+window; dense transforms expose that through ``realize`` (which accepts
+traced, shard-dependent offsets), hash transforms through per-coordinate
+``buckets``/``values`` slices.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..sketch.base import Dimension
+from ..sketch.dense import DenseSketch
+
+__all__ = ["rowwise_sharded", "columnwise_sharded"]
+
+
+def _coerce_float(A):
+    A = jnp.asarray(A)
+    if not jnp.issubdtype(A.dtype, jnp.floating):
+        A = A.astype(jnp.float32)
+    return A
+
+
+def rowwise_sharded(S, A, mesh: Mesh):
+    """A (m, N) sharded on rows → A·Omegaᵀ (m, S) sharded on rows.
+
+    Zero communication: each shard applies the full sketch to its local
+    rows (Omega realized in-shard).
+    """
+    axes = tuple(mesh.axis_names)
+    A = _coerce_float(A)
+
+    def local(a):
+        return S.apply(a, Dimension.ROWWISE)
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=P(axes, None),
+        out_specs=P(axes, None),
+    )(A)
+
+
+def columnwise_sharded(S: DenseSketch, A, mesh: Mesh, scatter: bool = False):
+    """A (N, m) sharded on rows → S·A (S, m).
+
+    Each shard multiplies its Omega column window (counter-derived, local
+    — ``realize`` with a shard-dependent traced offset) with its row
+    block, then a ``psum`` sums partial products; with ``scatter=True`` a
+    ``psum_scatter`` leaves the output row-sharded (the reference's
+    reduce-scatter within grid columns).
+    """
+    axes = tuple(mesh.axis_names)
+    A = _coerce_float(A)
+    nshards = 1
+    for a in axes:
+        nshards *= mesh.shape[a]
+    n = A.shape[0]
+    if n % nshards:
+        raise ValueError(f"rows {n} not divisible by mesh size {nshards}")
+    block = n // nshards
+    if S.s % nshards and scatter:
+        raise ValueError(f"S={S.s} not divisible by mesh size for scatter")
+
+    def local(a):
+        idx = jax.lax.axis_index(axes)  # linearized shard index
+        omega_blk = S.realize(
+            a.dtype, offset=(0, idx * block), shape=(S.s, block)
+        )
+        partial_out = omega_blk @ a  # (S, m_local) partial product
+        if scatter:
+            return jax.lax.psum_scatter(
+                partial_out, axes, scatter_dimension=0, tiled=True
+            )
+        return jax.lax.psum(partial_out, axes)
+
+    out_spec = P(axes, None) if scatter else P(None, None)
+    return jax.shard_map(
+        local, mesh=mesh, in_specs=P(axes, None), out_specs=out_spec
+    )(A)

@@ -26,9 +26,18 @@ import jax.numpy as jnp
 
 from ..core.context import SketchContext
 from ..core.random import sample_window
+from ..utils.exceptions import UnsupportedError
 from .base import Dimension, SketchTransform, register_sketch
 
-__all__ = ["DenseSketch", "JLT", "CT"]
+__all__ = ["DenseSketch", "JLT", "CT", "MAX_REALIZE_ELEMENTS"]
+
+# Above this many Omega entries, apply() switches to panel-blocked
+# accumulation so the realized window stays bounded (≙ the reference's
+# panel-blocked GEMM with sketch_params block-size knobs,
+# ``sketch/dense_transform_Elemental_mc_mr.hpp:87-120``): Omega is
+# realized panel-by-panel along N and accumulated, never materialized
+# whole.  128M entries ≈ 0.5 GB in f32.
+MAX_REALIZE_ELEMENTS = 1 << 27
 
 
 class DenseSketch(SketchTransform):
@@ -88,19 +97,67 @@ class DenseSketch(SketchTransform):
         dtype = A.dtype
         if not jnp.issubdtype(dtype, jnp.floating):
             dtype = jnp.float32
-        omega = self.realize(dtype)
         if dim is Dimension.COLUMNWISE:
             if A.shape[0] != self.n:
                 raise ValueError(
                     f"columnwise apply needs A with {self.n} rows, "
                     f"got {A.shape}"
                 )
-            return _matmul(omega, A)
-        if A.shape[-1] != self.n:
+        elif A.shape[-1] != self.n:
             raise ValueError(
                 f"rowwise apply needs A with {self.n} columns, got {A.shape}"
             )
+        if self.n * self.s > MAX_REALIZE_ELEMENTS:
+            if hasattr(A, "todense"):
+                raise UnsupportedError(
+                    f"dense sketch of a sparse input needs the full "
+                    f"({self.s}, {self.n}) Omega materialized "
+                    f"(> MAX_REALIZE_ELEMENTS); use an input-sparsity "
+                    f"sketch (CWT/SJLT) at this scale"
+                )
+            return self._apply_blocked(A, dim, dtype)
+        omega = self.realize(dtype)
+        if dim is Dimension.COLUMNWISE:
+            return _matmul(omega, A)
         return _matmul(A, omega.T)
+
+    def _apply_blocked(self, A, dim: Dimension, dtype):
+        """Panel-blocked apply: realize Omega in column panels along N and
+        accumulate — peak extra memory is one (S, panel) window.  Equal
+        panels run in a ``fori_loop`` (one traced body regardless of
+        panel count); a ragged remainder panel is handled outside."""
+        import jax
+        from jax import lax
+
+        panel = max(1, MAX_REALIZE_ELEMENTS // self.s)
+        nfull = self.n // panel
+        rem0 = nfull * panel
+        cw = dim is Dimension.COLUMNWISE
+        A = A.astype(dtype)
+        out_shape = (
+            (self.s,) + A.shape[1:] if cw else A.shape[:-1] + (self.s,)
+        )
+        acc = jnp.zeros(out_shape, dtype)
+
+        def body(p, acc):
+            p0 = p * panel
+            w = self.realize(dtype, offset=(0, p0), shape=(self.s, panel))
+            if cw:
+                blk = lax.dynamic_slice_in_dim(A, p0, panel, axis=0)
+                return acc + _matmul(w, blk)
+            blk = lax.dynamic_slice_in_dim(A, p0, panel, axis=A.ndim - 1)
+            return acc + _matmul(blk, w.T)
+
+        if nfull:
+            acc = lax.fori_loop(0, nfull, body, acc)
+        if rem0 < self.n:
+            pc = self.n - rem0
+            w = self.realize(dtype, offset=(0, rem0), shape=(self.s, pc))
+            if cw:
+                acc = acc + _matmul(w, A[rem0:])
+            else:
+                acc = acc + _matmul(A[..., rem0:], w.T)
+        return acc
 
 
 def _matmul(x, y):

@@ -1,0 +1,125 @@
+"""shard_map sketch schedules + panel-blocked dense apply + linear CLI."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libskylark_tpu import SketchContext
+from libskylark_tpu.parallel import (
+    ROWS,
+    columnwise_sharded,
+    default_mesh,
+    make_mesh,
+    rowwise_sharded,
+    shard_rows,
+)
+from libskylark_tpu.sketch import CWT, JLT
+from libskylark_tpu.sketch import dense as dense_mod
+
+
+class TestShardMapSchedules:
+    def test_rowwise_communication_free_matches_local(self, rng):
+        n, s, m = 64, 16, 128
+        A = jnp.asarray(rng.standard_normal((m, n)))
+        mesh = default_mesh()
+        S = JLT(n, s, SketchContext(seed=1))
+        ref = S.apply(A, "rowwise")
+        out = rowwise_sharded(S, shard_rows(A, mesh), mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-10)
+
+    def test_rowwise_hash_sketch(self, rng):
+        n, s, m = 48, 12, 64
+        A = jnp.asarray(rng.standard_normal((m, n)))
+        mesh = default_mesh()
+        S = CWT(n, s, SketchContext(seed=2))
+        ref = S.apply(A, "rowwise")
+        out = rowwise_sharded(S, shard_rows(A, mesh), mesh)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-10)
+
+    def test_columnwise_psum_matches_local(self, rng):
+        n, s, m = 128, 32, 24
+        A = jnp.asarray(rng.standard_normal((n, m)))
+        mesh = default_mesh()
+        S = JLT(n, s, SketchContext(seed=3))
+        ref = S.apply(A, "columnwise")
+        out = columnwise_sharded(S, shard_rows(A, mesh), mesh)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-8, atol=1e-10
+        )
+
+    def test_columnwise_psum_scatter(self, rng):
+        n, s, m = 64, 32, 8
+        A = jnp.asarray(rng.standard_normal((n, m)))
+        mesh = default_mesh()
+        S = JLT(n, s, SketchContext(seed=4))
+        ref = S.apply(A, "columnwise")
+        out = columnwise_sharded(S, shard_rows(A, mesh), mesh, scatter=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-8, atol=1e-10
+        )
+
+
+class TestPanelBlockedApply:
+    def test_blocked_matches_unblocked(self, rng, monkeypatch):
+        n, s, m = 250, 32, 10  # 250 % panel != 0 -> exercises the remainder
+        A = jnp.asarray(rng.standard_normal((n, m)))
+        S = JLT(n, s, SketchContext(seed=5))
+        ref = S.apply(A, "columnwise")
+        ref_r = S.apply(A.T, "rowwise")  # references BEFORE forcing panels
+        monkeypatch.setattr(dense_mod, "MAX_REALIZE_ELEMENTS", 1024)
+        out = S.apply(A, "columnwise")
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-9, atol=1e-11
+        )
+        out_r = S.apply(A.T, "rowwise")
+        np.testing.assert_allclose(
+            np.asarray(out_r), np.asarray(ref_r), rtol=1e-9, atol=1e-11
+        )
+
+    def test_sparse_over_threshold_raises(self, rng, monkeypatch):
+        from jax.experimental import sparse as jsparse
+
+        from libskylark_tpu.utils.exceptions import UnsupportedError
+
+        monkeypatch.setattr(dense_mod, "MAX_REALIZE_ELEMENTS", 64)
+        S = JLT(32, 8, SketchContext(seed=7))
+        A = jsparse.BCOO.fromdense(jnp.eye(32))
+        with pytest.raises(UnsupportedError, match="CWT"):
+            S.apply(A, "columnwise")
+
+    def test_traced_offset_window_crosses_2_32(self):
+        # window_bits with base near 2^32: traced vs concrete offsets must
+        # agree bit-for-bit (the carry path).
+        from libskylark_tpu.core.random import window_bits
+
+        base = (1 << 32) - 64
+        hi_c, lo_c = window_bits(5, base, 1000, 0, 40, 3, 50)
+        off = jnp.asarray(40, jnp.uint32)
+        hi_t, lo_t = jax.jit(
+            lambda o: window_bits(5, base, 1000, 0, o, 3, 50)
+        )(off)
+        np.testing.assert_array_equal(np.asarray(hi_c), np.asarray(hi_t))
+        np.testing.assert_array_equal(np.asarray(lo_c), np.asarray(lo_t))
+
+    def test_blocked_jittable(self, rng, monkeypatch):
+        monkeypatch.setattr(dense_mod, "MAX_REALIZE_ELEMENTS", 512)
+        S = JLT(100, 16, SketchContext(seed=6))
+        A = jnp.asarray(rng.standard_normal((100, 4)))
+        out = jax.jit(lambda X: S.apply(X, "columnwise"))(A)
+        assert out.shape == (16, 4)
+
+
+class TestLinearCLI:
+    def test_solves(self, tmp_path, rng, capsys):
+        from libskylark_tpu.cli.linear import main
+        from libskylark_tpu.io import write_libsvm
+
+        A = rng.standard_normal((500, 10))
+        x_true = rng.standard_normal(10)
+        b = A @ x_true
+        write_libsvm(tmp_path / "p", A, b)
+        rc = main([str(tmp_path / "p"), "--solution", str(tmp_path / "x.npy")])
+        assert rc == 0
+        x = np.load(tmp_path / "x.npy")
+        np.testing.assert_allclose(x, x_true, rtol=1e-4, atol=1e-6)
