@@ -15,6 +15,9 @@ elementwise/gather — local.
 
 from __future__ import annotations
 
+import os
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -24,6 +27,13 @@ from .fut import RFUT
 from .sampling import UST
 
 __all__ = ["FJLT"]
+
+
+def _use_pallas() -> bool:
+    return (
+        os.environ.get("SKYLARK_NO_PALLAS", "0") != "1"
+        and jax.default_backend() == "tpu"
+    )
 
 
 @register_sketch
@@ -53,9 +63,33 @@ class FJLT(SketchTransform):
 
     def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
         dim = Dimension.of(dim)
+        if self._fut_name == "wht" and not hasattr(A, "todense"):
+            A2 = jnp.asarray(A)
+            if (
+                A2.ndim == 2
+                and dim is Dimension.ROWWISE
+                and A2.shape[1] == self.n
+                and _use_pallas()
+            ):
+                from . import pallas_fut
+
+                if pallas_fut.supported(A2.shape[0], self.n, self._nb):
+                    return self._apply_pallas(A2)
         T = self._rfut.apply(A, dim)
         scale = jnp.asarray(np.sqrt(self._nb / self.s), T.dtype)
         return scale * self._ust.apply(T, dim)
+
+    def _apply_pallas(self, A, interpret: bool = False):
+        """Fused one-pass D·x → WHT kernel (natural order, matching the
+        XLA path), then the usual sampled gather."""
+        from . import pallas_fut
+
+        if not jnp.issubdtype(A.dtype, jnp.floating):
+            A = A.astype(jnp.float32)
+        D = self._rfut.diagonal(A.dtype)
+        T = pallas_fut.rfut_rowwise(A, D, self._nb, interpret=interpret)
+        scale = jnp.asarray(np.sqrt(self._nb / self.s), T.dtype)
+        return scale * self._ust.apply(T, Dimension.ROWWISE)
 
     def _param_dict(self):
         return {"fut": self._fut_name}

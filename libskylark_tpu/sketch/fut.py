@@ -53,34 +53,42 @@ def wht(x, axis: int = 0):
 
     Sylvester (natural) ordering: row-major index factorization matches
     ``H = H_{f0} ⊗ H_{f1} ⊗ ...``, so the transform is a chain of small
-    dense contractions that XLA maps onto the MXU.
+    dense einsum contractions that XLA maps onto the MXU.  The factor
+    axes are expanded *in place* (no moveaxis of the whole array): for
+    multi-GB operands a front-transpose would cost two extra full HBM
+    passes per factor.
     """
     x = jnp.asarray(x)
+    axis = axis % x.ndim
     n = x.shape[axis]
     k = n.bit_length() - 1
     if n != (1 << k):
         raise ValueError(f"wht needs a power-of-2 size, got {n}")
     if n == 1:
         return x
-    # Split exponent k into chunks of <= _MAX_FACTOR_LOG2.
     chunks = []
     rem = k
     while rem > 0:
         c = min(rem, _MAX_FACTOR_LOG2)
         chunks.append(c)
         rem -= c
-    x = jnp.moveaxis(x, axis, 0)
-    rest = x.shape[1:]
     factors = [1 << c for c in chunks]
-    x = x.reshape(*factors, *rest)
-    for i, (c, f) in enumerate(zip(chunks, factors)):
+    lead = x.shape[:axis]
+    trail = x.shape[axis + 1 :]
+    x = x.reshape(*lead, *factors, *trail)
+    # Einsum letters: leading dims, factor dims, trailing dims.
+    nlead, nfac, ntrail = len(lead), len(factors), len(trail)
+    letters = "abcdefghijklmnopqrstuvw"
+    lead_l = letters[:nlead]
+    fac_l = letters[nlead : nlead + nfac]
+    trail_l = letters[nlead + nfac : nlead + nfac + ntrail]
+    for i, c in enumerate(chunks):
         H = jnp.asarray(_hadamard(c), x.dtype)
-        # Contract factor-dim i with H; tensordot moves it to the front.
-        x = jnp.tensordot(H, x, axes=[[1], [i]])
-        # Restore order: the new axis 0 belongs at position i.
-        x = jnp.moveaxis(x, 0, i)
-    x = x.reshape(n, *rest) * jnp.asarray(1.0 / np.sqrt(n), x.dtype)
-    return jnp.moveaxis(x, 0, axis)
+        in_sub = lead_l + fac_l + trail_l
+        out_sub = in_sub.replace(fac_l[i], "z")
+        x = jnp.einsum(f"{in_sub},z{fac_l[i]}->{out_sub}", x, H)
+    x = x.reshape(*lead, n, *trail)
+    return x * jnp.asarray(1.0 / np.sqrt(n), x.dtype)
 
 
 def dct(x, axis: int = 0):

@@ -263,3 +263,29 @@ def test_l2_embedding_preserves_singular_values(kind):
             ok = True
             break
     assert ok, f"{kind}: no repeat satisfied the 0.5 relative bound"
+
+
+class TestHashScatterFallback:
+    """The segment_sum path (production path for huge N*S) must stay
+    covered: force it by shrinking the one-hot threshold."""
+
+    def test_scatter_matches_onehot(self, rng, monkeypatch):
+        import jax.numpy as jnp
+        from libskylark_tpu import SketchContext
+        from libskylark_tpu.sketch import CWT, SJLT
+
+        A = jnp.asarray(rng.standard_normal((50, 20)))
+        for cls, kw in ((CWT, {}), (SJLT, {"nnz": 3})):
+            S = cls(50, 12, SketchContext(seed=9), **kw)
+            ref = S.apply(A, "columnwise")
+            ref_r = S.apply(A.T, "rowwise")
+            monkeypatch.setattr(cls, "_ONEHOT_LIMIT", 0)
+            out = S.apply(A, "columnwise")
+            out_r = S.apply(A.T, "rowwise")
+            monkeypatch.undo()
+            np.testing.assert_allclose(
+                np.asarray(out), np.asarray(ref), rtol=1e-10, atol=1e-12
+            )
+            np.testing.assert_allclose(
+                np.asarray(out_r), np.asarray(ref_r), rtol=1e-10, atol=1e-12
+            )
