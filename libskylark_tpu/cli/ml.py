@@ -42,6 +42,11 @@ def main(argv=None) -> int:
     p.add_argument("--seed", "-s", type=int, default=12345)
     p.add_argument("--sparse", action="store_true")
     p.add_argument("--x64", action="store_true")
+    p.add_argument("--outputfile", "-o", default=None,
+                   help="stream test predictions to this file (bounded "
+                        "memory; one prediction per line)")
+    p.add_argument("--batch", type=int, default=4096,
+                   help="streaming predict batch size")
     args = p.parse_args(argv)
 
     import jax
@@ -117,12 +122,44 @@ def main(argv=None) -> int:
         model.classes = load_classes(args.modelfile)
 
     if args.testfile:
-        from .common import print_test_metrics
-
         d = model.input_dim
-        Xt, yt = read_libsvm(args.testfile, n_features=d, sparse=args.sparse)
-        Xtj = Xt if args.sparse else jnp.asarray(Xt)
-        print_test_metrics(model, Xtj, yt, args.regression)
+        if args.outputfile:
+            # Streaming predict (≙ the reference's line-by-line predict IO).
+            from ..io import stream_libsvm
+
+            n_done = correct = 0
+            sq_err = sq_nrm = 0.0
+            with open(args.outputfile, "w") as out:
+                for Xb, yb in stream_libsvm(
+                    args.testfile, d, args.batch, sparse=args.sparse
+                ):
+                    if not args.sparse:
+                        Xb = jnp.asarray(Xb)
+                    if args.regression or getattr(model, "classes", None) is None:
+                        pred = np.asarray(model.predict(Xb))[:, 0]
+                        sq_err += float(np.sum((pred - yb) ** 2))
+                        sq_nrm += float(np.sum(yb**2))
+                    else:
+                        pred = np.asarray(
+                            model.predict_labels(Xb, model.classes)
+                        )
+                        correct += int((pred == yb).sum())
+                    n_done += len(yb)
+                    out.writelines(f"{v}\n" for v in pred)
+            if args.regression or getattr(model, "classes", None) is None:
+                print(f"Test relative error: "
+                      f"{(sq_err / max(sq_nrm, 1e-30)) ** 0.5:.4f} "
+                      f"({n_done} examples)")
+            else:
+                print(f"Test accuracy: {correct * 100.0 / max(n_done, 1):.2f}% "
+                      f"({n_done} examples)")
+            print(f"Predictions -> {args.outputfile}")
+        else:
+            from .common import print_test_metrics
+
+            Xt, yt = read_libsvm(args.testfile, n_features=d, sparse=args.sparse)
+            Xtj = Xt if args.sparse else jnp.asarray(Xt)
+            print_test_metrics(model, Xtj, yt, args.regression)
     return 0
 
 

@@ -1,6 +1,12 @@
 """Dataset IO (≙ reference ``ml/io.hpp``, ``utility/io/libsvm_io.hpp``)."""
 
 from .hdf5 import read_hdf5, write_hdf5
-from .libsvm import read_libsvm, write_libsvm
+from .libsvm import read_libsvm, stream_libsvm, write_libsvm
 
-__all__ = ["read_libsvm", "write_libsvm", "read_hdf5", "write_hdf5"]
+__all__ = [
+    "read_libsvm",
+    "write_libsvm",
+    "stream_libsvm",
+    "read_hdf5",
+    "write_hdf5",
+]

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["read_libsvm", "write_libsvm"]
+__all__ = ["read_libsvm", "write_libsvm", "stream_libsvm"]
 
 
 def read_libsvm(
@@ -94,6 +94,65 @@ def read_libsvm(
     X = np.zeros((n, d), dtype=dtype)
     X[rows_a, cols_a] = vals_a
     return X, y
+
+
+def stream_libsvm(
+    path, n_features: int, batch: int = 4096, sparse: bool = False,
+    dtype=np.float64,
+):
+    """Yield ``(X, y)`` batches of up to ``batch`` examples (dense ndarray,
+    or BCOO when ``sparse``).
+
+    ≙ the reference's streaming line-by-line predict IO (``ml/io.hpp``):
+    bounded memory for test files larger than RAM.
+    """
+    ridx: list[int] = []
+    cidx: list[int] = []
+    vals: list[float] = []
+    labels: list[float] = []
+
+    def flush():
+        n = len(labels)
+        y = np.asarray(labels, dtype=dtype)
+        if sparse:
+            from jax.experimental import sparse as jsparse
+            import jax.numpy as jnp
+
+            idx = np.stack(
+                [np.asarray(ridx), np.asarray(cidx)], axis=1
+            ).astype(np.int32) if ridx else np.zeros((0, 2), np.int32)
+            X = jsparse.BCOO(
+                (jnp.asarray(np.asarray(vals, dtype=dtype)), jnp.asarray(idx)),
+                shape=(n, n_features),
+            )
+        else:
+            X = np.zeros((n, n_features), dtype)
+            if ridx:
+                X[np.asarray(ridx), np.asarray(cidx)] = np.asarray(vals, dtype)
+        ridx.clear(); cidx.clear(); vals.clear(); labels.clear()
+        return X, y
+
+    with open(path, "r") as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            r = len(labels)
+            labels.append(float(parts[0]))
+            for tok in parts[1:]:
+                idx, val = tok.split(":", 1)
+                c = int(idx) - 1
+                if c < 0:
+                    raise ValueError(f"bad LIBSVM index {idx!r} (1-based)")
+                if c < n_features:
+                    ridx.append(r)
+                    cidx.append(c)
+                    vals.append(float(val))
+            if len(labels) >= batch:
+                yield flush()
+    if labels:
+        yield flush()
 
 
 def write_libsvm(path: str, X, y) -> None:

@@ -42,6 +42,7 @@ from jax.scipy.linalg import solve_triangular
 from ..core.params import Params
 from ..sketch.base import Dimension
 from ..solvers.prox import get_loss, get_regularizer
+from ..utils.timer import PhaseTimer
 from .coding import dummy_coding
 from .model import FeatureMapModel
 
@@ -127,16 +128,23 @@ class BlockADMMSolver:
         starts = np.cumsum([0] + sizes)
         D = int(starts[-1])
 
-        Zs = [self._apply_map(S, Xp, d) for S in self.maps]  # (P, sj, ni)
+        # Phase timers ≙ the reference's ADMM SKYLARK_TIMER instrumentation
+        # (transform/iteration/prediction, BlockADMM.hpp:357-365).
+        timer = PhaseTimer()
+        with timer.phase("transform") as ph:
+            Zs = [self._apply_map(S, Xp, d) for S in self.maps]  # (P, sj, ni)
+            ph.result = Zs
         # Cached Cholesky of Z·Zᵀ + I per (partition, block)
         # (≙ Cache[j] = inv(Z·Zᵀ + I), BlockADMM.hpp:437-441).
-        Ls = [
-            jnp.linalg.cholesky(
-                jnp.einsum("pst,put->psu", Z, Z)
-                + jnp.eye(Z.shape[1], dtype=dtype)
-            )
-            for Z in Zs
-        ]
+        with timer.phase("factor") as ph:
+            Ls = [
+                jnp.linalg.cholesky(
+                    jnp.einsum("pst,put->psu", Z, Z)
+                    + jnp.eye(Z.shape[1], dtype=dtype)
+                )
+                for Z in Zs
+            ]
+            ph.result = Ls
 
         rho = jnp.asarray(p.rho, dtype)
         lam = jnp.asarray(p.lam, dtype)
@@ -213,28 +221,32 @@ class BlockADMMSolver:
 
         history, val_history = [], []
         for it in range(1, p.maxiter + 1):
-            state = step(state)
-            obj = float(state[-1])
+            with timer.phase("iteration"):
+                state = step(state)
+                obj = float(state[-1])  # readback syncs the step
             history.append(obj)
             msg = f"iteration {it} objective {obj:.6e}"
             if have_val:
-                interim = FeatureMapModel(
-                    self.maps, state[0], scale_maps=p.scale_maps, input_dim=d
-                )
-                if regression:
-                    pv = np.asarray(interim.predict(Xv))[:, 0]
-                    metric = float(
-                        np.linalg.norm(pv - Yv)
-                        / max(np.linalg.norm(Yv), 1e-30)
+                with timer.phase("prediction") as ph:
+                    interim = FeatureMapModel(
+                        self.maps, state[0], scale_maps=p.scale_maps,
+                        input_dim=d,
                     )
-                    msg += f" val relerr {metric:.4f}"
-                else:
-                    pv = np.asarray(interim.predict_labels(Xv, classes))
-                    metric = float((pv == Yv).mean()) * 100
-                    msg += f" val accuracy {metric:.2f}"
+                    if regression:
+                        pv = np.asarray(interim.predict(Xv))[:, 0]
+                        metric = float(
+                            np.linalg.norm(pv - Yv)
+                            / max(np.linalg.norm(Yv), 1e-30)
+                        )
+                        msg += f" val relerr {metric:.4f}"
+                    else:
+                        pv = np.asarray(interim.predict_labels(Xv, classes))
+                        metric = float((pv == Yv).mean()) * 100
+                        msg += f" val accuracy {metric:.2f}"
                 val_history.append(metric)
             p.log(1, msg)
 
+        p.log(2, timer.report())
         Wbar = state[0]
         model = FeatureMapModel(
             self.maps, Wbar, scale_maps=p.scale_maps, input_dim=d
@@ -242,4 +254,5 @@ class BlockADMMSolver:
         model.classes = classes
         model.history = history
         model.val_history = val_history
+        model.timers = timer
         return model

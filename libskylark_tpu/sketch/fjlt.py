@@ -69,6 +69,7 @@ class FJLT(SketchTransform):
                 A2.ndim == 2
                 and dim is Dimension.ROWWISE
                 and A2.shape[1] == self.n
+                and A2.dtype in (jnp.float32, jnp.bfloat16)
                 and _use_pallas()
             ):
                 from . import pallas_fut

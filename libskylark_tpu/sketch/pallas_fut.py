@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .fut import _hadamard
+
 __all__ = ["rfut_rowwise", "supported"]
 
 _F2 = 256  # minor factor (lane-multiple; 256² H keeps the MXU busy)
@@ -55,13 +57,6 @@ def supported(m: int, n: int, nb: int) -> bool:
     if nb != (1 << k) or nb < 2 * _F2 or nb > (1 << 15):
         return False
     return _tile_rows(m, nb) is not None
-
-
-def _hadamard(k: int) -> np.ndarray:
-    H = np.array([[1.0]])
-    for _ in range(k):
-        H = np.block([[H, H], [H, -H]])
-    return H
 
 
 def _butterfly_kron_eye(x, f1: int):

@@ -110,3 +110,69 @@ class TestGraftEntry:
         import __graft_entry__ as g
 
         g.dryrun_multichip(8)
+
+
+class TestStreamingPredict:
+    def test_streaming_matches_batch(self, blob_files, capsys):
+        from libskylark_tpu.cli.ml import main
+
+        main([
+            "--trainfile", str(blob_files / "train"),
+            "--modelfile", str(blob_files / "sp.json"),
+            "-l", "squared", "-g", "2.0", "-f", "128", "-n", "2", "-i", "15",
+        ])
+        capsys.readouterr()
+        rc = main([
+            "--testfile", str(blob_files / "test"),
+            "--modelfile", str(blob_files / "sp.json"),
+            "--outputfile", str(blob_files / "preds.txt"),
+            "--batch", "5",
+        ])
+        assert rc == 0
+        out = capsys.readouterr().out
+        acc_stream = float(out.split("Test accuracy:")[1].split("%")[0])
+        preds = (blob_files / "preds.txt").read_text().splitlines()
+        assert len(preds) == 16
+        rc = main([
+            "--testfile", str(blob_files / "test"),
+            "--modelfile", str(blob_files / "sp.json"),
+        ])
+        out = capsys.readouterr().out
+        acc_batch = float(out.split("Test accuracy:")[1].split("%")[0])
+        assert acc_stream == acc_batch
+
+
+class TestStreamLibsvm:
+    def test_batches_cover_file(self, tmp_path, rng):
+        import numpy as np
+
+        from libskylark_tpu.io import read_libsvm, stream_libsvm, write_libsvm
+
+        X = rng.standard_normal((23, 6))
+        y = rng.standard_normal(23)
+        write_libsvm(tmp_path / "s", X, y)
+        chunks = list(stream_libsvm(tmp_path / "s", 6, batch=7))
+        assert [len(c[1]) for c in chunks] == [7, 7, 7, 2]
+        Xall = np.vstack([c[0] for c in chunks])
+        yall = np.concatenate([c[1] for c in chunks])
+        Xr, yr = read_libsvm(tmp_path / "s", n_features=6)
+        np.testing.assert_allclose(Xall, Xr, rtol=1e-15)
+        np.testing.assert_allclose(yall, yr, rtol=1e-15)
+
+
+class TestStreamLibsvmSparse:
+    def test_sparse_batches_match_dense(self, tmp_path, rng):
+        import numpy as np
+
+        from libskylark_tpu.io import stream_libsvm, write_libsvm
+
+        X = rng.standard_normal((17, 8))
+        X[rng.random((17, 8)) < 0.6] = 0
+        y = rng.standard_normal(17)
+        write_libsvm(tmp_path / "sp", X, y)
+        dense = list(stream_libsvm(tmp_path / "sp", 8, batch=6))
+        sparse = list(stream_libsvm(tmp_path / "sp", 8, batch=6, sparse=True))
+        assert len(dense) == len(sparse) == 3
+        for (Xd, yd), (Xs, ys) in zip(dense, sparse):
+            np.testing.assert_allclose(np.asarray(Xs.todense()), Xd, rtol=1e-15)
+            np.testing.assert_allclose(ys, yd)
