@@ -136,7 +136,8 @@ def main(argv=None) -> int:
                     if not args.sparse:
                         Xb = jnp.asarray(Xb)
                     if args.regression or getattr(model, "classes", None) is None:
-                        pred = np.asarray(model.predict(Xb))[:, 0]
+                        pred = np.asarray(model.predict(Xb))
+                        pred = pred[:, 0] if pred.ndim > 1 else pred
                         sq_err += float(np.sum((pred - yb) ** 2))
                         sq_nrm += float(np.sum(yb**2))
                     else:

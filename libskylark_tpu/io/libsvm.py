@@ -56,24 +56,10 @@ def read_libsvm(
         rows: list[int] = []
         cols: list[int] = []
         vals: list[float] = []
-        max_col = 0
         with open(path, "r") as f:
             for line in f:
-                line = line.split("#", 1)[0].strip()
-                if not line:
-                    continue
-                parts = line.split()
-                labels.append(float(parts[0]))
-                r = len(labels) - 1
-                for tok in parts[1:]:
-                    idx, val = tok.split(":", 1)
-                    c = int(idx) - 1
-                    if c < 0:
-                        raise ValueError(f"bad LIBSVM index {idx!r} (1-based)")
-                    max_col = max(max_col, c + 1)
-                    rows.append(r)
-                    cols.append(c)
-                    vals.append(float(val))
+                _parse_line(line, labels, rows, cols, vals)
+        max_col = max(cols) + 1 if cols else 0
         n = len(labels)
         d = n_features if n_features is not None else max_col
         y = np.asarray(labels, dtype=dtype)
@@ -96,63 +82,121 @@ def read_libsvm(
     return X, y
 
 
+def _parse_line(line, labels, rows, cols, vals) -> None:
+    """Parse one LIBSVM line into the accumulator lists (shared by the
+    batch reader's Python fallback and the streaming reader)."""
+    line = line.split("#", 1)[0].strip()
+    if not line:
+        return
+    parts = line.split()
+    labels.append(float(parts[0]))
+    r = len(labels) - 1
+    for tok in parts[1:]:
+        idx, val = tok.split(":", 1)
+        c = int(idx) - 1
+        if c < 0:
+            raise ValueError(f"bad LIBSVM index {idx!r} (1-based)")
+        rows.append(r)
+        cols.append(c)
+        vals.append(float(val))
+
+
+def _assemble_batch(labels, rows, cols, vals, n_features, sparse, dtype):
+    """(labels, triplet arrays with batch-local rows) → (X, y)."""
+    n = len(labels)
+    y = np.asarray(labels, dtype=dtype)
+    rows = np.asarray(rows, dtype=np.int64)
+    cols = np.asarray(cols, dtype=np.int64)
+    vals = np.asarray(vals, dtype=dtype)
+    keep = cols < n_features
+    rows, cols, vals = rows[keep], cols[keep], vals[keep]
+    if sparse:
+        from jax.experimental import sparse as jsparse
+        import jax.numpy as jnp
+
+        idx = np.stack([rows, cols], axis=1).astype(np.int32)
+        X = jsparse.BCOO(
+            (jnp.asarray(vals), jnp.asarray(idx)), shape=(n, n_features)
+        )
+        return X, y
+    X = np.zeros((n, n_features), dtype)
+    X[rows, cols] = vals
+    return X, y
+
+
 def stream_libsvm(
     path, n_features: int, batch: int = 4096, sparse: bool = False,
-    dtype=np.float64,
+    dtype=np.float64, chunk_bytes: int = 8 << 20,
 ):
     """Yield ``(X, y)`` batches of up to ``batch`` examples (dense ndarray,
     or BCOO when ``sparse``).
 
     ≙ the reference's streaming line-by-line predict IO (``ml/io.hpp``):
-    bounded memory for test files larger than RAM.
+    bounded memory for test files larger than RAM.  Byte chunks go through
+    the native multithreaded parser when built; the pure-Python per-line
+    parser is the fallback.
     """
-    ridx: list[int] = []
-    cidx: list[int] = []
-    vals: list[float] = []
-    labels: list[float] = []
+    from .. import native
 
-    def flush():
-        n = len(labels)
-        y = np.asarray(labels, dtype=dtype)
-        if sparse:
-            from jax.experimental import sparse as jsparse
-            import jax.numpy as jnp
+    use_native = native.available()
+    # Pending examples carried across chunks until a full batch exists.
+    p_labels: list = []
+    p_rows: list = []
+    p_cols: list = []
+    p_vals: list = []
 
-            idx = np.stack(
-                [np.asarray(ridx), np.asarray(cidx)], axis=1
-            ).astype(np.int32) if ridx else np.zeros((0, 2), np.int32)
-            X = jsparse.BCOO(
-                (jnp.asarray(np.asarray(vals, dtype=dtype)), jnp.asarray(idx)),
-                shape=(n, n_features),
+    def emit_full():
+        while len(p_labels) >= batch:
+            cut = batch
+            rows = np.asarray(p_rows, dtype=np.int64)
+            split = int(np.searchsorted(rows, cut))
+            yield _assemble_batch(
+                p_labels[:cut], rows[:split], p_cols[:split], p_vals[:split],
+                n_features, sparse, dtype,
             )
-        else:
-            X = np.zeros((n, n_features), dtype)
-            if ridx:
-                X[np.asarray(ridx), np.asarray(cidx)] = np.asarray(vals, dtype)
-        ridx.clear(); cidx.clear(); vals.clear(); labels.clear()
-        return X, y
+            del p_labels[:cut]
+            remaining = rows[split:] - cut
+            p_rows[:] = remaining.tolist()
+            del p_cols[:split]
+            del p_vals[:split]
 
-    with open(path, "r") as f:
-        for line in f:
-            line = line.split("#", 1)[0].strip()
-            if not line:
-                continue
-            parts = line.split()
-            r = len(labels)
-            labels.append(float(parts[0]))
-            for tok in parts[1:]:
-                idx, val = tok.split(":", 1)
-                c = int(idx) - 1
-                if c < 0:
-                    raise ValueError(f"bad LIBSVM index {idx!r} (1-based)")
-                if c < n_features:
-                    ridx.append(r)
-                    cidx.append(c)
-                    vals.append(float(val))
-            if len(labels) >= batch:
-                yield flush()
-    if labels:
-        yield flush()
+    if use_native:
+        with open(path, "rb") as f:
+            carry = b""
+            while True:
+                data = f.read(chunk_bytes)
+                block = carry + data
+                if not block:
+                    break
+                if data:
+                    cut = block.rfind(b"\n")
+                    if cut < 0:
+                        carry = block
+                        continue
+                    carry, block = block[cut + 1 :], block[: cut + 1]
+                else:
+                    carry = b""
+                labels, rows, cols, vals, _ = native.parse_libsvm_bytes(block)
+                base = len(p_labels)
+                p_labels.extend(labels.tolist())
+                p_rows.extend((rows + base).tolist())
+                p_cols.extend(cols.tolist())
+                p_vals.extend(vals.tolist())
+                yield from emit_full()
+                if not data:
+                    break
+    else:
+        with open(path, "r") as f:
+            for line in f:
+                # _parse_line indexes rows by len(p_labels)-1, which is
+                # already the pending-local row id.
+                _parse_line(line, p_labels, p_rows, p_cols, p_vals)
+                if len(p_labels) >= batch:
+                    yield from emit_full()
+    if p_labels:
+        yield _assemble_batch(
+            p_labels, p_rows, p_cols, p_vals, n_features, sparse, dtype
+        )
 
 
 def write_libsvm(path: str, X, y) -> None:

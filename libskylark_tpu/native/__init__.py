@@ -4,6 +4,12 @@ Builds ``libskylark_native.so`` from ``src/skylark_native.cpp`` on first
 use (g++, cached by mtime) and exposes it through ctypes.  Everything
 degrades gracefully: ``available()`` is False when no compiler exists and
 all Python paths fall back to pure JAX/numpy.
+
+Precision note: the native core computes in float64, so it matches the
+JAX path bit-for-integer-draws and to ~1e-14 for transcendentals **when
+jax_enable_x64 is on**.  With x64 off, normal/cauchy/exp draws use the
+f32 bit constructions (docs/counter_contract.md) and are *different
+stream values* — by design, not drift.
 """
 
 from __future__ import annotations

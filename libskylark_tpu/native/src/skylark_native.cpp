@@ -144,16 +144,18 @@ struct sl_context_t {
 };
 
 enum sl_type_t { SL_JLT = 0, SL_CT = 1, SL_CWT = 2, SL_MMT = 3, SL_WZT = 4,
-                 SL_UST = 5 };
+                 SL_UST = 5, SL_FJLT = 6, SL_GRFT = 7, SL_LRFT = 8,
+                 SL_RLT = 9 };
 
 struct sl_sketch_t {
     int type;
     long n, s;
+    long nb;  // FJLT: padded pow2 size
     uint64_t seed;
     uint64_t ctx_counter;  // creation-time counter (serialization)
     // reserved counter bases
     uint64_t base0, base1, base2;
-    double param;  // CT: C, WZT: p, UST: replace (1/0)
+    double param;  // CT: C, WZT: p, UST: replace, RFT: sigma, RLT: beta
 };
 
 void* sl_create_context(uint64_t seed) {
@@ -174,12 +176,24 @@ static int sk_type_from_name(const char* name) {
     if (!strcmp(name, "MMT")) return SL_MMT;
     if (!strcmp(name, "WZT")) return SL_WZT;
     if (!strcmp(name, "UST")) return SL_UST;
+    if (!strcmp(name, "FJLT")) return SL_FJLT;
+    if (!strcmp(name, "GaussianRFT")) return SL_GRFT;
+    if (!strcmp(name, "LaplacianRFT")) return SL_LRFT;
+    if (!strcmp(name, "ExpSemigroupRLT")) return SL_RLT;
     return -1;
 }
 
 static const char* sk_name_from_type(int t) {
-    static const char* names[6] = {"JLT", "CT", "CWT", "MMT", "WZT", "UST"};
-    return (t >= 0 && t < 6) ? names[t] : "?";
+    static const char* names[10] = {"JLT", "CT", "CWT", "MMT", "WZT", "UST",
+                                    "FJLT", "GaussianRFT", "LaplacianRFT",
+                                    "ExpSemigroupRLT"};
+    return (t >= 0 && t < 10) ? names[t] : "?";
+}
+
+static long sk_next_pow2(long n) {
+    long p = 1;
+    while (p < n) p *= 2;
+    return p;
 }
 
 // Reservation schedule mirrors the Python classes exactly.
@@ -204,6 +218,22 @@ static void sk_reserve(sl_sketch_t* t, sl_context_t* ctx) {
             t->base0 = ctx->counter;
             ctx->counter += (t->param != 0.0) ? t->s : t->n;
             break;
+        case SL_FJLT:
+            // RFUT diagonal (N), then UST(replace) samples (S).
+            t->base0 = ctx->counter; ctx->counter += t->n;
+            t->base1 = ctx->counter; ctx->counter += t->s;
+            break;
+        case SL_GRFT:
+        case SL_LRFT:
+            // dense W (N·S), then shifts (S) — ≙ RFT_data_t::build.
+            t->base0 = ctx->counter;
+            ctx->counter += (uint64_t)t->n * t->s;
+            t->base1 = ctx->counter; ctx->counter += t->s;
+            break;
+        case SL_RLT:
+            t->base0 = ctx->counter;
+            ctx->counter += (uint64_t)t->n * t->s;
+            break;
     }
 }
 
@@ -216,9 +246,12 @@ int sl_create_sketch_transform(void* ctx_, const char* type, long n, long s,
     t->type = ty;
     t->n = n;
     t->s = s;
+    t->nb = (ty == SL_FJLT) ? sk_next_pow2(n) : n;
     t->seed = ctx->seed;
     t->ctx_counter = ctx->counter;
     t->param = param;
+    if ((ty == SL_GRFT || ty == SL_LRFT) && param == 0.0) t->param = 1.0;
+    if (ty == SL_RLT && param == 0.0) t->param = 1.0;
     if (ty == SL_UST && param == 0.0 && s > n) { delete t; return 102; }
     sk_reserve(t, ctx);
     *out = t;
@@ -316,6 +349,95 @@ static void sk_apply_ust_cw(const sl_sketch_t* t, const double* A, long m,
         std::memcpy(out + i * m, A + idx[i] * m, sizeof(double) * m);
 }
 
+// In-place orthonormal FWHT over a length-nb (pow2) buffer, Sylvester
+// (natural) order — matches sketch/fut.py wht().
+static void sk_fwht(double* x, long nb) {
+    for (long h = 1; h < nb; h *= 2)
+        for (long i = 0; i < nb; i += 2 * h)
+            for (long j = i; j < i + h; j++) {
+                double a = x[j], b = x[j + h];
+                x[j] = a + b;
+                x[j + h] = a - b;
+            }
+    double scale = 1.0 / std::sqrt((double)nb);
+    for (long i = 0; i < nb; i++) x[i] *= scale;
+}
+
+// FJLT columnwise: out (s, m) = sqrt(nb/s) · sample(H·(D ⊙ A)) per column.
+static void sk_apply_fjlt_cw(const sl_sketch_t* t, const double* A, long m,
+                             double* out) {
+    const long n = t->n, nb = t->nb, s = t->s;
+    std::vector<double> D(n);
+    std::vector<long> samples(s);
+    for (long i = 0; i < n; i++) {
+        uint32_t hi, lo;
+        sk_bits(t->seed, 0, t->base0 + (uint64_t)i, &hi, &lo);
+        D[i] = (lo & 1u) ? 1.0 : -1.0;
+    }
+    for (long i = 0; i < s; i++) {
+        uint32_t hi, lo;
+        sk_bits(t->seed, 0, t->base1 + (uint64_t)i, &hi, &lo);
+        samples[i] = (long)sk_uniform_int(hi, lo, 0, (uint32_t)(nb - 1));
+    }
+    const double scale = std::sqrt((double)nb / (double)s);
+#pragma omp parallel
+    {
+        std::vector<double> work(nb);
+#pragma omp for schedule(static)
+        for (long c = 0; c < m; c++) {
+            for (long i = 0; i < n; i++) work[i] = D[i] * A[i * m + c];
+            std::fill(work.begin() + n, work.end(), 0.0);
+            sk_fwht(work.data(), nb);
+            for (long i = 0; i < s; i++)
+                out[i * m + c] = scale * work[samples[i]];
+        }
+    }
+}
+
+// RFT columnwise: out = outscale·cos(inscale·(W·A) + shift); W normal
+// (Gaussian) or cauchy (Laplacian).  RLT: out = outscale·exp(−inscale·W·A)
+// with W ~ Lévy.  ≙ RFT_Elemental.hpp:85-120 / RLT_Elemental.hpp:77.
+static void sk_apply_rft_cw(const sl_sketch_t* t, const double* A, long m,
+                            double* out) {
+    const long n = t->n, s = t->s;
+    const bool rlt = t->type == SL_RLT;  // rlt branch never reads dist
+    const int dist =
+        (t->type == SL_LRFT) ? SK_DIST_CAUCHY : SK_DIST_NORMAL;
+    const double inscale =
+        rlt ? (t->param * t->param / 2.0) : (1.0 / t->param);
+    const double outscale =
+        rlt ? std::sqrt(1.0 / (double)s) : std::sqrt(2.0 / (double)s);
+#pragma omp parallel for schedule(static)
+    for (long i = 0; i < s; i++) {
+        double* orow = out + i * m;
+        for (long c = 0; c < m; c++) orow[c] = 0.0;
+        for (long j = 0; j < n; j++) {
+            uint32_t hi, lo;
+            sk_bits(t->seed, 0, t->base0 + (uint64_t)(i * n + j), &hi, &lo);
+            double w;
+            if (rlt) {
+                double z = sk_normal(hi, lo);
+                w = 1.0 / (z * z);  // standard Lévy = 1/Z²
+            } else {
+                w = sk_draw(dist, hi, lo);
+            }
+            w *= inscale;
+            const double* arow = A + j * m;
+            for (long c = 0; c < m; c++) orow[c] += w * arow[c];
+        }
+        if (rlt) {
+            for (long c = 0; c < m; c++)
+                orow[c] = outscale * std::exp(-orow[c]);
+        } else {
+            uint32_t hi, lo;
+            sk_bits(t->seed, 0, t->base1 + (uint64_t)i, &hi, &lo);
+            double shift = sk_uniform01(hi, lo) * 2.0 * M_PI;
+            for (long c = 0; c < m; c++)
+                orow[c] = outscale * std::cos(orow[c] + shift);
+        }
+    }
+}
+
 // dim: 0 = columnwise (A (n, m) -> (s, m)), 1 = rowwise (A (m, n) -> (m, s)).
 int sl_apply_sketch_transform(void* t_, const double* A, long rows, long cols,
                               int dim, double* out) {
@@ -325,6 +447,9 @@ int sl_apply_sketch_transform(void* t_, const double* A, long rows, long cols,
         switch (t->type) {
             case SL_JLT: case SL_CT: sk_apply_dense_cw(t, A, cols, out); break;
             case SL_UST: sk_apply_ust_cw(t, A, cols, out); break;
+            case SL_FJLT: sk_apply_fjlt_cw(t, A, cols, out); break;
+            case SL_GRFT: case SL_LRFT: case SL_RLT:
+                sk_apply_rft_cw(t, A, cols, out); break;
             default: sk_apply_hash_cw(t, A, cols, out); break;
         }
         return 0;
@@ -354,6 +479,12 @@ int sl_serialize_sketch_transform(void* t_, char** out) {
     else if (t->type == SL_UST)
         snprintf(extra, sizeof extra, ", \"replace\": %s",
                  t->param != 0.0 ? "true" : "false");
+    else if (t->type == SL_FJLT)
+        snprintf(extra, sizeof extra, ", \"fut\": \"wht\"");
+    else if (t->type == SL_GRFT || t->type == SL_LRFT)
+        snprintf(extra, sizeof extra, ", \"sigma\": %.17g", t->param);
+    else if (t->type == SL_RLT)
+        snprintf(extra, sizeof extra, ", \"beta\": %.17g", t->param);
     char* buf = (char*)malloc(512);
     snprintf(buf, 512,
              "{\"skylark_object_type\": \"sketch\", \"skylark_version\": 1, "
@@ -423,6 +554,17 @@ int sl_deserialize_sketch_transform(const char* json, void** out) {
     else if (!strcmp(type, "WZT")) { js_find_num(norm.c_str(), "P", &param); if (param == 0) param = 2.0; }
     else if (!strcmp(type, "UST")) {
         param = strstr(norm.c_str(), "\"replace\":false") ? 0.0 : 1.0;
+    }
+    else if (!strcmp(type, "GaussianRFT") || !strcmp(type, "LaplacianRFT")) {
+        js_find_num(norm.c_str(), "sigma", &param);
+        if (param == 0) param = 1.0;
+    }
+    else if (!strcmp(type, "ExpSemigroupRLT")) {
+        js_find_num(norm.c_str(), "beta", &param);
+        if (param == 0) param = 1.0;
+    }
+    else if (!strcmp(type, "FJLT")) {
+        if (strstr(norm.c_str(), "\"fut\":\"dct\"")) return 104;  // wht only
     }
     sl_context_t ctx{seed, counter};
     return sl_create_sketch_transform(&ctx, type, (long)n, (long)s, param, out);

@@ -1,6 +1,7 @@
 """Utility subsystems (≙ reference ``utility/`` + ``base/exception.hpp``):
 phase timers, exceptions, solver checkpointing."""
 
+from . import profiling
 from .checkpoint import load_solver_state, save_solver_state
 from .exceptions import (
     AllocationError,
@@ -13,6 +14,7 @@ from .exceptions import (
 from .timer import PhaseTimer, timer_report
 
 __all__ = [
+    "profiling",
     "PhaseTimer",
     "timer_report",
     "SkylarkError",

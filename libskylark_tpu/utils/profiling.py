@@ -1,0 +1,37 @@
+"""Device-level tracing (the TPU replacement for the reference's
+compile-time profiler macros, SURVEY §5: "jax.profiler traces + per-phase
+wall timers").
+
+``PhaseTimer`` (``utils.timer``) covers the wall-clock side; this module
+wraps ``jax.profiler`` for op-level traces viewable in XProf/TensorBoard.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+import jax
+
+__all__ = ["trace", "annotate"]
+
+
+@contextmanager
+def trace(logdir: str):
+    """Capture a device trace into ``logdir`` (open with xprof/TensorBoard).
+
+    Usage::
+
+        with profiling.trace("/tmp/skylark-trace"):
+            model = solver.train(X, y)
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region inside a trace (≙ the reference's per-phase timer
+    labels); usable as decorator or context manager."""
+    return jax.profiler.TraceAnnotation(name)

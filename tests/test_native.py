@@ -151,3 +151,65 @@ class TestLibsvmParser:
         # row indices must be globally consistent (file order)
         assert rows[0] == 0 and rows[-1] == 4999
         np.testing.assert_allclose(labels[:3], [0, 1, 2])
+
+
+class TestExtendedNativeTypes:
+    """FJLT / RFT / RLT native applies match the JAX path."""
+
+    def test_fjlt_matches_python(self, rng):
+        from libskylark_tpu.sketch import FJLT
+
+        n, s, m = 100, 24, 6  # pads to nb=128
+        A = rng.standard_normal((n, m))
+        nctx = native.NativeContext(21)
+        ns = native.NativeSketch.create(nctx, "FJLT", n, s)
+        ps = FJLT(n, s, SketchContext(seed=21))
+        np.testing.assert_allclose(
+            ns.apply(A), np.asarray(ps.apply(A, "columnwise")),
+            rtol=1e-9, atol=1e-11,
+        )
+        pctx = SketchContext(seed=21)
+        FJLT(n, s, pctx)
+        assert nctx.counter == pctx.counter
+
+    @pytest.mark.parametrize("stype,pname,param", [
+        ("GaussianRFT", "GaussianRFT", 2.5),
+        ("LaplacianRFT", "LaplacianRFT", 1.5),
+    ])
+    def test_rft_matches_python(self, rng, stype, pname, param):
+        import libskylark_tpu.sketch as sk
+
+        n, s, m = 30, 16, 5
+        A = rng.standard_normal((n, m))
+        nctx = native.NativeContext(22)
+        ns = native.NativeSketch.create(nctx, stype, n, s, param)
+        ps = getattr(sk, pname)(n, s, SketchContext(seed=22), sigma=param)
+        np.testing.assert_allclose(
+            ns.apply(A), np.asarray(ps.apply(A, "columnwise")),
+            rtol=1e-8, atol=1e-10,
+        )
+
+    def test_rlt_matches_python(self, rng):
+        from libskylark_tpu.sketch import ExpSemigroupRLT
+
+        n, s, m = 20, 12, 4
+        A = rng.random((n, m))  # histograms: nonnegative
+        nctx = native.NativeContext(23)
+        ns = native.NativeSketch.create(nctx, "ExpSemigroupRLT", n, s, 0.4)
+        ps = ExpSemigroupRLT(n, s, SketchContext(seed=23), beta=0.4)
+        np.testing.assert_allclose(
+            ns.apply(A), np.asarray(ps.apply(A, "columnwise")),
+            rtol=1e-8, atol=1e-10,
+        )
+
+    def test_extended_serialization_roundtrip(self, rng):
+        from libskylark_tpu.sketch import from_json
+
+        A = rng.standard_normal((50, 3))
+        nctx = native.NativeContext(24)
+        ns = native.NativeSketch.create(nctx, "GaussianRFT", 50, 8, 3.0)
+        ps = from_json(ns.to_json())
+        np.testing.assert_allclose(
+            ns.apply(A), np.asarray(ps.apply(A, "columnwise")),
+            rtol=1e-8, atol=1e-10,
+        )
