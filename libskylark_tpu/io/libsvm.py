@@ -138,64 +138,72 @@ def stream_libsvm(
     """
     from .. import native
 
-    use_native = native.available()
-    # Pending examples carried across chunks until a full batch exists.
-    p_labels: list = []
-    p_rows: list = []
-    p_cols: list = []
-    p_vals: list = []
-
-    def emit_full():
-        while len(p_labels) >= batch:
-            cut = batch
-            rows = np.asarray(p_rows, dtype=np.int64)
-            split = int(np.searchsorted(rows, cut))
-            yield _assemble_batch(
-                p_labels[:cut], rows[:split], p_cols[:split], p_vals[:split],
-                n_features, sparse, dtype,
-            )
-            del p_labels[:cut]
-            remaining = rows[split:] - cut
-            p_rows[:] = remaining.tolist()
-            del p_cols[:split]
-            del p_vals[:split]
-
-    if use_native:
-        with open(path, "rb") as f:
-            carry = b""
-            while True:
-                data = f.read(chunk_bytes)
-                block = carry + data
-                if not block:
-                    break
-                if data:
-                    cut = block.rfind(b"\n")
-                    if cut < 0:
-                        carry = block
-                        continue
-                    carry, block = block[cut + 1 :], block[: cut + 1]
-                else:
-                    carry = b""
+    def parse_chunk(block: bytes):
+        """Parse a newline-aligned byte chunk → numpy arrays.  The native
+        parser is preferred; malformed chunks re-parse through the strict
+        Python path so the exception type (ValueError) matches
+        read_libsvm regardless of whether the .so is built."""
+        if native.available():
+            try:
                 labels, rows, cols, vals, _ = native.parse_libsvm_bytes(block)
-                base = len(p_labels)
-                p_labels.extend(labels.tolist())
-                p_rows.extend((rows + base).tolist())
-                p_cols.extend(cols.tolist())
-                p_vals.extend(vals.tolist())
-                yield from emit_full()
-                if not data:
-                    break
-    else:
-        with open(path, "r") as f:
-            for line in f:
-                # _parse_line indexes rows by len(p_labels)-1, which is
-                # already the pending-local row id.
-                _parse_line(line, p_labels, p_rows, p_cols, p_vals)
-                if len(p_labels) >= batch:
-                    yield from emit_full()
-    if p_labels:
+                return labels, rows, cols, vals
+            except ValueError:
+                raise
+            except Exception:
+                pass
+        l: list = []
+        r: list = []
+        c: list = []
+        v: list = []
+        for line in block.decode().splitlines():
+            _parse_line(line, l, r, c, v)
+        return (
+            np.asarray(l, np.float64),
+            np.asarray(r, np.int64),
+            np.asarray(c, np.int64),
+            np.asarray(v, np.float64),
+        )
+
+    # Pending examples carried across chunks, kept as numpy arrays (no
+    # per-element Python boxing on the hot path).
+    p_lab = np.empty(0, np.float64)
+    p_rows = np.empty(0, np.int64)
+    p_cols = np.empty(0, np.int64)
+    p_vals = np.empty(0, np.float64)
+
+    with open(path, "rb") as f:
+        carry = b""
+        eof = False
+        while not eof:
+            data = f.read(chunk_bytes)
+            eof = not data
+            block = carry + data
+            carry = b""
+            if not eof:
+                cut = block.rfind(b"\n")
+                if cut < 0:
+                    carry = block
+                    continue
+                carry, block = block[cut + 1 :], block[: cut + 1]
+            if block:
+                labels, rows, cols, vals = parse_chunk(block)
+                p_rows = np.concatenate([p_rows, rows + len(p_lab)])
+                p_lab = np.concatenate([p_lab, labels])
+                p_cols = np.concatenate([p_cols, cols])
+                p_vals = np.concatenate([p_vals, vals])
+            while len(p_lab) >= batch:
+                split = int(np.searchsorted(p_rows, batch))
+                yield _assemble_batch(
+                    p_lab[:batch], p_rows[:split], p_cols[:split],
+                    p_vals[:split], n_features, sparse, dtype,
+                )
+                p_lab = p_lab[batch:]
+                p_rows = p_rows[split:] - batch
+                p_cols = p_cols[split:]
+                p_vals = p_vals[split:]
+    if len(p_lab):
         yield _assemble_batch(
-            p_labels, p_rows, p_cols, p_vals, n_features, sparse, dtype
+            p_lab, p_rows, p_cols, p_vals, n_features, sparse, dtype
         )
 
 

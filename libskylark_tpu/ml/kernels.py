@@ -43,9 +43,31 @@ def _sqdist(X, Y):
     return jnp.maximum(xx + yy - 2.0 * (X @ Y.T), 0.0)
 
 
+# Broadcast intermediates above this many elements are computed in row
+# blocks (the reference's base/distance.hpp does the full O(n·m·d) loop;
+# blocking keeps peak memory to one (B, m, d) slab).
+_PAIRWISE_LIMIT = 1 << 27
+
+
+def _blocked_rows(pair_fn, X, Y):
+    n, d = X.shape
+    m = Y.shape[0]
+    if n * m * d <= _PAIRWISE_LIMIT:
+        return pair_fn(X, Y)
+    block = max(1, _PAIRWISE_LIMIT // max(m * d, 1))
+    outs = [
+        pair_fn(X[i : i + block], Y) for i in range(0, n, block)
+    ]
+    return jnp.concatenate(outs, axis=0)
+
+
 def _l1dist(X, Y):
-    """Pairwise L1 distances (broadcast; O(n·m·d) like base/distance.hpp)."""
-    return jnp.sum(jnp.abs(X[:, None, :] - Y[None, :, :]), axis=-1)
+    """Pairwise L1 distances (row-blocked broadcast)."""
+    return _blocked_rows(
+        lambda a, b: jnp.sum(jnp.abs(a[:, None, :] - b[None, :, :]), axis=-1),
+        X,
+        Y,
+    )
 
 
 class Kernel(abc.ABC):
@@ -196,7 +218,14 @@ class ExpSemigroupKernel(Kernel):
 
     def gram(self, X, Y=None):
         Y = X if Y is None else Y
-        s = jnp.sum(jnp.sqrt(jnp.maximum(X[:, None, :] + Y[None, :, :], 0.0)), axis=-1)
+        s = _blocked_rows(
+            lambda a, b: jnp.sum(
+                jnp.sqrt(jnp.maximum(a[:, None, :] + b[None, :, :], 0.0)),
+                axis=-1,
+            ),
+            X,
+            Y,
+        )
         return jnp.exp(-self.beta * s)
 
     def create_rft(self, s, tag, context):
