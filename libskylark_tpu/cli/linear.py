@@ -20,6 +20,8 @@ def main(argv=None) -> int:
                    choices=["exact", "sketched", "accelerated", "lsrn"])
     p.add_argument("--sparse", action="store_true")
     p.add_argument("--x64", action="store_true")
+    p.add_argument("--shard", action="store_true",
+                   help="shard the input rows over all visible devices")
     args = p.parse_args(argv)
 
     import jax
@@ -34,6 +36,18 @@ def main(argv=None) -> int:
 
     A, b = read_libsvm(args.inputfile, sparse=args.sparse)
     Aj = A if args.sparse else jnp.asarray(A)
+    if args.shard:
+        if args.sparse:
+            print("warning: --shard ignores sparse inputs (BCOO stays on "
+                  "one device)")
+        else:
+            from ..parallel import default_mesh, shard_rows_padded
+
+            # Zero rows contribute zero residual: the LS solution is
+            # unchanged; pad b to match.
+            mesh = default_mesh()
+            Aj, n_orig = shard_rows_padded(Aj, mesh)
+            b = np.concatenate([b, np.zeros(Aj.shape[0] - n_orig)])
     t0 = time.perf_counter()
     result = solve_regression(
         RegressionProblem(Aj),

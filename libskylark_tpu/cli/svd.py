@@ -36,6 +36,8 @@ def main(argv=None) -> int:
         help="synthetic MxN profiling mode (no input file)",
     )
     p.add_argument("--x64", action="store_true", help="enable float64")
+    p.add_argument("--shard", action="store_true",
+                   help="shard the input rows over all visible devices")
     args = p.parse_args(argv)
 
     import jax
@@ -64,6 +66,16 @@ def main(argv=None) -> int:
     else:
         p.error("need an inputfile or --profile M N")
 
+    n_orig = None
+    if args.shard:
+        if args.sparse:
+            print("warning: --shard ignores sparse inputs (BCOO stays on "
+                  "one device)")
+        else:
+            from ..parallel import default_mesh, shard_rows_padded
+
+            # Zero rows don't affect singular values/V; U is trimmed below.
+            A, n_orig = shard_rows_padded(jnp.asarray(A), default_mesh())
     ctx = SketchContext(seed=args.seed)
     params = SVDParams(
         oversampling_ratio=args.oversampling_ratio,
@@ -75,6 +87,8 @@ def main(argv=None) -> int:
     U, s, V = approximate_svd(A, args.rank, ctx, params)
     jax.block_until_ready((U, s, V))
     dt = time.perf_counter() - t0
+    if n_orig is not None:
+        U = U[:n_orig]
     np.save(f"{args.prefix}.U.npy", np.asarray(U))
     np.save(f"{args.prefix}.S.npy", np.asarray(s))
     np.save(f"{args.prefix}.V.npy", np.asarray(V))

@@ -9,8 +9,9 @@ Convention: X is (n, d) with examples as **rows** (the reference's
 dirX/dirY orientation tags collapse to this fixed layout; its sketches'
 columnwise/rowwise tags are applied internally).  Gram matrices are
 computed from sharded MXU-friendly primitives: squared-distance via the
-‖x‖² + ‖y‖² − 2·X·Yᵀ expansion (≙ ``base/distance.hpp``), L1 distance via
-broadcast (documented O(n·m·d) memory like the reference).
+‖x‖² + ‖y‖² − 2·X·Yᵀ expansion (≙ ``base/distance.hpp``), L1/semigroup
+distances via row-blocked broadcasts (peak intermediate capped at
+``_PAIRWISE_LIMIT`` elements; the reference loops the full O(n·m·d)).
 """
 
 from __future__ import annotations

@@ -22,6 +22,7 @@ from .mesh import (
     shard,
     shard_cols,
     shard_rows,
+    shard_rows_padded,
     sharding,
 )
 
@@ -35,6 +36,7 @@ __all__ = [
     "shard",
     "shard_cols",
     "shard_rows",
+    "shard_rows_padded",
     "sharding",
     "rowwise_sharded",
     "columnwise_sharded",

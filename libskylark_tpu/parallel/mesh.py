@@ -35,6 +35,7 @@ __all__ = [
     "sharding",
     "shard",
     "shard_rows",
+    "shard_rows_padded",
     "shard_cols",
     "replicate",
     "fully_replicated",
@@ -113,6 +114,22 @@ def shard_cols(x, mesh: Mesh):
 def replicate(x, mesh: Mesh):
     """Fully replicate (≙ ``[*,*]``)."""
     return shard(x, mesh)
+
+
+def shard_rows_padded(x, mesh: Mesh, pad_value=0.0):
+    """``shard_rows`` for arbitrary row counts: zero-pads dim 0 up to a
+    multiple of the mesh size.  Returns ``(sharded, n_orig)`` — callers
+    whose math tolerates zero rows (least squares residuals, SVD) trim
+    row-shaped outputs back to ``n_orig``."""
+    n = x.shape[0]
+    total = math.prod(mesh.shape.values())
+    pad = (-n) % total
+    if pad:
+        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        import jax.numpy as jnp
+
+        x = jnp.pad(x, widths, constant_values=pad_value)
+    return shard_rows(x, mesh), n
 
 
 def fully_replicated(x):
