@@ -103,13 +103,18 @@ class HashSketch(SketchTransform):
         TPU note: for dense inputs the sketch is then a plain MXU matmul
         — an order of magnitude faster than XLA's scatter-add lowering,
         at the cost of the same O(S·N) window memory a dense sketch uses.
+        Built by broadcast-compare (vectorized one-hot on the VPU) rather
+        than scatter, which on TPU costs more than the matmul itself.
         BCOO inputs keep the scatter path (input-sparsity time).
         """
         b = self.buckets().reshape(self.nnz, self.n)
         v = self.values(dtype).reshape(self.nnz, self.n)
+        iota = jnp.arange(self.s, dtype=b.dtype)
         M = jnp.zeros((self.n, self.s), dtype)
         for h in range(self.nnz):
-            M = M.at[jnp.arange(self.n), b[h]].add(v[h])
+            M = M + jnp.where(
+                b[h][:, None] == iota[None, :], v[h][:, None], jnp.zeros((), dtype)
+            )
         return M
 
     def _apply_dense(self, A, dim: Dimension):
