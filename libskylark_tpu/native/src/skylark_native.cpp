@@ -145,17 +145,19 @@ struct sl_context_t {
 
 enum sl_type_t { SL_JLT = 0, SL_CT = 1, SL_CWT = 2, SL_MMT = 3, SL_WZT = 4,
                  SL_UST = 5, SL_FJLT = 6, SL_GRFT = 7, SL_LRFT = 8,
-                 SL_RLT = 9 };
+                 SL_RLT = 9, SL_MRFT = 10, SL_FGRFT = 11, SL_FMRFT = 12 };
 
 struct sl_sketch_t {
     int type;
     long n, s;
-    long nb;  // FJLT: padded pow2 size
+    long nb;  // FJLT/Fastfood: padded pow2 size
     uint64_t seed;
     uint64_t ctx_counter;  // creation-time counter (serialization)
     // reserved counter bases
-    uint64_t base0, base1, base2;
-    double param;  // CT: C, WZT: p, UST: replace, RFT: sigma, RLT: beta
+    uint64_t base0, base1, base2, base3;
+    double param;   // CT: C, WZT: p, UST: replace, RFT: sigma, RLT: beta,
+                    // Matern: nu
+    double param2;  // Matern: l
 };
 
 void* sl_create_context(uint64_t seed) {
@@ -180,14 +182,18 @@ static int sk_type_from_name(const char* name) {
     if (!strcmp(name, "GaussianRFT")) return SL_GRFT;
     if (!strcmp(name, "LaplacianRFT")) return SL_LRFT;
     if (!strcmp(name, "ExpSemigroupRLT")) return SL_RLT;
+    if (!strcmp(name, "MaternRFT")) return SL_MRFT;
+    if (!strcmp(name, "FastGaussianRFT")) return SL_FGRFT;
+    if (!strcmp(name, "FastMaternRFT")) return SL_FMRFT;
     return -1;
 }
 
 static const char* sk_name_from_type(int t) {
-    static const char* names[10] = {"JLT", "CT", "CWT", "MMT", "WZT", "UST",
+    static const char* names[13] = {"JLT", "CT", "CWT", "MMT", "WZT", "UST",
                                     "FJLT", "GaussianRFT", "LaplacianRFT",
-                                    "ExpSemigroupRLT"};
-    return (t >= 0 && t < 10) ? names[t] : "?";
+                                    "ExpSemigroupRLT", "MaternRFT",
+                                    "FastGaussianRFT", "FastMaternRFT"};
+    return (t >= 0 && t < 13) ? names[t] : "?";
 }
 
 static long sk_next_pow2(long n) {
@@ -234,11 +240,33 @@ static void sk_reserve(sl_sketch_t* t, sl_context_t* ctx) {
             t->base0 = ctx->counter;
             ctx->counter += (uint64_t)t->n * t->s;
             break;
+        case SL_MRFT:
+            // W (N·S), shifts (S), chi2 scales (S; lanes 1..2nu).
+            t->base0 = ctx->counter;
+            ctx->counter += (uint64_t)t->n * t->s;
+            t->base1 = ctx->counter; ctx->counter += t->s;
+            t->base2 = ctx->counter; ctx->counter += t->s;
+            break;
+        case SL_FGRFT:
+        case SL_FMRFT: {
+            // ≙ FastRFT_data_t::build: shifts (S), B, G, P (numblks·NB
+            // each); FastMatern adds chi2 (numblks·NB).
+            long numblks = 1 + (t->s - 1) / t->nb;
+            t->base0 = ctx->counter; ctx->counter += t->s;
+            t->base1 = ctx->counter; ctx->counter += numblks * t->nb;  // B
+            t->base2 = ctx->counter; ctx->counter += numblks * t->nb;  // G
+            t->base3 = ctx->counter; ctx->counter += numblks * t->nb;  // P
+            if (t->type == SL_FMRFT) {
+                // chi base stored by re-deriving: it is base3 + blk·NB.
+                ctx->counter += numblks * t->nb;
+            }
+            break;
+        }
     }
 }
 
-int sl_create_sketch_transform(void* ctx_, const char* type, long n, long s,
-                               double param, void** out) {
+int sl_create_sketch_transform2(void* ctx_, const char* type, long n, long s,
+                                double param, double param2, void** out) {
     int ty = sk_type_from_name(type);
     if (ty < 0) return 103;  // SketchError
     sl_context_t* ctx = (sl_context_t*)ctx_;
@@ -246,16 +274,35 @@ int sl_create_sketch_transform(void* ctx_, const char* type, long n, long s,
     t->type = ty;
     t->n = n;
     t->s = s;
-    t->nb = (ty == SL_FJLT) ? sk_next_pow2(n) : n;
+    t->nb = (ty == SL_FJLT || ty == SL_FGRFT || ty == SL_FMRFT)
+                ? sk_next_pow2(n)
+                : n;
     t->seed = ctx->seed;
     t->ctx_counter = ctx->counter;
     t->param = param;
-    if ((ty == SL_GRFT || ty == SL_LRFT) && param == 0.0) t->param = 1.0;
+    t->param2 = param2;
+    if ((ty == SL_GRFT || ty == SL_LRFT || ty == SL_FGRFT) && param == 0.0)
+        t->param = 1.0;
     if (ty == SL_RLT && param == 0.0) t->param = 1.0;
+    if (ty == SL_MRFT || ty == SL_FMRFT) {
+        if (t->param == 0.0) t->param = 1.0;   // nu
+        if (t->param2 == 0.0) t->param2 = 1.0; // l
+        double two_nu = 2.0 * t->param;
+        if (std::fabs(two_nu - std::round(two_nu)) > 1e-9 ||
+            std::round(two_nu) < 1) {
+            delete t;
+            return 102;
+        }
+    }
     if (ty == SL_UST && param == 0.0 && s > n) { delete t; return 102; }
     sk_reserve(t, ctx);
     *out = t;
     return 0;
+}
+
+int sl_create_sketch_transform(void* ctx_, const char* type, long n, long s,
+                               double param, void** out) {
+    return sl_create_sketch_transform2(ctx_, type, n, s, param, 0.0, out);
 }
 
 void sl_free_sketch_transform(void* t) { delete (sl_sketch_t*)t; }
@@ -394,17 +441,35 @@ static void sk_apply_fjlt_cw(const sl_sketch_t* t, const double* A, long m,
     }
 }
 
-// RFT columnwise: out = outscale·cos(inscale·(W·A) + shift); W normal
-// (Gaussian) or cauchy (Laplacian).  RLT: out = outscale·exp(−inscale·W·A)
-// with W ~ Lévy.  ≙ RFT_Elemental.hpp:85-120 / RLT_Elemental.hpp:77.
+// χ²_{2ν}(i) as a sum over lanes 1..2ν — MUST match
+// core.random.chi2_lanes (shared by Matérn and Fastfood-Matérn).
+static double sk_chi2(uint64_t seed, uint64_t base, uint64_t i, int two_nu) {
+    double chi2 = 0.0;
+    for (int lane = 1; lane <= two_nu; lane++) {
+        uint32_t hi, lo;
+        sk_bits(seed, (uint32_t)lane, base + i, &hi, &lo);
+        double z = sk_normal(hi, lo);
+        chi2 += z * z;
+    }
+    return chi2;
+}
+
+// RFT columnwise: out = outscale·cos(scale_i·(inscale·W·A)_i + shift);
+// W normal (Gaussian/Matérn) or cauchy (Laplacian); Matérn multiplies
+// per-row multivariate-t corrections sqrt(2ν/χ²_{2ν}) (chi2 from lanes
+// 1..2ν on base2, matching core.random.chi2_lanes).  RLT:
+// out = outscale·exp(−inscale·W·A) with W ~ Lévy.
+// ≙ RFT_Elemental.hpp:85-120 / RFT_data.hpp:336-345 / RLT_Elemental.hpp:77.
 static void sk_apply_rft_cw(const sl_sketch_t* t, const double* A, long m,
                             double* out) {
     const long n = t->n, s = t->s;
     const bool rlt = t->type == SL_RLT;  // rlt branch never reads dist
+    const bool matern = t->type == SL_MRFT;
     const int dist =
         (t->type == SL_LRFT) ? SK_DIST_CAUCHY : SK_DIST_NORMAL;
     const double inscale =
-        rlt ? (t->param * t->param / 2.0) : (1.0 / t->param);
+        rlt ? (t->param * t->param / 2.0)
+            : (1.0 / (matern ? t->param2 : t->param));
     const double outscale =
         rlt ? std::sqrt(1.0 / (double)s) : std::sqrt(2.0 / (double)s);
 #pragma omp parallel for schedule(static)
@@ -429,11 +494,92 @@ static void sk_apply_rft_cw(const sl_sketch_t* t, const double* A, long m,
             for (long c = 0; c < m; c++)
                 orow[c] = outscale * std::exp(-orow[c]);
         } else {
+            if (matern) {
+                int two_nu = (int)std::llround(2.0 * t->param);
+                double chi2 = sk_chi2(t->seed, t->base2, (uint64_t)i, two_nu);
+                double sc = std::sqrt(2.0 * t->param / chi2);
+                for (long c = 0; c < m; c++) orow[c] *= sc;
+            }
             uint32_t hi, lo;
             sk_bits(t->seed, 0, t->base1 + (uint64_t)i, &hi, &lo);
             double shift = sk_uniform01(hi, lo) * 2.0 * M_PI;
             for (long c = 0; c < m; c++)
                 orow[c] = outscale * std::cos(orow[c] + shift);
+        }
+    }
+}
+
+// Fastfood columnwise (≙ FRFT_Elemental.hpp / sketch/frft.py _features):
+// per block: H·(B⊙x) → permute → G⊙ → H → Sm⊙; first S coords; cos.
+static void sk_apply_frft_cw(const sl_sketch_t* t, const double* A, long m,
+                             double* out) {
+    const long n = t->n, nb = t->nb, s = t->s;
+    const long numblks = 1 + (s - 1) / nb;
+    const bool matern = t->type == SL_FMRFT;
+    const uint64_t chi_base = t->base3 + (uint64_t)(numblks * nb);
+
+    // Counter-derived per-block data.
+    std::vector<double> B(numblks * nb), G(numblks * nb);
+    std::vector<long> perm(numblks * nb);
+    std::vector<double> Sm(numblks * nb);
+    for (long i = 0; i < numblks * nb; i++) {
+        uint32_t hi, lo;
+        sk_bits(t->seed, 0, t->base1 + (uint64_t)i, &hi, &lo);
+        B[i] = (lo & 1u) ? 1.0 : -1.0;
+        sk_bits(t->seed, 0, t->base2 + (uint64_t)i, &hi, &lo);
+        G[i] = sk_normal(hi, lo);
+    }
+    for (long b = 0; b < numblks; b++) {
+        // argsort (stable) of f32 uniform keys, matching jnp.argsort.
+        std::vector<std::pair<float, long>> keys(nb);
+        for (long j = 0; j < nb; j++) {
+            uint32_t hi, lo;
+            sk_bits(t->seed, 0, t->base3 + (uint64_t)(b * nb + j), &hi, &lo);
+            keys[j] = {sk_uniform01_f32(lo), j};
+        }
+        std::stable_sort(keys.begin(), keys.end(),
+                         [](const std::pair<float, long>& a,
+                            const std::pair<float, long>& x) {
+                             return a.first < x.first;
+                         });
+        for (long j = 0; j < nb; j++) perm[b * nb + j] = keys[j].second;
+    }
+    for (long i = 0; i < numblks * nb; i++) {
+        if (matern) {
+            int two_nu = (int)std::llround(2.0 * t->param);
+            double chi2 = sk_chi2(t->seed, chi_base, (uint64_t)i, two_nu);
+            Sm[i] = std::sqrt(2.0 * t->param / chi2) *
+                    (std::sqrt((double)nb) / t->param2);
+        } else {
+            Sm[i] = std::sqrt((double)nb) / t->param;  // sqrt(NB)/sigma
+        }
+    }
+    std::vector<double> shifts(s);
+    for (long i = 0; i < s; i++) {
+        uint32_t hi, lo;
+        sk_bits(t->seed, 0, t->base0 + (uint64_t)i, &hi, &lo);
+        shifts[i] = sk_uniform01(hi, lo) * 2.0 * M_PI;
+    }
+    const double outscale = std::sqrt(2.0 / (double)s);
+#pragma omp parallel
+    {
+        std::vector<double> work(nb), tmp(nb);
+#pragma omp for schedule(static)
+        for (long c = 0; c < m; c++) {
+            // The block writes below cover exactly rows [0, s).
+            for (long b = 0; b < numblks; b++) {
+                for (long j = 0; j < n; j++)
+                    work[j] = B[b * nb + j] * A[j * m + c];
+                std::fill(work.begin() + n, work.end(), 0.0);
+                sk_fwht(work.data(), nb);
+                for (long j = 0; j < nb; j++)
+                    tmp[j] = G[b * nb + j] * work[perm[b * nb + j]];
+                sk_fwht(tmp.data(), nb);
+                for (long j = 0; j < nb && b * nb + j < s; j++)
+                    out[(b * nb + j) * m + c] =
+                        outscale * std::cos(tmp[j] * Sm[b * nb + j] +
+                                            shifts[b * nb + j]);
+            }
         }
     }
 }
@@ -448,8 +594,10 @@ int sl_apply_sketch_transform(void* t_, const double* A, long rows, long cols,
             case SL_JLT: case SL_CT: sk_apply_dense_cw(t, A, cols, out); break;
             case SL_UST: sk_apply_ust_cw(t, A, cols, out); break;
             case SL_FJLT: sk_apply_fjlt_cw(t, A, cols, out); break;
-            case SL_GRFT: case SL_LRFT: case SL_RLT:
+            case SL_GRFT: case SL_LRFT: case SL_RLT: case SL_MRFT:
                 sk_apply_rft_cw(t, A, cols, out); break;
+            case SL_FGRFT: case SL_FMRFT:
+                sk_apply_frft_cw(t, A, cols, out); break;
             default: sk_apply_hash_cw(t, A, cols, out); break;
         }
         return 0;
@@ -481,10 +629,14 @@ int sl_serialize_sketch_transform(void* t_, char** out) {
                  t->param != 0.0 ? "true" : "false");
     else if (t->type == SL_FJLT)
         snprintf(extra, sizeof extra, ", \"fut\": \"wht\"");
-    else if (t->type == SL_GRFT || t->type == SL_LRFT)
+    else if (t->type == SL_GRFT || t->type == SL_LRFT ||
+             t->type == SL_FGRFT)
         snprintf(extra, sizeof extra, ", \"sigma\": %.17g", t->param);
     else if (t->type == SL_RLT)
         snprintf(extra, sizeof extra, ", \"beta\": %.17g", t->param);
+    else if (t->type == SL_MRFT || t->type == SL_FMRFT)
+        snprintf(extra, sizeof extra, ", \"nu\": %.17g, \"l\": %.17g",
+                 t->param, t->param2);
     char* buf = (char*)malloc(512);
     snprintf(buf, 512,
              "{\"skylark_object_type\": \"sketch\", \"skylark_version\": 1, "
@@ -555,7 +707,9 @@ int sl_deserialize_sketch_transform(const char* json, void** out) {
     else if (!strcmp(type, "UST")) {
         param = strstr(norm.c_str(), "\"replace\":false") ? 0.0 : 1.0;
     }
-    else if (!strcmp(type, "GaussianRFT") || !strcmp(type, "LaplacianRFT")) {
+    double param2 = 0.0;
+    if (!strcmp(type, "GaussianRFT") || !strcmp(type, "LaplacianRFT") ||
+        !strcmp(type, "FastGaussianRFT")) {
         js_find_num(norm.c_str(), "sigma", &param);
         if (param == 0) param = 1.0;
     }
@@ -563,11 +717,16 @@ int sl_deserialize_sketch_transform(const char* json, void** out) {
         js_find_num(norm.c_str(), "beta", &param);
         if (param == 0) param = 1.0;
     }
+    else if (!strcmp(type, "MaternRFT") || !strcmp(type, "FastMaternRFT")) {
+        js_find_num(norm.c_str(), "nu", &param);
+        js_find_num(norm.c_str(), "l", &param2);
+    }
     else if (!strcmp(type, "FJLT")) {
         if (strstr(norm.c_str(), "\"fut\":\"dct\"")) return 104;  // wht only
     }
     sl_context_t ctx{seed, counter};
-    return sl_create_sketch_transform(&ctx, type, (long)n, (long)s, param, out);
+    return sl_create_sketch_transform2(&ctx, type, (long)n, (long)s, param,
+                                       param2, out);
 }
 
 const char* sl_error_string(int code) {

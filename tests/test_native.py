@@ -213,3 +213,66 @@ class TestExtendedNativeTypes:
             ns.apply(A), np.asarray(ps.apply(A, "columnwise")),
             rtol=1e-8, atol=1e-10,
         )
+
+
+class TestFastfoodMaternNative:
+    def test_matern_matches_python(self, rng):
+        from libskylark_tpu.sketch import MaternRFT
+
+        n, s, m = 24, 10, 4
+        A = rng.standard_normal((n, m))
+        nctx = native.NativeContext(31)
+        ns = native.NativeSketch.create(nctx, "MaternRFT", n, s, 1.5, 2.0)
+        ps = MaternRFT(n, s, SketchContext(seed=31), nu=1.5, l=2.0)
+        np.testing.assert_allclose(
+            ns.apply(A), np.asarray(ps.apply(A, "columnwise")),
+            rtol=1e-8, atol=1e-10,
+        )
+        pctx = SketchContext(seed=31)
+        MaternRFT(n, s, pctx, nu=1.5, l=2.0)
+        assert nctx.counter == pctx.counter
+
+    def test_fastgaussian_matches_python(self, rng):
+        from libskylark_tpu.sketch import FastGaussianRFT
+
+        n, s, m = 20, 40, 3  # nb=32, numblks=2
+        A = rng.standard_normal((n, m))
+        nctx = native.NativeContext(32)
+        ns = native.NativeSketch.create(nctx, "FastGaussianRFT", n, s, 1.7)
+        ps = FastGaussianRFT(n, s, SketchContext(seed=32), sigma=1.7)
+        np.testing.assert_allclose(
+            ns.apply(A), np.asarray(ps.apply(A, "columnwise")),
+            rtol=1e-7, atol=1e-9,
+        )
+        pctx = SketchContext(seed=32)
+        FastGaussianRFT(n, s, pctx, sigma=1.7)
+        assert nctx.counter == pctx.counter
+
+    def test_fastmatern_matches_python(self, rng):
+        from libskylark_tpu.sketch import FastMaternRFT
+
+        n, s, m = 12, 20, 3  # nb=16, numblks=2
+        A = rng.standard_normal((n, m))
+        nctx = native.NativeContext(33)
+        ns = native.NativeSketch.create(nctx, "FastMaternRFT", n, s, 1.0, 1.5)
+        ps = FastMaternRFT(n, s, SketchContext(seed=33), nu=1.0, l=1.5)
+        np.testing.assert_allclose(
+            ns.apply(A), np.asarray(ps.apply(A, "columnwise")),
+            rtol=1e-7, atol=1e-9,
+        )
+
+    def test_serialization_roundtrip_new_types(self, rng):
+        from libskylark_tpu.sketch import from_json
+
+        A = rng.standard_normal((16, 2))
+        for stype, p1, p2 in [
+            ("MaternRFT", 2.5, 1.2), ("FastGaussianRFT", 0.9, 0.0),
+            ("FastMaternRFT", 0.5, 2.0),
+        ]:
+            nctx = native.NativeContext(34)
+            ns = native.NativeSketch.create(nctx, stype, 16, 8, p1, p2)
+            ps = from_json(ns.to_json())
+            np.testing.assert_allclose(
+                ns.apply(A), np.asarray(ps.apply(A, "columnwise")),
+                rtol=1e-7, atol=1e-9,
+            )
