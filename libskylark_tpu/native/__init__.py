@@ -82,6 +82,11 @@ def lib():
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_long,
             ctypes.c_double, ctypes.c_double, ctypes.POINTER(ctypes.c_void_p),
         ]
+        L.sl_create_sketch_transform_ex.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_long, ctypes.c_long,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_void_p),
+        ]
         L.sl_free_sketch_transform.argtypes = [ctypes.c_void_p]
         L.sl_apply_sketch_transform.argtypes = [
             ctypes.c_void_p,
@@ -177,10 +182,10 @@ class NativeSketch:
 
     @classmethod
     def create(cls, ctx: NativeContext, sketch_type: str, n: int, s: int,
-               param: float = 0.0, param2: float = 0.0):
+               param: float = 0.0, param2: float = 0.0, param3: float = 0.0):
         out = ctypes.c_void_p()
-        _check(lib().sl_create_sketch_transform2(
-            ctx._h, sketch_type.encode(), n, s, param, param2,
+        _check(lib().sl_create_sketch_transform_ex(
+            ctx._h, sketch_type.encode(), n, s, param, param2, param3,
             ctypes.byref(out)))
         return cls(out, n, s)
 

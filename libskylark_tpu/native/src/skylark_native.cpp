@@ -22,6 +22,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <cmath>
+#include <complex>
 #include <string>
 #include <vector>
 #include <algorithm>
@@ -92,6 +93,81 @@ static inline float sk_uniform01_f32(uint32_t lo) {
     return ((float)k + 0.5f) * 0x1p-24f;
 }
 
+// Cephes ndtri (inverse normal CDF) — same algorithm jax.scipy.special
+// uses, so float64 values agree to ~1 ulp.  Used by the QMC (inverse-CDF)
+// feature maps.
+static double sk_ndtri(double y0) {
+    static const double P0[5] = {
+        -5.99633501014107895267e1, 9.80010754185999661536e1,
+        -5.66762857469070293439e1, 1.39312609387279679503e1,
+        -1.23916583867381258016e0};
+    static const double Q0[8] = {
+        1.95448858338141759834e0, 4.67627912898881538453e0,
+        8.63602421390890590575e1, -2.25462687854119370527e2,
+        2.00260212380060660359e2, -8.20372256168538034e1,
+        1.59056225126211695515e1, -1.18331621121330003142e0};
+    static const double P1[9] = {
+        4.05544892305962419923e0, 3.15251094599893866154e1,
+        5.71628192246421288162e1, 4.408050738932008347e1,
+        1.46849561928858024014e1, 2.18663306850790267539e0,
+        -1.40256079171354495875e-1, -3.50424626827848203418e-2,
+        -8.57456785154685413611e-4};
+    static const double Q1[8] = {
+        1.57799883256466749731e1, 4.53907635128879210584e1,
+        4.13172038254672030440e1, 1.50425385692907503408e1,
+        2.50464946208309415979e0, -1.42182922854787788574e-1,
+        -3.80806407691578277194e-2, -9.33259480895457427372e-4};
+    static const double P2[9] = {
+        3.23774891776946035970e0, 6.91522889068984211695e0,
+        3.93881025292474443415e0, 1.33303460815807542389e0,
+        2.01485389549179081538e-1, 1.23716634817820021358e-2,
+        3.01581553508235416007e-4, 2.65806974686737550832e-6,
+        6.23974539184983651783e-9};
+    static const double Q2[8] = {
+        6.02427039364742014255e0, 3.67983563856160859403e0,
+        1.37702099489081330271e0, 2.16236993594496635890e-1,
+        1.34204006088543189037e-2, 3.28014464682127739104e-4,
+        2.89247864745380683936e-6, 6.79019408009981274425e-9};
+
+    const double s2pi = 2.50662827463100050242;
+    if (y0 <= 0.0) return -INFINITY;
+    if (y0 >= 1.0) return INFINITY;
+    int code = 1;
+    double y = y0;
+    if (y > 1.0 - 0.13533528323661269189) {  // 1 - exp(-2)
+        y = 1.0 - y;
+        code = 0;
+    }
+    if (y > 0.13533528323661269189) {
+        y = y - 0.5;
+        double y2 = y * y;
+        double num = P0[0], den = 1.0;
+        for (int i = 1; i < 5; i++) num = num * y2 + P0[i];
+        for (int i = 0; i < 8; i++) den = den * y2 + Q0[i];
+        double x = y + y * (y2 * num / den);
+        return x * s2pi;
+    }
+    double x = std::sqrt(-2.0 * std::log(y));
+    double x0 = x - std::log(x) / x;
+    double z = 1.0 / x;
+    double x1;
+    if (x < 8.0) {
+        double num = P1[0], den = 1.0;
+        for (int i = 1; i < 9; i++) num = num * z + P1[i];
+        for (int i = 0; i < 8; i++) den = den * z + Q1[i];
+        x1 = z * num / den;
+    } else {
+        double num = P2[0], den = 1.0;
+        for (int i = 1; i < 9; i++) num = num * z + P2[i];
+        for (int i = 0; i < 8; i++) den = den * z + Q2[i];
+        x1 = z * num / den;
+    }
+    x = x0 - x1;
+    if (code) x = -x;
+    return x;
+}
+
+
 static inline uint32_t sk_uniform_int(uint32_t hi, uint32_t lo, uint32_t lo_b,
                                       uint32_t hi_b) {
     uint64_t span = (uint64_t)(hi_b - lo_b) + 1;
@@ -145,7 +221,68 @@ struct sl_context_t {
 
 enum sl_type_t { SL_JLT = 0, SL_CT = 1, SL_CWT = 2, SL_MMT = 3, SL_WZT = 4,
                  SL_UST = 5, SL_FJLT = 6, SL_GRFT = 7, SL_LRFT = 8,
-                 SL_RLT = 9, SL_MRFT = 10, SL_FGRFT = 11, SL_FMRFT = 12 };
+                 SL_RLT = 9, SL_MRFT = 10, SL_FGRFT = 11, SL_FMRFT = 12,
+                 SL_GQRFT = 13, SL_LQRFT = 14, SL_QRLT = 15, SL_PPT = 16 };
+
+// ---------------------------------------------------------------------------
+// Leaped Halton QMC (≙ core/quasirand.py)
+// ---------------------------------------------------------------------------
+
+static void sk_primes(int k, std::vector<long>& out) {
+    out.clear();
+    long c = 2;
+    while ((int)out.size() < k) {
+        bool p = true;
+        for (long d = 2; d * d <= c; d++)
+            if (c % d == 0) { p = false; break; }
+        if (p) out.push_back(c);
+        c++;
+    }
+}
+
+// Van der Corput radical inverse of (idx + 1) in `base`, 41 digits —
+// identical accumulation order to core/quasirand.radical_inverse (f64).
+static double sk_radical_inverse(long base, unsigned long long idx) {
+    unsigned long long res = idx + 1ull;
+    double r = 0.0, m = 1.0;
+    for (int d = 0; d < 41; d++) {
+        m /= (double)base;
+        r += m * (double)(res % (unsigned long long)base);
+        res /= (unsigned long long)base;
+    }
+    return r;
+}
+
+// ---------------------------------------------------------------------------
+// Radix-2 complex FFT matching jnp.fft.fft's sign convention
+// (X_k = sum_n x_n e^{-2*pi*i*n*k/N}); PPT requires pow2 S.
+// ---------------------------------------------------------------------------
+
+static void sk_fft(std::complex<double>* x, long nfft, bool inverse) {
+    // bit reversal
+    for (long i = 1, j = 0; i < nfft; i++) {
+        long bit = nfft >> 1;
+        for (; j & bit; bit >>= 1) j ^= bit;
+        j ^= bit;
+        if (i < j) std::swap(x[i], x[j]);
+    }
+    for (long len = 2; len <= nfft; len <<= 1) {
+        double ang = 2.0 * M_PI / (double)len * (inverse ? 1.0 : -1.0);
+        std::complex<double> wl(std::cos(ang), std::sin(ang));
+        for (long i = 0; i < nfft; i += len) {
+            std::complex<double> w(1.0, 0.0);
+            for (long j = 0; j < len / 2; j++) {
+                std::complex<double> u = x[i + j];
+                std::complex<double> v = x[i + j + len / 2] * w;
+                x[i + j] = u + v;
+                x[i + j + len / 2] = u - v;
+                w *= wl;
+            }
+        }
+    }
+    if (inverse)
+        for (long i = 0; i < nfft; i++) x[i] /= (double)nfft;
+}
 
 struct sl_sketch_t {
     int type;
@@ -185,15 +322,21 @@ static int sk_type_from_name(const char* name) {
     if (!strcmp(name, "MaternRFT")) return SL_MRFT;
     if (!strcmp(name, "FastGaussianRFT")) return SL_FGRFT;
     if (!strcmp(name, "FastMaternRFT")) return SL_FMRFT;
+    if (!strcmp(name, "GaussianQRFT")) return SL_GQRFT;
+    if (!strcmp(name, "LaplacianQRFT")) return SL_LQRFT;
+    if (!strcmp(name, "ExpSemigroupQRLT")) return SL_QRLT;
+    if (!strcmp(name, "PPT")) return SL_PPT;
     return -1;
 }
 
 static const char* sk_name_from_type(int t) {
-    static const char* names[13] = {"JLT", "CT", "CWT", "MMT", "WZT", "UST",
+    static const char* names[17] = {"JLT", "CT", "CWT", "MMT", "WZT", "UST",
                                     "FJLT", "GaussianRFT", "LaplacianRFT",
                                     "ExpSemigroupRLT", "MaternRFT",
-                                    "FastGaussianRFT", "FastMaternRFT"};
-    return (t >= 0 && t < 13) ? names[t] : "?";
+                                    "FastGaussianRFT", "FastMaternRFT",
+                                    "GaussianQRFT", "LaplacianQRFT",
+                                    "ExpSemigroupQRLT", "PPT"};
+    return (t >= 0 && t < 17) ? names[t] : "?";
 }
 
 static long sk_next_pow2(long n) {
@@ -247,6 +390,20 @@ static void sk_reserve(sl_sketch_t* t, sl_context_t* ctx) {
             t->base1 = ctx->counter; ctx->counter += t->s;
             t->base2 = ctx->counter; ctx->counter += t->s;
             break;
+        case SL_GQRFT:
+        case SL_LQRFT:
+        case SL_QRLT:
+            break;  // QMC types consume no counters (skip-based)
+        case SL_PPT: {
+            // q CWTs (2N each), then hash idx (q) and val (q)
+            // (≙ sketch/ppt.py reservation order).
+            long q = (long)t->nb;  // q stashed in nb for PPT
+            t->base0 = ctx->counter;
+            ctx->counter += (uint64_t)(2 * t->n) * q;
+            t->base1 = ctx->counter; ctx->counter += q;
+            t->base2 = ctx->counter; ctx->counter += q;
+            break;
+        }
         case SL_FGRFT:
         case SL_FMRFT: {
             // ≙ FastRFT_data_t::build: shifts (S), B, G, P (numblks·NB
@@ -265,8 +422,9 @@ static void sk_reserve(sl_sketch_t* t, sl_context_t* ctx) {
     }
 }
 
-int sl_create_sketch_transform2(void* ctx_, const char* type, long n, long s,
-                                double param, double param2, void** out) {
+int sl_create_sketch_transform_ex(void* ctx_, const char* type, long n,
+                                  long s, double param, double param2,
+                                  double param3, void** out) {
     int ty = sk_type_from_name(type);
     if (ty < 0) return 103;  // SketchError
     sl_context_t* ctx = (sl_context_t*)ctx_;
@@ -277,13 +435,22 @@ int sl_create_sketch_transform2(void* ctx_, const char* type, long n, long s,
     t->nb = (ty == SL_FJLT || ty == SL_FGRFT || ty == SL_FMRFT)
                 ? sk_next_pow2(n)
                 : n;
+    if (ty == SL_PPT) {
+        // c (param) and gamma (param2) may legitimately be 0 — no
+        // zero-means-default coercion here (unlike sigma/beta, where 0 is
+        // invalid).  q=0 is invalid, so 0 selects the reference default.
+        long q = (long)(param3 != 0.0 ? param3 : 3.0);
+        if (q < 1 || s != sk_next_pow2(s)) { delete t; return 104; }
+        t->nb = q;  // PPT stashes q here
+    }
     t->seed = ctx->seed;
     t->ctx_counter = ctx->counter;
     t->param = param;
     t->param2 = param2;
-    if ((ty == SL_GRFT || ty == SL_LRFT || ty == SL_FGRFT) && param == 0.0)
+    if ((ty == SL_GRFT || ty == SL_LRFT || ty == SL_FGRFT ||
+         ty == SL_GQRFT || ty == SL_LQRFT) && param == 0.0)
         t->param = 1.0;
-    if (ty == SL_RLT && param == 0.0) t->param = 1.0;
+    if ((ty == SL_RLT || ty == SL_QRLT) && param == 0.0) t->param = 1.0;
     if (ty == SL_MRFT || ty == SL_FMRFT) {
         if (t->param == 0.0) t->param = 1.0;   // nu
         if (t->param2 == 0.0) t->param2 = 1.0; // l
@@ -300,9 +467,16 @@ int sl_create_sketch_transform2(void* ctx_, const char* type, long n, long s,
     return 0;
 }
 
+int sl_create_sketch_transform2(void* ctx_, const char* type, long n, long s,
+                                double param, double param2, void** out) {
+    return sl_create_sketch_transform_ex(ctx_, type, n, s, param, param2, 0.0,
+                                         out);
+}
+
 int sl_create_sketch_transform(void* ctx_, const char* type, long n, long s,
                                double param, void** out) {
-    return sl_create_sketch_transform2(ctx_, type, n, s, param, 0.0, out);
+    return sl_create_sketch_transform_ex(ctx_, type, n, s, param, 0.0, 0.0,
+                                         out);
 }
 
 void sl_free_sketch_transform(void* t) { delete (sl_sketch_t*)t; }
@@ -509,6 +683,104 @@ static void sk_apply_rft_cw(const sl_sketch_t* t, const double* A, long m,
     }
 }
 
+// QMC feature maps (≙ sketch/rft.py QRFT / sketch/rlt.py QRLT): W rows
+// from the leaped Halton sequence through inverse CDFs; no counters.
+static void sk_apply_qmc_cw(const sl_sketch_t* t, const double* A, long m,
+                            double* out) {
+    const long n = t->n, s = t->s;
+    const bool rlt = t->type == SL_QRLT;
+    const long seq_d = rlt ? n : n + 1;  // QRFT uses dim n for the shift
+    const long skip = (long)t->param2;
+    std::vector<long> primes;
+    sk_primes((int)seq_d + 1, primes);
+    const long leap = primes[seq_d];  // (d+1)-th prime ≙ quasirand.py
+    const double inscale =
+        rlt ? (t->param * t->param / 2.0) : (1.0 / t->param);
+    const double outscale =
+        rlt ? std::sqrt(1.0 / (double)s) : std::sqrt(2.0 / (double)s);
+#pragma omp parallel for schedule(static)
+    for (long i = 0; i < s; i++) {
+        double* orow = out + i * m;
+        for (long c = 0; c < m; c++) orow[c] = 0.0;
+        unsigned long long idx = (unsigned long long)(skip + i) *
+                                 (unsigned long long)leap;
+        for (long j = 0; j < n; j++) {
+            double u = sk_radical_inverse(primes[j], idx);
+            double w;
+            if (rlt) {
+                double z = sk_ndtri(u / 2.0);
+                w = 1.0 / (z * z);  // Lévy quantile
+            } else if (t->type == SL_LQRFT) {
+                w = std::tan(M_PI * (u - 0.5));
+            } else {
+                w = sk_ndtri(u);
+            }
+            w *= inscale;
+            const double* arow = A + j * m;
+            for (long c = 0; c < m; c++) orow[c] += w * arow[c];
+        }
+        if (rlt) {
+            for (long c = 0; c < m; c++)
+                orow[c] = outscale * std::exp(-orow[c]);
+        } else {
+            double shift =
+                2.0 * M_PI * sk_radical_inverse(primes[n], idx);
+            for (long c = 0; c < m; c++)
+                orow[c] = outscale * std::cos(orow[c] + shift);
+        }
+    }
+}
+
+// PPT / TensorSketch columnwise (≙ sketch/ppt.py): q CountSketches
+// composed in the FFT domain; requires pow2 S (radix-2 FFT).
+static void sk_apply_ppt_cw(const sl_sketch_t* t, const double* A, long m,
+                            double* out) {
+    const long n = t->n, s = t->s, q = (long)t->nb;
+    const double sqrt_c = std::sqrt(t->param);
+    const double sqrt_g = std::sqrt(t->param2);
+    // Per-level CWT hash arrays + the constant-term hash.
+    std::vector<long> buckets(q * n);
+    std::vector<double> values(q * n);
+    std::vector<long> hidx(q);
+    std::vector<double> hval(q);
+    for (long l = 0; l < q; l++) {
+        uint64_t idx_base = t->base0 + (uint64_t)(l * 2 * n);
+        uint64_t val_base = idx_base + (uint64_t)n;
+        for (long i = 0; i < n; i++) {
+            uint32_t hi, lo;
+            sk_bits(t->seed, 0, idx_base + (uint64_t)i, &hi, &lo);
+            buckets[l * n + i] =
+                (long)sk_uniform_int(hi, lo, 0, (uint32_t)(s - 1));
+            sk_bits(t->seed, 0, val_base + (uint64_t)i, &hi, &lo);
+            values[l * n + i] = (lo & 1u) ? 1.0 : -1.0;
+        }
+        uint32_t hi, lo;
+        sk_bits(t->seed, 0, t->base1 + (uint64_t)l, &hi, &lo);
+        hidx[l] = (long)sk_uniform_int(hi, lo, 0, (uint32_t)(s - 1));
+        sk_bits(t->seed, 0, t->base2 + (uint64_t)l, &hi, &lo);
+        hval[l] = (lo & 1u) ? 1.0 : -1.0;
+    }
+#pragma omp parallel
+    {
+        std::vector<std::complex<double>> P(s), W(s);
+#pragma omp for schedule(static)
+        for (long c = 0; c < m; c++) {
+            for (long k = 0; k < s; k++) P[k] = {1.0, 0.0};
+            for (long l = 0; l < q; l++) {
+                for (long k = 0; k < s; k++) W[k] = {0.0, 0.0};
+                for (long i = 0; i < n; i++)
+                    W[buckets[l * n + i]] +=
+                        sqrt_g * values[l * n + i] * A[i * m + c];
+                W[hidx[l]] += sqrt_c * hval[l];
+                sk_fft(W.data(), s, false);
+                for (long k = 0; k < s; k++) P[k] *= W[k];
+            }
+            sk_fft(P.data(), s, true);
+            for (long k = 0; k < s; k++) out[k * m + c] = P[k].real();
+        }
+    }
+}
+
 // Fastfood columnwise (≙ FRFT_Elemental.hpp / sketch/frft.py _features):
 // per block: H·(B⊙x) → permute → G⊙ → H → Sm⊙; first S coords; cos.
 static void sk_apply_frft_cw(const sl_sketch_t* t, const double* A, long m,
@@ -598,6 +870,9 @@ int sl_apply_sketch_transform(void* t_, const double* A, long rows, long cols,
                 sk_apply_rft_cw(t, A, cols, out); break;
             case SL_FGRFT: case SL_FMRFT:
                 sk_apply_frft_cw(t, A, cols, out); break;
+            case SL_GQRFT: case SL_LQRFT: case SL_QRLT:
+                sk_apply_qmc_cw(t, A, cols, out); break;
+            case SL_PPT: sk_apply_ppt_cw(t, A, cols, out); break;
             default: sk_apply_hash_cw(t, A, cols, out); break;
         }
         return 0;
@@ -636,6 +911,16 @@ int sl_serialize_sketch_transform(void* t_, char** out) {
         snprintf(extra, sizeof extra, ", \"beta\": %.17g", t->param);
     else if (t->type == SL_MRFT || t->type == SL_FMRFT)
         snprintf(extra, sizeof extra, ", \"nu\": %.17g, \"l\": %.17g",
+                 t->param, t->param2);
+    else if (t->type == SL_GQRFT || t->type == SL_LQRFT)
+        snprintf(extra, sizeof extra, ", \"sigma\": %.17g, \"skip\": %ld",
+                 t->param, (long)t->param2);
+    else if (t->type == SL_QRLT)
+        snprintf(extra, sizeof extra, ", \"beta\": %.17g, \"skip\": %ld",
+                 t->param, (long)t->param2);
+    else if (t->type == SL_PPT)
+        snprintf(extra, sizeof extra,
+                 ", \"q\": %ld, \"c\": %.17g, \"gamma\": %.17g", (long)t->nb,
                  t->param, t->param2);
     char* buf = (char*)malloc(512);
     snprintf(buf, 512,
@@ -724,9 +1009,27 @@ int sl_deserialize_sketch_transform(const char* json, void** out) {
     else if (!strcmp(type, "FJLT")) {
         if (strstr(norm.c_str(), "\"fut\":\"dct\"")) return 104;  // wht only
     }
+    double param3 = 0.0;
+    if (!strcmp(type, "GaussianQRFT") || !strcmp(type, "LaplacianQRFT")) {
+        js_find_num(norm.c_str(), "sigma", &param);
+        if (param == 0) param = 1.0;
+        js_find_num(norm.c_str(), "skip", &param2);
+    }
+    else if (!strcmp(type, "ExpSemigroupQRLT")) {
+        js_find_num(norm.c_str(), "beta", &param);
+        if (param == 0) param = 1.0;
+        js_find_num(norm.c_str(), "skip", &param2);
+    }
+    else if (!strcmp(type, "PPT")) {
+        // Absent keys default to the reference's (c=1, gamma=1, q=3);
+        // present zeros are preserved (c=0 / gamma=0 are legal).
+        if (!js_find_num(norm.c_str(), "c", &param)) param = 1.0;
+        if (!js_find_num(norm.c_str(), "gamma", &param2)) param2 = 1.0;
+        if (!js_find_num(norm.c_str(), "q", &param3)) param3 = 3.0;
+    }
     sl_context_t ctx{seed, counter};
-    return sl_create_sketch_transform2(&ctx, type, (long)n, (long)s, param,
-                                       param2, out);
+    return sl_create_sketch_transform_ex(&ctx, type, (long)n, (long)s, param,
+                                         param2, param3, out);
 }
 
 const char* sl_error_string(int code) {

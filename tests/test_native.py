@@ -276,3 +276,97 @@ class TestFastfoodMaternNative:
                 ns.apply(A), np.asarray(ps.apply(A, "columnwise")),
                 rtol=1e-7, atol=1e-9,
             )
+
+
+class TestQMCAndPPTNative:
+    """The final 4 types: QMC feature maps + TensorSketch → 16/16."""
+
+    @pytest.mark.parametrize("stype,pname", [
+        ("GaussianQRFT", "GaussianQRFT"), ("LaplacianQRFT", "LaplacianQRFT"),
+    ])
+    def test_qrft_matches_python(self, rng, stype, pname):
+        import libskylark_tpu.sketch as sk
+
+        n, s, m = 12, 10, 4
+        A = rng.standard_normal((n, m))
+        nctx = native.NativeContext(41)
+        ns = native.NativeSketch.create(nctx, stype, n, s, 1.8, 50.0)
+        ps = getattr(sk, pname)(n, s, SketchContext(seed=41), sigma=1.8, skip=50)
+        np.testing.assert_allclose(
+            ns.apply(A), np.asarray(ps.apply(A, "columnwise")),
+            rtol=1e-8, atol=1e-10,
+        )
+        assert nctx.counter == 0  # QMC consumes no counters
+
+    def test_qrlt_matches_python(self, rng):
+        from libskylark_tpu.sketch import ExpSemigroupQRLT
+
+        n, s, m = 8, 12, 3
+        A = rng.random((n, m))
+        nctx = native.NativeContext(42)
+        ns = native.NativeSketch.create(nctx, "ExpSemigroupQRLT", n, s, 0.3, 25.0)
+        ps = ExpSemigroupQRLT(n, s, SketchContext(seed=42), beta=0.3, skip=25)
+        np.testing.assert_allclose(
+            ns.apply(A), np.asarray(ps.apply(A, "columnwise")),
+            rtol=1e-8, atol=1e-10,
+        )
+
+    def test_ppt_matches_python(self, rng):
+        from libskylark_tpu.sketch import PPT
+
+        n, s, m = 10, 16, 5  # s must be pow2 for the native FFT
+        A = rng.standard_normal((n, m))
+        nctx = native.NativeContext(43)
+        ns = native.NativeSketch.create(nctx, "PPT", n, s, 0.5, 2.0, 3.0)
+        ps = PPT(n, s, SketchContext(seed=43), q=3, c=0.5, gamma=2.0)
+        np.testing.assert_allclose(
+            ns.apply(A), np.asarray(ps.apply(A, "columnwise")),
+            rtol=1e-9, atol=1e-11,
+        )
+        pctx = SketchContext(seed=43)
+        PPT(n, s, pctx, q=3, c=0.5, gamma=2.0)
+        assert nctx.counter == pctx.counter
+
+    def test_ppt_non_pow2_unsupported(self):
+        from libskylark_tpu.utils.exceptions import SkylarkError
+
+        nctx = native.NativeContext(44)
+        with pytest.raises(SkylarkError):
+            native.NativeSketch.create(nctx, "PPT", 10, 12, 1.0, 1.0, 2.0)
+
+    def test_all_16_serialization_roundtrips(self, rng):
+        from libskylark_tpu.sketch import from_json
+
+        A = np.abs(rng.standard_normal((16, 2)))
+        cases = [
+            ("JLT", 0.0, 0.0, 0.0), ("CT", 1.5, 0.0, 0.0),
+            ("CWT", 0.0, 0.0, 0.0), ("MMT", 0.0, 0.0, 0.0),
+            ("WZT", 1.5, 0.0, 0.0), ("UST", 1.0, 0.0, 0.0),
+            ("FJLT", 0.0, 0.0, 0.0), ("GaussianRFT", 2.0, 0.0, 0.0),
+            ("LaplacianRFT", 1.0, 0.0, 0.0), ("ExpSemigroupRLT", 0.4, 0.0, 0.0),
+            ("MaternRFT", 1.5, 1.0, 0.0), ("FastGaussianRFT", 1.0, 0.0, 0.0),
+            ("FastMaternRFT", 0.5, 1.0, 0.0), ("GaussianQRFT", 1.0, 7.0, 0.0),
+            ("LaplacianQRFT", 1.0, 7.0, 0.0), ("ExpSemigroupQRLT", 0.3, 7.0, 0.0),
+            ("PPT", 1.0, 1.0, 2.0),
+        ]
+        for stype, p1, p2, p3 in cases:
+            nctx = native.NativeContext(45)
+            ns = native.NativeSketch.create(nctx, stype, 16, 8, p1, p2, p3)
+            ps = from_json(ns.to_json())
+            np.testing.assert_allclose(
+                ns.apply(A), np.asarray(ps.apply(A, "columnwise")),
+                rtol=1e-7, atol=1e-9, err_msg=stype,
+            )
+
+    def test_ppt_zero_c_roundtrip(self, rng):
+        # c=0 (homogeneous polynomial kernel) must be preserved.
+        from libskylark_tpu.sketch import PPT, from_json
+
+        n, s = 6, 8
+        A = rng.standard_normal((n, 2))
+        ps = PPT(n, s, SketchContext(seed=46), q=2, c=0.0, gamma=1.0)
+        ns = native.NativeSketch.from_json(ps.to_json())
+        np.testing.assert_allclose(
+            ns.apply(A), np.asarray(ps.apply(A, "columnwise")),
+            rtol=1e-9, atol=1e-11,
+        )
