@@ -67,15 +67,21 @@ class FJLT(SketchTransform):
             A2 = jnp.asarray(A)
             if (
                 A2.ndim == 2
-                and dim is Dimension.ROWWISE
-                and A2.shape[1] == self.n
                 and A2.dtype in (jnp.float32, jnp.bfloat16)
                 and _use_pallas()
             ):
                 from . import pallas_fut
 
-                if pallas_fut.supported(A2.shape[0], self.n, self._nb):
-                    return self._apply_pallas(A2)
+                # Normalize to rowwise: columnwise = transpose in/out (two
+                # extra passes; the fused kernel saves more than that vs
+                # the XLA WHT lowering).
+                rowwise = dim is Dimension.ROWWISE
+                B = A2 if rowwise else A2.T
+                if B.shape[1] == self.n and pallas_fut.supported(
+                    B.shape[0], self.n, self._nb
+                ):
+                    out = self._apply_pallas(B)
+                    return out if rowwise else out.T
         T = self._rfut.apply(A, dim)
         scale = jnp.asarray(np.sqrt(self._nb / self.s), T.dtype)
         return scale * self._ust.apply(T, dim)
