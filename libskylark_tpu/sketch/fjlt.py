@@ -74,13 +74,14 @@ class FJLT(SketchTransform):
 
                 # Normalize to rowwise: columnwise = transpose in/out (two
                 # extra passes; the fused kernel saves more than that vs
-                # the XLA WHT lowering).
+                # the XLA WHT lowering).  Gate on A2's dims before forming
+                # the transpose so a failed gate costs nothing.
                 rowwise = dim is Dimension.ROWWISE
-                B = A2 if rowwise else A2.T
-                if B.shape[1] == self.n and pallas_fut.supported(
-                    B.shape[0], self.n, self._nb
+                sk_axis, batch_axis = (1, 0) if rowwise else (0, 1)
+                if A2.shape[sk_axis] == self.n and pallas_fut.supported(
+                    A2.shape[batch_axis], self.n, self._nb
                 ):
-                    out = self._apply_pallas(B)
+                    out = self._apply_pallas(A2 if rowwise else A2.T)
                     return out if rowwise else out.T
         T = self._rfut.apply(A, dim)
         scale = jnp.asarray(np.sqrt(self._nb / self.s), T.dtype)
