@@ -230,16 +230,26 @@ def large_scale_kernel_ridge(
         remains -= this
     maps = [kernel.create_rft(sz, _tag(params), context) for sz in sizes]
 
-    Zs = [S.apply(X, Dimension.ROWWISE).T for S in maps]  # (sz, n) each
-    dtype = Zs[0].dtype
-    lam_ = jnp.asarray(lam, dtype)
-    t = Y2.shape[1]
-    Ws = [jnp.zeros((sz, t), dtype) for sz in sizes]
-    R = Y2.astype(dtype)
+    # Memory-bounded by construction: each chunk's Z is recomputed from
+    # its counter-based map on every sweep and never held alongside the
+    # others (≙ the reference re-applying featureMaps[c] per iteration;
+    # only the small per-chunk Cholesky factors are cached,
+    # krr.hpp:608-660).  Peak extra memory = one (n, max chunk) block.
+    def chunk_Z(c):
+        return maps[c].apply(X, Dimension.ROWWISE).T  # (sz, n)
 
-    # First sweep builds the cached factors (krr.hpp:608-660).
+    # First sweep builds the cached factors (krr.hpp:608-660); the first
+    # chunk also establishes the feature dtype for the state arrays.
     factors = []
-    for c, Z in enumerate(Zs):
+    Ws = None
+    t = Y2.shape[1]
+    for c in range(len(maps)):
+        Z = chunk_Z(c)
+        if Ws is None:
+            dtype = Z.dtype
+            lam_ = jnp.asarray(lam, dtype)
+            Ws = [jnp.zeros((sz, t), dtype) for sz in sizes]
+            R = Y2.astype(dtype)
         G = fully_replicated(Z @ Z.T + lam_ * jnp.eye(Z.shape[0], dtype=dtype))
         Lc = cho_factor(G, lower=True)
         factors.append(Lc)
@@ -251,7 +261,8 @@ def large_scale_kernel_ridge(
     # More sweeps (krr.hpp:668-727).
     for it in range(1, params.iter_lim):
         delsize = 0.0
-        for c, Z in enumerate(Zs):
+        for c in range(len(maps)):
+            Z = chunk_Z(c)
             ZR = Z @ R - lam_ * Ws[c]
             delta = cho_solve(factors[c], ZR)
             Ws[c] = Ws[c] + delta
