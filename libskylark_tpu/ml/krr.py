@@ -243,7 +243,9 @@ def large_scale_kernel_ridge(
     factors = []
     Ws = None
     t = Y2.shape[1]
+    Z = None
     for c in range(len(maps)):
+        Z = None  # release chunk c-1 before materializing chunk c
         Z = chunk_Z(c)
         if Ws is None:
             dtype = Z.dtype
@@ -262,6 +264,7 @@ def large_scale_kernel_ridge(
     for it in range(1, params.iter_lim):
         delsize = 0.0
         for c in range(len(maps)):
+            Z = None  # release chunk c-1 before materializing chunk c
             Z = chunk_Z(c)
             ZR = Z @ R - lam_ * Ws[c]
             delta = cho_solve(factors[c], ZR)
