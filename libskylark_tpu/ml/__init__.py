@@ -4,6 +4,13 @@ persistence."""
 
 from .admm import ADMMParams, BlockADMMSolver
 from .coding import decode_labels, dummy_coding
+from .distances import (
+    euclidean_distance_matrix,
+    expsemigroup_distance_matrix,
+    l1_distance_matrix,
+)
+from .metrics import classification_accuracy, mean_squared_error
+from .nonlinear import RLS, NystromRLS, SketchPCR, SketchRLS
 from .kernels import (
     ExpSemigroupKernel,
     GaussianKernel,
@@ -51,6 +58,15 @@ __all__ = [
     "faster_kernel_rlsc",
     "dummy_coding",
     "decode_labels",
+    "euclidean_distance_matrix",
+    "l1_distance_matrix",
+    "expsemigroup_distance_matrix",
+    "classification_accuracy",
+    "mean_squared_error",
+    "RLS",
+    "SketchRLS",
+    "NystromRLS",
+    "SketchPCR",
     "ADMMParams",
     "BlockADMMSolver",
     "FeatureMapModel",

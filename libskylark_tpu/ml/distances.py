@@ -1,0 +1,67 @@
+"""Pairwise distance matrices (≙ ``base/distance.hpp`` and
+``python-skylark/skylark/ml/distances.py``).
+
+The reference provides three distance families with BLAS-style
+``C = beta*C + alpha*dist(A, B)`` accumulate semantics:
+
+- squared euclidean (``EuclideanDistanceMatrix``, base/distance.hpp:11-155)
+- L1 (``L1DistanceMatrix``, base/distance.hpp:160-384)
+- exp-semigroup, sum of elementwise sqrt
+  (``ExpsemigroupDistanceMatrix``, base/distance.hpp:386-533)
+
+TPU notes: squared euclidean is one big MXU matmul plus rank-1 norm
+corrections; L1 and semigroup have no matmul form, so they run as
+row-blocked broadcasts (the same O(n·m·d) loop the reference does, with
+peak memory bounded to one block slab).  All functions accept dense or
+BCOO inputs (BCOO is densified — the outputs are dense anyway).
+
+Convention: rows are points.  ``D[i, j] = dist(X[i], Y[j])``, i.e. an
+(n, m) matrix for X (n, d), Y (m, d) — the orientation the kernel layer
+and ``KernelModel.predict`` use.  (python-skylark's ``euclidean(X, Y)``
+returns the transpose of this; use ``.T`` for that layout.)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .kernels import _dense, _l1dist, _semigroup_dist, _sqdist
+
+__all__ = [
+    "euclidean_distance_matrix",
+    "l1_distance_matrix",
+    "expsemigroup_distance_matrix",
+]
+
+
+def _accumulate(D, alpha, beta, C):
+    if beta != 0.0 and C is None:
+        raise ValueError("beta != 0 requires an existing C to accumulate into")
+    if C is None:
+        return alpha * D
+    return beta * jnp.asarray(C) + alpha * D
+
+
+def euclidean_distance_matrix(X, Y=None, alpha=1.0, beta=0.0, C=None):
+    """Squared euclidean distances, ``C = beta*C + alpha*D``
+    (≙ ``EuclideanDistanceMatrix``, base/distance.hpp:11-79)."""
+    X = _dense(X)
+    Y = X if Y is None else _dense(Y)
+    return _accumulate(_sqdist(X, Y), alpha, beta, C)
+
+
+def l1_distance_matrix(X, Y=None, alpha=1.0, beta=0.0, C=None):
+    """L1 distances (≙ ``L1DistanceMatrix``, base/distance.hpp:160-384)."""
+    X = _dense(X)
+    Y = X if Y is None else _dense(Y)
+    return _accumulate(_l1dist(X, Y), alpha, beta, C)
+
+
+def expsemigroup_distance_matrix(X, Y=None, alpha=1.0, beta=0.0, C=None):
+    """Semigroup "distance" sum_k sqrt(x_k + y_k), used by the
+    exp-semigroup kernel on histogram features
+    (≙ ``ExpsemigroupDistanceMatrix``, base/distance.hpp:386-533).
+    Inputs must be nonnegative."""
+    X = _dense(X)
+    Y = X if Y is None else _dense(Y)
+    return _accumulate(_semigroup_dist(X, Y), alpha, beta, C)

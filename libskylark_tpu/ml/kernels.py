@@ -38,10 +38,17 @@ __all__ = [
 
 
 def _sqdist(X, Y):
-    """Pairwise squared euclidean distances, (n, m) — one big matmul."""
+    """Pairwise squared euclidean distances, (n, m) — one big matmul.
+
+    The cross-term matmul runs at ``precision='highest'``: on TPU the
+    default f32 matmul passes through bf16, and the ``xx + yy − 2·xy``
+    differencing amplifies that to O(1) absolute errors on clustered data
+    (nonzero self-distances → non-PSD Grams → Cholesky failures).  The
+    reference computes Grams in f64 (base/distance.hpp); full-f32 MXU is
+    the TPU parity point."""
     xx = jnp.sum(X * X, axis=1)[:, None]
     yy = jnp.sum(Y * Y, axis=1)[None, :]
-    return jnp.maximum(xx + yy - 2.0 * (X @ Y.T), 0.0)
+    return jnp.maximum(xx + yy - 2.0 * jnp.dot(X, Y.T, precision="highest"), 0.0)
 
 
 # Broadcast intermediates above this many elements are computed in row
@@ -69,6 +76,23 @@ def _l1dist(X, Y):
         X,
         Y,
     )
+
+
+def _semigroup_dist(X, Y):
+    """Pairwise semigroup "distance" sum_k sqrt(x_k + y_k) on nonnegative
+    inputs (row-blocked broadcast)."""
+    return _blocked_rows(
+        lambda a, b: jnp.sum(
+            jnp.sqrt(jnp.maximum(a[:, None, :] + b[None, :, :], 0.0)), axis=-1
+        ),
+        X,
+        Y,
+    )
+
+
+def _dense(X):
+    """Densify BCOO for Gram/distance paths (outputs are dense anyway)."""
+    return X.todense() if hasattr(X, "todense") else jnp.asarray(X)
 
 
 class Kernel(abc.ABC):
@@ -112,7 +136,7 @@ class LinearKernel(Kernel):
 
     def gram(self, X, Y=None):
         Y = X if Y is None else Y
-        return X @ Y.T
+        return jnp.dot(X, Y.T, precision="highest")
 
     def create_rft(self, s, tag, context):
         from ..sketch import CWT, FJLT, JLT
@@ -168,7 +192,9 @@ class PolynomialKernel(Kernel):
 
     def gram(self, X, Y=None):
         Y = X if Y is None else Y
-        return (self.gamma * (X @ Y.T) + self.c) ** self.q
+        return (
+            self.gamma * jnp.dot(X, Y.T, precision="highest") + self.c
+        ) ** self.q
 
     def create_rft(self, s, tag, context):
         from ..sketch import PPT
@@ -219,15 +245,7 @@ class ExpSemigroupKernel(Kernel):
 
     def gram(self, X, Y=None):
         Y = X if Y is None else Y
-        s = _blocked_rows(
-            lambda a, b: jnp.sum(
-                jnp.sqrt(jnp.maximum(a[:, None, :] + b[None, :, :], 0.0)),
-                axis=-1,
-            ),
-            X,
-            Y,
-        )
-        return jnp.exp(-self.beta * s)
+        return jnp.exp(-self.beta * _semigroup_dist(X, Y))
 
     def create_rft(self, s, tag, context):
         from ..sketch import ExpSemigroupQRLT, ExpSemigroupRLT

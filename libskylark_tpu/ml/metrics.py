@@ -1,0 +1,27 @@
+"""Prediction metrics (≙ ``skylark.metrics`` as used by
+``python-skylark/skylark/ml/nonlinear.py`` doctests; the module itself is
+absent from the reference tree — these are the semantics its call sites
+assume)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["classification_accuracy", "mean_squared_error"]
+
+
+def classification_accuracy(predictions, labels):
+    """Percent of exact label matches (0..100)."""
+    predictions = jnp.ravel(jnp.asarray(predictions))
+    labels = jnp.ravel(jnp.asarray(labels))
+    if predictions.shape != labels.shape:
+        raise ValueError(
+            f"shape mismatch: {predictions.shape} vs {labels.shape}"
+        )
+    return 100.0 * jnp.mean(predictions == labels)
+
+
+def mean_squared_error(predictions, targets):
+    predictions = jnp.asarray(predictions)
+    targets = jnp.asarray(targets)
+    return jnp.mean((predictions - targets) ** 2)

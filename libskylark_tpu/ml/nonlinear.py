@@ -1,0 +1,218 @@
+"""Class-based nonlinear regression/classification models
+(≙ ``python-skylark/skylark/ml/nonlinear.py``).
+
+Four estimators, mirroring the reference's pure-Python layer on top of the
+kernel + sketch machinery:
+
+- ``RLS`` — exact kernel regularized least squares
+  (≙ ``nonlinear.py`` class ``rls``): Gram + PSD solve, predict via
+  ``k(X_test, X_train) @ alpha``.
+- ``SketchRLS`` — random-feature RLS (≙ class ``sketchrls``): feature map
+  from ``kernel.create_rft``, normal-equation solve in feature space.
+- ``NystromRLS`` — Nyström features (≙ class ``nystromrls``): sample l
+  landmark rows (uniform or ridge-leverage weighted), whiten with the
+  landmark Gram's inverse square root, solve in the induced feature space.
+- ``SketchPCR`` — sketched kernel principal component regression
+  (≙ class ``sketchpcr``).  The reference calls
+  ``lowrank.approximate_domsubspace_basis`` — a module absent from its
+  tree (dead import; the class cannot run upstream).  We implement the
+  algebra its call site assumes: random features Z (n, s), a second-level
+  sketch of size t to cheaply factor Z, SVD of the small t×s factor for
+  the top-``rank`` right basis and whitener (the Blendenpik-style role
+  the reference's triangular R plays), regression on the projected
+  features, and weights folded back to feature space exactly as the
+  reference's ``train`` does with ``R⁻¹·(V·w₀)``.
+
+All four share the reference's label handling: multiclass labels are
+±1 dummy-coded for training and argmax-decoded at prediction
+(``ml/utils.py dummycoding/dummydecode``); with ``multiclass=False``
+targets pass through untouched (regression).
+
+TPU notes: every train path is (blocked) MXU matmuls plus one
+replicated-small factorization (Cholesky/eigh/QR of s×s or l×l), the same
+replicate-the-small-factor choice the reference makes with [*,*]
+matrices.  Solves run in f32; inputs may be dense or BCOO (feature maps
+consume BCOO directly; Gram paths densify).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.scipy.linalg import cho_factor, cho_solve
+
+from ..core.context import SketchContext
+from ..sketch.base import Dimension
+from ..sketch.hash import CWT
+from ..sketch.sampling import NURST
+from .coding import decode_labels, dummy_coding
+from .kernels import Kernel, _dense
+
+__all__ = ["RLS", "SketchRLS", "NystromRLS", "SketchPCR"]
+
+
+class _LabeledModel:
+    """Shared ±1 dummy-coding / argmax-decoding label plumbing."""
+
+    def __init__(self, kernel: Kernel):
+        self.kernel = kernel
+        self.multiclass = True
+        self.classes = None
+
+    def _encode(self, Y, multiclass):
+        self.multiclass = bool(multiclass)
+        if not self.multiclass:
+            Y = jnp.asarray(Y)
+            self.classes = None
+            return Y[:, None] if Y.ndim == 1 else Y
+        T, self.classes = dummy_coding(Y)
+        return T
+
+    def _decode(self, O):
+        if not self.multiclass:
+            return O[:, 0] if O.shape[1] == 1 else O
+        return decode_labels(O, self.classes)
+
+
+class RLS(_LabeledModel):
+    """Exact kernel RLS (≙ nonlinear.py ``rls``)."""
+
+    def train(self, X, Y, regularization: float = 1.0, multiclass: bool = True):
+        X = _dense(X)
+        T = self._encode(Y, multiclass)
+        K = self.kernel.gram(X, X)
+        A = K + regularization * jnp.eye(K.shape[0], dtype=K.dtype)
+        self.alpha = cho_solve(cho_factor(A, lower=True), T)
+        self.X_train = X
+        return self
+
+    def predict(self, Xt):
+        K = self.kernel.gram(_dense(Xt), self.X_train)
+        return self._decode(K @ self.alpha)
+
+
+class SketchRLS(_LabeledModel):
+    """Random-feature RLS (≙ nonlinear.py ``sketchrls``)."""
+
+    def train(
+        self,
+        X,
+        Y,
+        context: SketchContext,
+        random_features: int = 100,
+        regularization: float = 1.0,
+        multiclass: bool = True,
+        subtype: str = "regular",
+    ):
+        T = self._encode(Y, multiclass)
+        self.rft = self.kernel.create_rft(random_features, subtype, context)
+        Z = self.rft.apply(X, Dimension.ROWWISE)  # (n, s)
+        A = Z.T @ Z + regularization * jnp.eye(Z.shape[1], dtype=Z.dtype)
+        self.weights = cho_solve(cho_factor(A, lower=True), Z.T @ T)
+        return self
+
+    def predict(self, Xt):
+        Zt = self.rft.apply(Xt, Dimension.ROWWISE)
+        return self._decode(Zt @ self.weights)
+
+
+class NystromRLS(_LabeledModel):
+    """Nyström-feature RLS (≙ nonlinear.py ``nystromrls``).
+
+    Landmarks are drawn with ``NURST`` under ``probdist`` ∈ {"uniform",
+    "leverages"}; "leverages" weights rows by the ridge leverage scores
+    diag(K·(K+λI)⁻¹) — the intent of the reference's (self-admittedly
+    approximate) leverage branch, computed here with a PSD solve instead
+    of an explicit inverse.
+    """
+
+    _EPS = 1e-8  # eigenvalue floor for the landmark Gram (≙ eps in ref)
+
+    def train(
+        self,
+        X,
+        Y,
+        context: SketchContext,
+        random_features: int = 100,
+        regularization: float = 1.0,
+        probdist: str = "uniform",
+        multiclass: bool = True,
+    ):
+        X = _dense(X)
+        n = X.shape[0]
+        T = self._encode(Y, multiclass)
+        if probdist == "uniform":
+            probs = jnp.full((n,), 1.0 / n)
+        elif probdist == "leverages":
+            K = self.kernel.gram(X, X)
+            A = K + regularization * jnp.eye(n, dtype=K.dtype)
+            lev = jnp.diagonal(cho_solve(cho_factor(A, lower=True), K))
+            lev = jnp.maximum(lev, 0.0)
+            probs = lev / jnp.sum(lev)
+        else:
+            raise ValueError(f"unknown probdist {probdist!r}")
+        sampler = NURST(n, random_features, context, probs)
+        SX = sampler.apply(X, Dimension.COLUMNWISE)  # (l, d) landmarks
+        K_ll = self.kernel.gram(SX, SX)
+        evals, evecs = jnp.linalg.eigh(
+            K_ll + self._EPS * jnp.eye(K_ll.shape[0], dtype=K_ll.dtype)
+        )
+        evals = jnp.maximum(evals, self._EPS)
+        self.U = evecs / jnp.sqrt(evals)[None, :]  # whitener K_ll^{-1/2}
+        Z = self.kernel.gram(X, SX) @ self.U  # (n, l) Nyström features
+        A = Z.T @ Z + regularization * jnp.eye(Z.shape[1], dtype=Z.dtype)
+        self.weights = cho_solve(cho_factor(A, lower=True), Z.T @ T)
+        self.SX = SX
+        return self
+
+    def predict(self, Xt):
+        Zt = self.kernel.gram(_dense(Xt), self.SX) @ self.U
+        return self._decode(Zt @ self.weights)
+
+
+class SketchPCR(_LabeledModel):
+    """Sketched kernel PCR (≙ nonlinear.py ``sketchpcr``; see module
+    docstring for the reconstruction of its missing ``lowrank`` step)."""
+
+    def train(
+        self,
+        X,
+        Y,
+        context: SketchContext,
+        rank: int,
+        s: int | None = None,
+        t: int | None = None,
+        multiclass: bool = True,
+        subtype: str = "regular",
+    ):
+        if s is None:
+            s = 2 * rank
+        if t is None:
+            t = 2 * s
+        if not (rank <= s <= t):
+            raise ValueError(f"need rank <= s <= t, got {rank}, {s}, {t}")
+        T = self._encode(Y, multiclass)
+        self.rft = self.kernel.create_rft(s, subtype, context)
+        Z = self.rft.apply(X, Dimension.ROWWISE)  # (n, s)
+        n = Z.shape[0]
+        # Second-level sketch: t×s subspace embedding of Z's column space,
+        # then SVD of the small factor.  The top-rank right basis V and
+        # whitener V·Σ⁻¹ play the role of the reference's R⁻¹·V (QR-based;
+        # SVD handles the t < s and t > n corners the QR route cannot).
+        SZ = CWT(n, min(t, n), context).apply(Z, Dimension.COLUMNWISE)
+        _, sig, Vt = jnp.linalg.svd(SZ, full_matrices=False)
+        if rank > sig.shape[0]:
+            raise ValueError(
+                f"rank {rank} exceeds sketched factor rank {sig.shape[0]}"
+            )
+        whiten = Vt[:rank].T / jnp.maximum(sig[:rank], 1e-12)  # (s, rank)
+        # Projected (≈ orthonormal) principal features and regression;
+        # weights fold back to feature space (≙ ref train's R⁻¹·V·w0).
+        Zp = Z @ whiten
+        w0 = jnp.linalg.lstsq(Zp, T)[0]  # (rank, k)
+        self.weights = whiten @ w0  # (s, k)
+        self.rank, self.s, self.t = rank, s, t
+        return self
+
+    def predict(self, Xt):
+        Zt = self.rft.apply(Xt, Dimension.ROWWISE)
+        return self._decode(Zt @ self.weights)

@@ -1,0 +1,172 @@
+"""Nonlinear-model layer tests (≙ python-skylark ``ml/nonlinear.py`` +
+``ml/distances.py``): RLS / SketchRLS / NystromRLS / SketchPCR accuracy on
+separable data, agreement with exact RLS, distance-matrix numerics, and
+metric helpers."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libskylark_tpu import SketchContext
+from libskylark_tpu.ml import (
+    RLS,
+    GaussianKernel,
+    LinearKernel,
+    NystromRLS,
+    SketchPCR,
+    SketchRLS,
+    classification_accuracy,
+    euclidean_distance_matrix,
+    expsemigroup_distance_matrix,
+    l1_distance_matrix,
+    mean_squared_error,
+)
+
+
+def blobs(rng, n_per, d, k=2, sep=4.0):
+    Xs, ys = [], []
+    for c in range(k):
+        Xs.append(rng.standard_normal((n_per, d)) + sep * c)
+        ys.append(np.full(n_per, c + 1))  # 1-based labels like the ref
+    X = np.vstack(Xs)
+    y = np.concatenate(ys)
+    perm = rng.permutation(len(y))
+    return X[perm], y[perm]
+
+
+class TestDistances:
+    def test_euclidean(self, rng):
+        X = rng.standard_normal((7, 3))
+        Y = rng.standard_normal((5, 3))
+        D = np.asarray(euclidean_distance_matrix(X, Y))
+        ref = ((X[:, None] - Y[None, :]) ** 2).sum(-1)
+        np.testing.assert_allclose(D, ref, atol=1e-10)
+
+    def test_l1_and_semigroup(self, rng):
+        X = np.abs(rng.standard_normal((6, 4)))
+        Y = np.abs(rng.standard_normal((3, 4)))
+        np.testing.assert_allclose(
+            np.asarray(l1_distance_matrix(X, Y)),
+            np.abs(X[:, None] - Y[None, :]).sum(-1),
+            atol=1e-12,
+        )
+        np.testing.assert_allclose(
+            np.asarray(expsemigroup_distance_matrix(X, Y)),
+            np.sqrt(X[:, None] + Y[None, :]).sum(-1),
+            atol=1e-12,
+        )
+
+    def test_accumulate_semantics(self, rng):
+        X = rng.standard_normal((4, 2))
+        C0 = np.ones((4, 4))
+        D = np.asarray(euclidean_distance_matrix(X, alpha=2.0, beta=3.0, C=C0))
+        ref = 3.0 * C0 + 2.0 * ((X[:, None] - X[None, :]) ** 2).sum(-1)
+        np.testing.assert_allclose(D, ref, atol=1e-10)
+        with pytest.raises(ValueError):
+            euclidean_distance_matrix(X, beta=1.0)
+
+    def test_symmetric_default(self, rng):
+        X = rng.standard_normal((5, 3))
+        np.testing.assert_allclose(
+            np.asarray(l1_distance_matrix(X)),
+            np.asarray(l1_distance_matrix(X, X)),
+            atol=1e-12,
+        )
+
+
+class TestMetrics:
+    def test_accuracy(self):
+        assert float(classification_accuracy([1, 2, 2, 1], [1, 2, 1, 1])) == 75.0
+        with pytest.raises(ValueError):
+            classification_accuracy([1, 2], [1, 2, 3])
+
+    def test_mse(self):
+        assert float(mean_squared_error([1.0, 3.0], [0.0, 1.0])) == 2.5
+
+
+class TestRLS:
+    def test_classification(self, rng):
+        X, y = blobs(rng, 40, 5)
+        model = RLS(GaussianKernel(5, 3.0)).train(X, y, regularization=1e-3)
+        pred = model.predict(X)
+        assert float(classification_accuracy(pred, y)) > 95.0
+
+    def test_regression_matches_direct(self, rng):
+        X = rng.standard_normal((30, 4))
+        y = rng.standard_normal(30)
+        lam = 0.5
+        model = RLS(LinearKernel(4)).train(X, y, lam, multiclass=False)
+        K = X @ X.T
+        alpha = np.linalg.solve(K + lam * np.eye(30), y)
+        np.testing.assert_allclose(
+            np.asarray(model.predict(X)), K @ alpha, rtol=1e-6, atol=1e-8
+        )
+
+
+class TestSketchRLS:
+    def test_classification(self, rng):
+        X, y = blobs(rng, 50, 6)
+        ctx = SketchContext(seed=5)
+        model = SketchRLS(GaussianKernel(6, 3.0)).train(
+            X, y, ctx, random_features=256, regularization=1e-3
+        )
+        assert float(classification_accuracy(model.predict(X), y)) > 92.0
+
+    def test_approaches_exact_rls(self, rng):
+        """More features → predictions approach exact kernel RLS (the
+        reference's doctest contract: sketched accuracy tracks exact)."""
+        X, y = blobs(rng, 40, 4, sep=3.0)
+        exact = RLS(GaussianKernel(4, 2.0)).train(X, y, 1e-2)
+        ctx = SketchContext(seed=11)
+        sk = SketchRLS(GaussianKernel(4, 2.0)).train(
+            X, y, ctx, random_features=1024, regularization=1e-2
+        )
+        agree = np.mean(
+            np.asarray(exact.predict(X)) == np.asarray(sk.predict(X))
+        )
+        assert agree > 0.95
+
+
+class TestNystromRLS:
+    @pytest.mark.parametrize("probdist", ["uniform", "leverages"])
+    def test_classification(self, rng, probdist):
+        X, y = blobs(rng, 50, 5)
+        ctx = SketchContext(seed=7)
+        model = NystromRLS(GaussianKernel(5, 3.0)).train(
+            X, y, ctx, random_features=60, regularization=1e-3, probdist=probdist
+        )
+        assert float(classification_accuracy(model.predict(X), y)) > 92.0
+
+    def test_bad_probdist(self, rng):
+        X, y = blobs(rng, 10, 3)
+        with pytest.raises(ValueError):
+            NystromRLS(GaussianKernel(3, 1.0)).train(
+                X, y, SketchContext(seed=1), probdist="nope"
+            )
+
+
+class TestSketchPCR:
+    def test_classification(self, rng):
+        X, y = blobs(rng, 50, 6)
+        ctx = SketchContext(seed=13)
+        model = SketchPCR(GaussianKernel(6, 3.0)).train(X, y, ctx, rank=64)
+        assert float(classification_accuracy(model.predict(X), y)) > 90.0
+
+    def test_regression_low_rank_recovery(self, rng):
+        """PCR on a linear kernel with rank ≥ d recovers a linear map."""
+        X = rng.standard_normal((80, 5))
+        w = rng.standard_normal(5)
+        y = X @ w
+        ctx = SketchContext(seed=3)
+        model = SketchPCR(LinearKernel(5)).train(
+            X, y, ctx, rank=5, s=5, t=40, multiclass=False
+        )
+        pred = np.asarray(model.predict(X))
+        assert float(mean_squared_error(pred, y)) < 1e-3 * float(np.var(y))
+
+    def test_param_validation(self, rng):
+        X, y = blobs(rng, 10, 3)
+        with pytest.raises(ValueError):
+            SketchPCR(GaussianKernel(3, 1.0)).train(
+                X, y, SketchContext(seed=1), rank=8, s=4
+            )
