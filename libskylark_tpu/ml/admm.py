@@ -139,7 +139,9 @@ class BlockADMMSolver:
         with timer.phase("factor") as ph:
             Ls = [
                 jnp.linalg.cholesky(
-                    jnp.einsum("pst,put->psu", Z, Z)
+                    # highest: default f32 matmul (bf16 passes on TPU) can
+                    # push Z·Zᵀ + I indefinite → silent NaN factors.
+                    jnp.einsum("pst,put->psu", Z, Z, precision="highest")
                     + jnp.eye(Z.shape[1], dtype=dtype)
                 )
                 for Z in Zs

@@ -63,6 +63,15 @@ class KrrParams(Params):
     max_split: int = 0              # feature chunk size (large-scale)
 
 
+def _psd_gram(A, B):
+    """Gram products feeding a Cholesky run at ``precision='highest'``:
+    TPU's default f32 matmul passes through bf16, whose error can push
+    ``ZᵀZ + λI`` indefinite for small λ (cho_factor then yields silent
+    NaNs).  bf16 inputs are unaffected (their f32 accumulation is exact,
+    so the computed Gram is exactly PSD) and keep full MXU rate."""
+    return jnp.dot(A, B, precision="highest")
+
+
 def _as2d(Y):
     Y = jnp.asarray(Y)
     return (Y[:, None], True) if Y.ndim == 1 else (Y, False)
@@ -116,7 +125,7 @@ def approximate_kernel_ridge(
     Z = S.apply(X, Dimension.ROWWISE)  # (n, s)
     if params.sketched_rr:
         return _solve_sketched_ridge(S, Z, Y2, lam, s, context, params)
-    G = fully_replicated(Z.T @ Z + lam * jnp.eye(s, dtype=Z.dtype))
+    G = fully_replicated(_psd_gram(Z.T, Z) + lam * jnp.eye(s, dtype=Z.dtype))
     W = cho_solve(cho_factor(G, lower=True), Z.T @ Y2)
     return FeatureMapModel([S], W)
 
@@ -129,7 +138,7 @@ def _solve_sketched_ridge(S, Z, Y2, lam, s, context, params):
     R = create_sketch(sk_type, n, t, context)
     SZ = R.apply(Z, Dimension.COLUMNWISE)  # (t, s)
     SY = R.apply(Y2, Dimension.COLUMNWISE)  # (t, k)
-    G = fully_replicated(SZ.T @ SZ + lam * jnp.eye(s, dtype=Z.dtype))
+    G = fully_replicated(_psd_gram(SZ.T, SZ) + lam * jnp.eye(s, dtype=Z.dtype))
     W = cho_solve(cho_factor(G, lower=True), SZ.T @ SY)
     return FeatureMapModel([S], W)
 
@@ -154,7 +163,7 @@ class _FeatureMapPrecond:
         U = S.apply(jnp.asarray(X), Dimension.ROWWISE).T  # (s, n)
         lam = jnp.asarray(lam, U.dtype)
         C = fully_replicated(
-            jnp.eye(s, dtype=U.dtype) + (U @ U.T) / lam
+            jnp.eye(s, dtype=U.dtype) + _psd_gram(U, U.T) / lam
         )
         L = jnp.linalg.cholesky(C)
         self.U = solve_triangular(L, U, lower=True) / lam
@@ -252,7 +261,9 @@ def large_scale_kernel_ridge(
             lam_ = jnp.asarray(lam, dtype)
             Ws = [jnp.zeros((sz, t), dtype) for sz in sizes]
             R = Y2.astype(dtype)
-        G = fully_replicated(Z @ Z.T + lam_ * jnp.eye(Z.shape[0], dtype=dtype))
+        G = fully_replicated(
+            _psd_gram(Z, Z.T) + lam_ * jnp.eye(Z.shape[0], dtype=dtype)
+        )
         Lc = cho_factor(G, lower=True)
         factors.append(Lc)
         ZR = Z @ R - lam_ * Ws[c]

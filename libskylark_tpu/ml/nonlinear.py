@@ -46,6 +46,7 @@ from ..sketch.hash import CWT
 from ..sketch.sampling import NURST
 from .coding import decode_labels, dummy_coding
 from .kernels import Kernel, _dense
+from .krr import _psd_gram
 
 __all__ = ["RLS", "SketchRLS", "NystromRLS", "SketchPCR"]
 
@@ -106,7 +107,9 @@ class SketchRLS(_LabeledModel):
         T = self._encode(Y, multiclass)
         self.rft = self.kernel.create_rft(random_features, subtype, context)
         Z = self.rft.apply(X, Dimension.ROWWISE)  # (n, s)
-        A = Z.T @ Z + regularization * jnp.eye(Z.shape[1], dtype=Z.dtype)
+        A = _psd_gram(Z.T, Z) + regularization * jnp.eye(
+            Z.shape[1], dtype=Z.dtype
+        )
         self.weights = cho_solve(cho_factor(A, lower=True), Z.T @ T)
         return self
 
@@ -159,7 +162,9 @@ class NystromRLS(_LabeledModel):
         evals = jnp.maximum(evals, self._EPS)
         self.U = evecs / jnp.sqrt(evals)[None, :]  # whitener K_ll^{-1/2}
         Z = self.kernel.gram(X, SX) @ self.U  # (n, l) Nyström features
-        A = Z.T @ Z + regularization * jnp.eye(Z.shape[1], dtype=Z.dtype)
+        A = _psd_gram(Z.T, Z) + regularization * jnp.eye(
+            Z.shape[1], dtype=Z.dtype
+        )
         self.weights = cho_solve(cho_factor(A, lower=True), Z.T @ T)
         self.SX = SX
         return self

@@ -36,6 +36,14 @@ def _use_pallas() -> bool:
     )
 
 
+# Effective MXU flops-per-HBM-byte at which the explicit subsampled-
+# Hadamard matmul overtakes the streamed WHT + lane gather, per matmul
+# dtype (measured on v5e: the gather runs far below streaming bandwidth,
+# so the crossover favors the matmul strongly for bf16; f32 pays the
+# 6-pass full-precision matmul).  Tuned in bench.py's fjlt sweep.
+_GEMM_FPB = {jnp.bfloat16: 500.0, jnp.float32: 80.0}
+
+
 @register_sketch
 class FJLT(SketchTransform):
     """S·F·D: sample S coordinates of a randomized fast unitary transform.
@@ -65,6 +73,11 @@ class FJLT(SketchTransform):
         dim = Dimension.of(dim)
         if self._fut_name == "wht" and not hasattr(A, "todense"):
             A2 = jnp.asarray(A)
+            if A2.ndim == 2 and jnp.issubdtype(A2.dtype, jnp.floating):
+                rowwise = dim is Dimension.ROWWISE
+                sk_axis = 1 if rowwise else 0
+                if A2.shape[sk_axis] == self.n and self._gemm_wins(A2.dtype):
+                    return self._apply_srht_gemm(A2, rowwise)
             if (
                 A2.ndim == 2
                 and A2.dtype in (jnp.float32, jnp.bfloat16)
@@ -86,6 +99,58 @@ class FJLT(SketchTransform):
         T = self._rfut.apply(A, dim)
         scale = jnp.asarray(np.sqrt(self._nb / self.s), T.dtype)
         return scale * self._ust.apply(T, dim)
+
+    def _gemm_wins(self, dtype) -> bool:
+        """Gate for the subsampled-Hadamard-as-matmul path: per input
+        row/column the streamed WHT + gather moves ~(n + 2·NB + S)
+        itemsize bytes of HBM while the matmul does 2·n·S flops, so the
+        matmul wins whenever its flop/byte ratio stays under the dtype's
+        effective MXU-to-bandwidth ratio (``_GEMM_FPB``)."""
+        if os.environ.get("SKYLARK_NO_SRHT_GEMM", "0") == "1":
+            return False
+        fpb = _GEMM_FPB.get(jnp.dtype(dtype).type)
+        if fpb is None:  # f64 (CPU parity runs): matmul is fine, gate
+            fpb = 80.0   # like f32
+        itemsize = jnp.dtype(dtype).itemsize
+        return 2.0 * self.n * self.s <= fpb * itemsize * (
+            self.n + 2 * self._nb + self.s
+        )
+
+    def _srht_matrix(self, dtype):
+        """(n, S) matrix G with G[j, i] = D[j]·(-1)^popcount(j & r_i):
+        the S sampled columns of H_NB restricted to the first n rows (the
+        padding rows multiply zeros), with the Rademacher diagonal folded
+        in.  Entries are ±1 — exact in bf16 — so the 1/√S · √(NB/NB)
+        normalization is applied *after* the matmul in f32."""
+        idx = self.sample_indices  # (S,) in [0, NB)
+        j = jnp.arange(self.n, dtype=jnp.int32)
+        bits = jax.lax.population_count(j[:, None] & idx[None, :])
+        signs = (1 - 2 * (bits & 1)).astype(dtype)
+        return self._rfut.diagonal(dtype)[:, None] * signs
+
+    def _apply_srht_gemm(self, A2, rowwise: bool):
+        """out = scale · (sampled WHT columns of A ⊙ D) as ONE dense
+        matmul — same values as the WHT+gather path (same samples, same
+        diagonal), chosen by :meth:`_gemm_wins` when S is small enough
+        that 2·n·S flops beat the streamed transform + lane gather."""
+        dtype = A2.dtype
+        G = self._srht_matrix(dtype)
+        precision = "highest" if dtype != jnp.bfloat16 else None
+        acc = jnp.promote_types(dtype, jnp.float32)  # f32 accum for bf16
+        if rowwise:
+            out = jax.lax.dot_general(
+                A2, G, (((1,), (0,)), ((), ())),
+                precision=precision,
+                preferred_element_type=acc,
+            )
+        else:
+            out = jax.lax.dot_general(
+                G, A2, (((0,), (0,)), ((), ())),
+                precision=precision,
+                preferred_element_type=acc,
+            )
+        # orthonormal WHT (1/√NB) × sample rescale √(NB/S) = 1/√S.
+        return (out * acc.type(1.0 / np.sqrt(self.s))).astype(dtype)
 
     def _apply_pallas(self, A, interpret: bool = False):
         """Fused one-pass D·x → WHT kernel (natural order, matching the

@@ -136,6 +136,52 @@ class TestFJLT:
         )
 
 
+class TestFJLTSrhtGemm:
+    """The subsampled-Hadamard-as-matmul path must produce the SAME
+    transform as the streamed WHT + gather (same samples, same diagonal;
+    only the evaluation order differs)."""
+
+    @pytest.mark.parametrize(
+        "dim,shape", [("rowwise", (8, 300)), ("columnwise", (300, 8))]
+    )
+    def test_matches_wht_gather(self, rng, monkeypatch, dim, shape):
+        n, s = 300, 32
+        A = jnp.asarray(rng.standard_normal(shape))
+        S = FJLT(n, s, SketchContext(seed=17))
+        monkeypatch.setenv("SKYLARK_NO_SRHT_GEMM", "1")
+        ref = S.apply(A, dim)  # streamed WHT + gather
+        monkeypatch.delenv("SKYLARK_NO_SRHT_GEMM")
+        monkeypatch.setattr(FJLT, "_gemm_wins", lambda self, dtype: True)
+        out = S.apply(A, dim)
+        assert out.dtype == A.dtype
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-10, atol=1e-10
+        )
+
+    def test_pow2_n_no_padding(self, rng, monkeypatch):
+        n, s = 256, 64
+        A = jnp.asarray(rng.standard_normal((4, n)))
+        S = FJLT(n, s, SketchContext(seed=23))
+        monkeypatch.setenv("SKYLARK_NO_SRHT_GEMM", "1")
+        ref = S.apply(A, "rowwise")
+        monkeypatch.delenv("SKYLARK_NO_SRHT_GEMM")
+        out = S._apply_srht_gemm(A, rowwise=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-10, atol=1e-10
+        )
+
+    def test_gate(self, monkeypatch):
+        ctx = SketchContext(seed=1)
+        # the four measured configs from BASELINE.md (n=4096):
+        assert FJLT(4096, 256, ctx)._gemm_wins(jnp.float32)       # 30 < 38 ms
+        assert not FJLT(4096, 1024, ctx)._gemm_wins(jnp.float32)  # 55 > 45 ms
+        assert FJLT(4096, 1024, ctx)._gemm_wins(jnp.bfloat16)     # 16 < 26 ms
+        # huge S: matmul flops dominate → streamed path
+        assert not FJLT(4096, 4096, ctx)._gemm_wins(jnp.float32)
+        monkeypatch.setenv("SKYLARK_NO_SRHT_GEMM", "1")
+        assert not FJLT(4096, 128, ctx)._gemm_wins(jnp.float32)
+
+
 def _kernel_mse(Z, K):
     """Mean abs error between feature inner products and kernel matrix."""
     G = np.asarray(Z.T @ Z)
