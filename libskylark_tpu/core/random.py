@@ -87,14 +87,23 @@ def _mul_u32(a_hi, a_lo, c: int):
     return hi, lo
 
 
-def raw_bits(seed: int, base: int, num: int, lane: int = 0):
-    """64 random bits for counters ``base .. base+num`` as two uint32 arrays.
+def raw_bits(seed: int, base: int, num: int, lane: int = 0, offset=0):
+    """64 random bits for counters ``base+offset .. base+offset+num`` as two
+    uint32 arrays.
 
     Pure function of (seed, lane, counter): random access, no state.
+    ``offset`` may be a traced scalar (< 2^32; shard-dependent window
+    starts under ``shard_map``); ``base``/``num`` must be static.  Counter
+    math is uint32-pair with explicit carries, so windows crossing 2^32
+    stay exact.
     """
     idx = jax.lax.iota(jnp.uint32, num)
     b_hi, b_lo = _split64(base)
-    hi, lo = _add64(jnp.uint32(b_hi), jnp.uint32(b_lo), jnp.uint32(0), idx)
+    hi, lo = _add64(
+        jnp.uint32(b_hi), jnp.uint32(b_lo),
+        jnp.uint32(0), jnp.asarray(offset, jnp.uint32),
+    )
+    hi, lo = _add64(hi, lo, jnp.uint32(0), idx)
     out = threefry_2x32(_key(seed, lane), jnp.concatenate([hi, lo]))
     return out[:num], out[num:]
 
@@ -275,10 +284,12 @@ def sample(
     num: int,
     dtype=jnp.float32,
     lane: int = 0,
+    offset=0,
     **params: Any,
 ):
-    """1-D stream sample: values for counters ``base .. base+num``."""
-    hi, lo = raw_bits(seed, base, num, lane)
+    """1-D stream sample: values for counters ``base+offset ..
+    base+offset+num`` (``offset`` may be traced — see :func:`raw_bits`)."""
+    hi, lo = raw_bits(seed, base, num, lane, offset=offset)
     return DISTRIBUTIONS[dist](hi, lo, dtype, **params)
 
 

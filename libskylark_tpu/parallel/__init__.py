@@ -11,7 +11,12 @@ XLA from sharding constraints, or explicitly under ``shard_map`` where an
 invariant must be enforced by hand.
 """
 
-from .collectives import columnwise_sharded, rowwise_sharded
+from .collectives import (
+    columnwise_sharded,
+    columnwise_sharded_sparse,
+    rowwise_sharded,
+    rowwise_sharded_sparse,
+)
 from .mesh import (
     ROWS,
     COLS,
@@ -40,4 +45,6 @@ __all__ = [
     "sharding",
     "rowwise_sharded",
     "columnwise_sharded",
+    "rowwise_sharded_sparse",
+    "columnwise_sharded_sparse",
 ]

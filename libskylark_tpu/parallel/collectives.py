@@ -30,7 +30,12 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..sketch.base import Dimension
 from ..sketch.dense import DenseSketch
 
-__all__ = ["rowwise_sharded", "columnwise_sharded"]
+__all__ = [
+    "rowwise_sharded",
+    "columnwise_sharded",
+    "rowwise_sharded_sparse",
+    "columnwise_sharded_sparse",
+]
 
 
 def _coerce_float(A):
@@ -71,9 +76,7 @@ def columnwise_sharded(S: DenseSketch, A, mesh: Mesh, scatter: bool = False):
     """
     axes = tuple(mesh.axis_names)
     A = _coerce_float(A)
-    nshards = 1
-    for a in axes:
-        nshards *= mesh.shape[a]
+    nshards = mesh.size
     n = A.shape[0]
     if n % nshards:
         raise ValueError(f"rows {n} not divisible by mesh size {nshards}")
@@ -97,3 +100,134 @@ def columnwise_sharded(S: DenseSketch, A, mesh: Mesh, scatter: bool = False):
     return jax.shard_map(
         local, mesh=mesh, in_specs=P(axes, None), out_specs=out_spec
     )(A)
+
+
+# ---------------------------------------------------------------------------
+# P6: explicit sharded SPARSE hash-sketch schedules.
+#
+# The reference distributes sparse matrices on a CombBLAS √p×√p grid and
+# applies hash sketches block-locally, merging with an MPI reduce
+# (``sketch/hash_transform_CombBLAS.hpp:136-302``); its own docs call 2-D
+# sparse layouts imbalanced for 1-D data (``base/sparse_dist_matrix.hpp:37-41``).
+# The TPU re-design shards COO nonzeros by row block (balanced padding),
+# computes each shard's bucket/value counter window in-shard (P5: no sketch
+# data on the wire), scatter-adds into a dense (S, m) accumulator — sketch
+# outputs are short-and-dense by design, the mixed sparse→dense path of
+# ``hash_transform_Mixed.hpp`` — and merges with one psum (or psum_scatter,
+# the ragged-all-to-all stand-in that keeps the output sharded).
+
+
+def _shard_coo_rows(A, nshards: int, block: int):
+    """Host-side: split BCOO nonzeros into row blocks, padding each block
+    to equal nnz with zero-data entries (they scatter 0 — harmless)."""
+    import numpy as np
+
+    rows = np.asarray(A.indices[:, 0])
+    cols = np.asarray(A.indices[:, 1])
+    data = np.asarray(A.data)
+    owner = rows // block
+    counts = np.bincount(owner, minlength=nshards)
+    max_nnz = max(1, int(counts.max()))
+    d = np.zeros((nshards, max_nnz), data.dtype)
+    lr = np.zeros((nshards, max_nnz), np.int32)
+    cc = np.zeros((nshards, max_nnz), np.int32)
+    for p in range(nshards):
+        sel = owner == p
+        k = int(counts[p])
+        d[p, :k] = data[sel]
+        lr[p, :k] = rows[sel] - p * block
+        cc[p, :k] = cols[sel]
+    return jnp.asarray(d), jnp.asarray(lr), jnp.asarray(cc)
+
+
+def _coo_dtype(data):
+    return (
+        data.dtype
+        if jnp.issubdtype(data.dtype, jnp.floating)
+        else jnp.float32
+    )
+
+
+def columnwise_sharded_sparse(S, A, mesh: Mesh, scatter: bool = False):
+    """BCOO A (N, m), nonzeros owned by row block → dense S·A (S, m).
+
+    Each shard hashes its row block with its own bucket/value counter
+    windows (contiguous in the (nnz, N) flat layout, so shard-local) and
+    scatter-adds into a local (S, m) accumulator; one ``psum`` merges
+    (``psum_scatter`` with ``scatter=True`` leaves rows sharded).
+    """
+    axes = tuple(mesh.axis_names)
+    p = mesh.size
+    n, m = A.shape
+    if n != S.n:
+        raise ValueError(f"columnwise apply needs A with {S.n} rows, got {A.shape}")
+    if n % p:
+        raise ValueError(f"rows {n} not divisible by mesh size {p}")
+    if scatter and S.s % p:
+        raise ValueError(f"S={S.s} not divisible by mesh size for scatter")
+    block = n // p
+    d, lr, cc = _shard_coo_rows(A, p, block)
+    dtype = _coo_dtype(d)
+
+    def local(d, lr, cc):
+        d, lr, cc = d[0].astype(dtype), lr[0], cc[0]
+        idx = jax.lax.axis_index(axes)
+        acc = jnp.zeros((S.s * m,), dtype)
+        for h in range(S.nnz):
+            start = h * S.n + idx * block
+            b = S.buckets(start=start, num=block)  # (block,) in-shard
+            v = S.values(dtype, start=start, num=block)
+            acc = acc + jax.ops.segment_sum(
+                d * v[lr], b[lr] * m + cc, num_segments=S.s * m
+            )
+        out = acc.reshape(S.s, m)
+        if scatter:
+            return jax.lax.psum_scatter(
+                out, axes, scatter_dimension=0, tiled=True
+            )
+        return jax.lax.psum(out, axes)
+
+    out_spec = P(axes, None) if scatter else P(None, None)
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(axes, None), P(axes, None)),
+        out_specs=out_spec,
+    )(d, lr, cc)
+
+
+def rowwise_sharded_sparse(S, A, mesh: Mesh):
+    """BCOO A (m, N), nonzeros owned by row block → dense A·Sᵀ (m, S),
+    row-sharded.  Communication-free (≙ the ``[VC,*]`` rowwise invariant,
+    P2): the hashed axis is the replicated feature axis, so each shard
+    sketches its own rows with the full bucket table computed in-shard.
+    """
+    axes = tuple(mesh.axis_names)
+    p = mesh.size
+    m, n = A.shape
+    if n != S.n:
+        raise ValueError(f"rowwise apply needs A with {S.n} columns, got {A.shape}")
+    if m % p:
+        raise ValueError(f"rows {m} not divisible by mesh size {p}")
+    block = m // p
+    d, lr, cc = _shard_coo_rows(A, p, block)
+    dtype = _coo_dtype(d)
+
+    def local(d, lr, cc):
+        d, lr, cc = d[0].astype(dtype), lr[0], cc[0]
+        acc = jnp.zeros((block * S.s,), dtype)
+        for h in range(S.nnz):
+            start = h * S.n
+            b = S.buckets(start=start, num=S.n)
+            v = S.values(dtype, start=start, num=S.n)
+            acc = acc + jax.ops.segment_sum(
+                d * v[cc], lr * S.s + b[cc], num_segments=block * S.s
+            )
+        return acc.reshape(block, S.s)
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(axes, None), P(axes, None)),
+        out_specs=P(axes, None),
+    )(d, lr, cc)

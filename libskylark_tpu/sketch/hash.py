@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import sparse as jsparse
 
 from ..core.context import SketchContext
@@ -57,23 +58,43 @@ class HashSketch(SketchTransform):
 
     # -- counter-derived hash arrays ---------------------------------------
 
-    def buckets(self, start: int = 0, num: int | None = None):
+    def _window(self, start, num, total):
+        """(static_base_add, traced_offset, num) for a counter window.
+        ``start`` may be a traced scalar (shard-dependent under
+        ``shard_map``), in which case ``num`` is required."""
+        if isinstance(start, (int, np.integer)):
+            return int(start), 0, (total - int(start) if num is None else num)
+        if num is None:
+            raise ValueError("num is required when start is traced")
+        return 0, start, num
+
+    def buckets(self, start=0, num: int | None = None):
         """bucket[i] for i in [start, start+num) of the flat (nnz·N)
-        layout — shard-local computable."""
-        num = self.nnz * self.n - start if num is None else num
+        layout — shard-local computable, traced ``start`` supported."""
+        static, offset, num = self._window(start, num, total=self.nnz * self.n)
         return sample(
             "uniform_int",
             self._seed,
-            self._idx_base + start,
+            self._idx_base + static,
             num,
             dtype=jnp.int32,
+            offset=offset,
             low=0,
             high=self.s - 1,
         )
 
-    def values(self, dtype=jnp.float32, start: int = 0, num: int | None = None):
-        num = self.nnz * self.n - start if num is None else num
-        return sample(self.value_dist, self._seed, self._val_base + start, num, dtype=dtype)
+    def values(self, dtype=jnp.float32, start=0, num: int | None = None):
+        """Signed values, same flat layout and traced-``start`` support as
+        :meth:`buckets`."""
+        static, offset, num = self._window(start, num, total=self.nnz * self.n)
+        return sample(
+            self.value_dist,
+            self._seed,
+            self._val_base + static,
+            num,
+            dtype=dtype,
+            offset=offset,
+        )
 
     # -- apply --------------------------------------------------------------
 
@@ -239,10 +260,16 @@ class WZT(HashSketch):
         super().__init__(n, s, context)
         self._pm_base = context.reserve(n)
 
-    def values(self, dtype=jnp.float32, start: int = 0, num: int | None = None):
-        num = self.n - start if num is None else num
-        e = sample("exponential", self._seed, self._val_base + start, num, dtype=dtype)
-        pm = sample("rademacher", self._seed, self._pm_base + start, num, dtype=dtype)
+    def values(self, dtype=jnp.float32, start=0, num: int | None = None):
+        static, offset, num = self._window(start, num, total=self.n)
+        e = sample(
+            "exponential", self._seed, self._val_base + static, num,
+            dtype=dtype, offset=offset,
+        )
+        pm = sample(
+            "rademacher", self._seed, self._pm_base + static, num,
+            dtype=dtype, offset=offset,
+        )
         return pm * (1.0 / e) ** jnp.asarray(1.0 / self.p, dtype)
 
     def _param_dict(self):

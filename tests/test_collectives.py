@@ -9,12 +9,14 @@ from libskylark_tpu import SketchContext
 from libskylark_tpu.parallel import (
     ROWS,
     columnwise_sharded,
+    columnwise_sharded_sparse,
     default_mesh,
     make_mesh,
     rowwise_sharded,
+    rowwise_sharded_sparse,
     shard_rows,
 )
-from libskylark_tpu.sketch import CWT, JLT
+from libskylark_tpu.sketch import CWT, JLT, SJLT, WZT
 from libskylark_tpu.sketch import dense as dense_mod
 
 
@@ -58,6 +60,87 @@ class TestShardMapSchedules:
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=1e-8, atol=1e-10
         )
+
+
+def _random_bcoo(rng, shape, density=0.1):
+    from jax.experimental import sparse as jsparse
+
+    M = rng.standard_normal(shape) * (rng.random(shape) < density)
+    return jsparse.BCOO.fromdense(jnp.asarray(M)), M
+
+
+class TestSparseShardedSchedules:
+    """P6: sharded sparse hash sketches must equal the single-device BCOO
+    apply (same counter windows → same buckets/values, only the schedule
+    differs)."""
+
+    @pytest.mark.parametrize(
+        "sketch_cls,kw", [(CWT, {"nnz": 1}), (SJLT, {"nnz": 4}), (WZT, {"p": 1.5})]
+    )
+    def test_columnwise_psum(self, rng, sketch_cls, kw):
+        n, s, m = 128, 16, 24
+        A, _ = _random_bcoo(rng, (n, m))
+        mesh = default_mesh()
+        S = sketch_cls(n, s, SketchContext(seed=5), **kw)
+        ref = S.apply(A, "columnwise").todense()
+        out = columnwise_sharded_sparse(S, A, mesh)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-8, atol=1e-10
+        )
+
+    def test_columnwise_psum_scatter(self, rng):
+        n, s, m = 64, 32, 8
+        A, _ = _random_bcoo(rng, (n, m))
+        mesh = default_mesh()
+        S = CWT(n, s, SketchContext(seed=6))
+        ref = S.apply(A, "columnwise").todense()
+        out = columnwise_sharded_sparse(S, A, mesh, scatter=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-8, atol=1e-10
+        )
+
+    def test_rowwise_communication_free(self, rng):
+        n, s, m = 96, 12, 64
+        A, _ = _random_bcoo(rng, (m, n))
+        mesh = default_mesh()
+        S = CWT(n, s, SketchContext(seed=7))
+        ref = S.apply(A, "rowwise").todense()
+        out = rowwise_sharded_sparse(S, A, mesh)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-8, atol=1e-10
+        )
+
+    def test_ragged_row_blocks(self, rng):
+        # skew all nonzeros into the first row block: padding must stay
+        # harmless and the result exact
+        from jax.experimental import sparse as jsparse
+
+        n, s, m = 64, 8, 8
+        M = np.zeros((n, m))
+        M[: n // 8] = rng.standard_normal((n // 8, m))
+        A = jsparse.BCOO.fromdense(jnp.asarray(M))
+        mesh = default_mesh()
+        S = CWT(n, s, SketchContext(seed=8))
+        ref = S.apply(A, "columnwise").todense()
+        out = columnwise_sharded_sparse(S, A, mesh)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-8, atol=1e-10
+        )
+
+    def test_shape_validation(self, rng):
+        A, _ = _random_bcoo(rng, (60, 8))
+        mesh = default_mesh()
+        S = CWT(64, 8, SketchContext(seed=9))
+        with pytest.raises(ValueError):
+            columnwise_sharded_sparse(S, A, mesh)  # wrong N
+        S2 = CWT(60, 8, SketchContext(seed=10))
+        with pytest.raises(ValueError):
+            columnwise_sharded_sparse(S2, A, mesh)  # 60 % 8 != 0
+
+    def test_traced_start_requires_num(self):
+        S = CWT(64, 8, SketchContext(seed=11))
+        with pytest.raises(ValueError, match="num is required"):
+            jax.jit(lambda o: S.buckets(start=o))(jnp.uint32(3))
 
 
 class TestPanelBlockedApply:

@@ -41,6 +41,20 @@ def test_stream_vs_window():
     np.testing.assert_array_equal(np.asarray(stream).reshape(6, 9), np.asarray(win))
 
 
+def test_traced_offset_stream_matches_static():
+    """sample(base, offset=traced k) == sample(base+k) — including a
+    window whose counters cross the 2^32 carry boundary."""
+    import jax
+
+    base = (1 << 32) - 4
+    for k in (0, 2, 8):
+        static = sample("uniform", seed=11, base=base + k, num=16)
+        traced = jax.jit(
+            lambda o: sample("uniform", seed=11, base=base, num=16, offset=o)
+        )(jnp.uint32(k))
+        np.testing.assert_array_equal(np.asarray(static), np.asarray(traced))
+
+
 def test_disjoint_counters_disjoint_values():
     a = sample("normal", seed=1, base=0, num=100)
     b = sample("normal", seed=1, base=100, num=100)
