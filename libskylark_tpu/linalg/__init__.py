@@ -26,6 +26,8 @@ from .svd import (
     approximate_svd,
     approximate_symmetric_svd,
     power_iteration,
+    streaming_approximate_svd,
+    synthetic_lowrank_blocks,
 )
 
 __all__ = [
@@ -33,6 +35,8 @@ __all__ = [
     "approximate_svd",
     "approximate_symmetric_svd",
     "power_iteration",
+    "streaming_approximate_svd",
+    "synthetic_lowrank_blocks",
     "LeastSquaresParams",
     "approximate_least_squares",
     "exact_least_squares",

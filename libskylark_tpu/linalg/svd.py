@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.context import SketchContext
+from ..core.matrices import gaussian_matrix
 from ..core.params import Params
 from ..parallel.mesh import fully_replicated
 from ..sketch.base import Dimension
@@ -33,6 +34,8 @@ __all__ = [
     "power_iteration",
     "approximate_svd",
     "approximate_symmetric_svd",
+    "streaming_approximate_svd",
+    "synthetic_lowrank_blocks",
     "gram_orth",
 ]
 
@@ -167,3 +170,232 @@ def approximate_symmetric_svd(
     lam = lam[order][:k]
     V = (Q @ W)[:, order[:k]]
     return V, lam
+
+
+# ---------------------------------------------------------------------------
+# Streaming (matrix-free) randomized SVD — the n=1e7-row regime.
+#
+# ≙ the scale `skylark_svd --profile` exists for (nla/skylark_svd.cpp:37-60):
+# A too large for one memory, processed in row panels.  The reference's
+# answer is Elemental's distributed storage; on a single TPU chip the
+# counter-RNG design gives a better one — row blocks are *regenerated* (or
+# re-streamed) per sweep inside one compiled program, so only one (B, n)
+# block plus (n, s)/(s, s) accumulators are ever resident.  This is the
+# same memory-bounded pattern as ml's large_scale_kernel_ridge.
+
+
+def streaming_approximate_svd(
+    block_fn,
+    shape: tuple[int, int],
+    rank: int,
+    context: SketchContext,
+    params: SVDParams | None = None,
+    block_rows: int = 65536,
+    materialize_u: bool = False,
+):
+    """Randomized truncated SVD of a row-streamed A (m, n).
+
+    ``block_fn(start_row, rows)`` returns the (rows, n) panel of A; it must
+    be jit-traceable with a traced ``start_row`` (counter-generated
+    matrices and sharded arrays qualify; see
+    :func:`synthetic_lowrank_blocks`), and must return *bit-identical*
+    panels every time it is called — it is re-traced into more than one
+    compiled program, and the whitening step amplifies any cross-program
+    drift by 1/σ_min (avoid default-precision matmuls inside it).  Each
+    sweep re-requests every panel — O(q+2) passes over A, O(B·n + n·s)
+    resident memory.
+
+    Returns ``(u_block, s, V)`` where ``u_block(i)`` yields rows
+    ``[i·B, (i+1)·B)`` of U (the factored form keeps U off-memory for huge
+    m); with ``materialize_u=True`` the first element is U itself (m, k).
+
+    Math ≙ ``ApproximateSVD`` with explicit Gaussian test matrix: sweeps of
+    ``W ← Aᵀ(A·Ω)`` with Gram orthonormalization (power iteration), then a
+    fused pass accumulating ``G = YᵀY`` and ``M = YᵀA`` (Y = A·Ω), a second
+    streamed whitening pass (CholeskyQR2), and a small SVD of ``B = QᵀA``.
+
+    f32 note: with ``num_iterations=0`` on a noisy spectrum the Gram
+    whitening's f32 error mixes signal into the oversampling directions
+    and the rank-k truncation can lose real signal (measured ~0.3 relative
+    sv error on hardware); ``num_iterations >= 1`` restores ~1e-3 accuracy
+    and should be the default choice at this scale.
+    """
+    params = params or SVDParams()
+    m, n = shape
+    k = int(rank)
+    if k > min(m, n):
+        raise ValueError(f"rank {k} exceeds min(shape) = {min(m, n)}")
+    if block_rows <= 0:
+        raise ValueError(f"block_rows must be positive, got {block_rows}")
+    if m % block_rows:
+        raise ValueError(f"m={m} not divisible by block_rows={block_rows}")
+    nblocks = m // block_rows
+    s = min(k * params.oversampling_ratio + params.oversampling_additive, n)
+    s = max(s, k)
+
+    # Accumulator dtype follows the panels (f64 panels → f64 accumulators
+    # and eps — the x64 parity path must not silently demote to f32).
+    panel_dtype = jax.eval_shape(
+        lambda s0: block_fn(s0, block_rows),
+        jax.ShapeDtypeStruct((), jnp.int32),
+    ).dtype
+    acc = jnp.promote_types(panel_dtype, jnp.float32)
+
+    Om = gaussian_matrix(context, (n, s), dtype=acc)
+
+    def _panel_y(Ab, Om):
+        """Y panel = A_b·Ω at full f32 precision.  'highest' is load-
+        bearing: the whitener amplifies Y errors by 1/σ_min(kept), and Y
+        must be numerically IDENTICAL between the factor program and
+        ``u_block``'s separately-compiled program — default-precision
+        (bf16-pass) matmuls can differ across compilations, which showed
+        up as O(1) orthogonality loss in U on real hardware."""
+        return jnp.dot(Ab, Om.astype(Ab.dtype), precision="highest")
+
+    def _sweep(Om):
+        """One power pass: Aᵀ(A·Ω) accumulated over row panels.  Default
+        matmul precision — the sweep only steers the subspace (any Ω
+        works); the resulting Omq is computed once and reused as an array,
+        so the cross-program consistency that forces ``_panel_y`` to
+        'highest' elsewhere does not apply here."""
+
+        def body(i, W):
+            Ab = block_fn(i * block_rows, block_rows)
+            return W + jnp.dot(
+                Ab.T, Ab @ Om.astype(Ab.dtype),
+                preferred_element_type=acc,
+            )
+
+        return lax.fori_loop(0, nblocks, body, jnp.zeros((n, s), acc))
+
+    @jax.jit
+    def _power_and_factor():
+        W = Om
+        for _ in range(max(params.num_iterations, 0)):
+            # skip_qr ≙ the reference's ortho flag: raw power sweeps
+            # (overflow-prone for spread spectra — the user's choice).
+            W = _sweep(W) if params.skip_qr else _orth(_sweep(W))
+        Omq = W if params.num_iterations > 0 else Om
+
+        def body(i, carry):
+            G, M = carry
+            Ab = block_fn(i * block_rows, block_rows)
+            Yb = _panel_y(Ab, Omq)
+            G = G + jnp.dot(
+                Yb.T, Yb, precision="highest",
+                preferred_element_type=acc,
+            )
+            M = M + jnp.dot(
+                Yb.T, Ab, precision="highest",
+                preferred_element_type=acc,
+            )
+            return G, M
+
+        G, M = lax.fori_loop(
+            0,
+            nblocks,
+            body,
+            (jnp.zeros((s, s), acc), jnp.zeros((s, n), acc)),
+        )
+        # Whiten: Q = (Y·T1)·T2, both factors eigh-based V·lam^{-1/2}.
+        def whiten(G, rel_floor):
+            lam, V = jnp.linalg.eigh(G)
+            floor = jnp.maximum(lam[-1], 0) * rel_floor
+            scale = jnp.where(
+                lam > floor, jax.lax.rsqrt(jnp.maximum(lam, floor)), 0.0
+            )
+            return V * scale[None, :]
+
+        # Stage 1: loose floor (4·eps) — keep marginal directions whose
+        # Gram eigenvalues are only a few× the f32 representation noise;
+        # stage 2 either repairs or rejects them.
+        eps = jnp.finfo(acc).eps
+        T1 = whiten(G, 4.0 * eps)  # (s, s)
+        # Stage 2 (streamed CholeskyQR2): one-pass Gram whitening leaves
+        # ~eps·cond(G) orthogonality error — O(1) in f32 when Y mixes
+        # signal and noise-level directions.  Re-accumulate the Gram of
+        # the *whitened* panels: genuine directions land near 1 and are
+        # re-whitened exactly; directions whose stage-1 estimate was pure
+        # representation noise land far below 1 and are dropped (0.25
+        # reliability floor).  Exactly-rank-deficient A never reaches
+        # stage 2 (true zero eigenvalues are below even the loose floor).
+        def body2(i, G2):
+            Ab = block_fn(i * block_rows, block_rows)
+            Qb = jnp.dot(
+                _panel_y(Ab, Omq), T1.astype(Ab.dtype), precision="highest"
+            )
+            return G2 + jnp.dot(
+                Qb.T, Qb, precision="highest",
+                preferred_element_type=acc,
+            )
+
+        G2 = lax.fori_loop(0, nblocks, body2, jnp.zeros((s, s), acc))
+        T2 = whiten(G2, 0.25)
+        # CRITICAL: T1 and T2 stay FACTORED.  T1's columns span orders of
+        # magnitude; forming T1·T2 mixes those scales before the O(1)
+        # whitening of Y·T1 happens, and the associativity error destroys
+        # Q's orthonormality.  Apply left-to-right: ((Y·T1)·T2)·Ub.
+        B = T2.T @ (T1.T @ M)  # = Qᵀ·A  (s, n)
+        Ub, sv, Vt = jnp.linalg.svd(B, full_matrices=False)
+        rot2 = T2 @ Ub[:, :k]  # Q·Ub = (Y·T1)·rot2 = U
+        return Omq, T1, rot2, sv[:k], Vt[:k].T
+
+    Omq, T1, rot2, sv, V = _power_and_factor()
+
+    @jax.jit
+    def u_block_traced(start):
+        Ab = block_fn(start, block_rows)
+        Q1 = jnp.dot(_panel_y(Ab, Omq), T1.astype(Ab.dtype), precision="highest")
+        return jnp.dot(Q1, rot2.astype(Ab.dtype), precision="highest")
+
+    def u_block(i: int):
+        """Rows [i·block_rows, (i+1)·block_rows) of U."""
+        return u_block_traced(i * block_rows)
+
+    if materialize_u:
+        U = jnp.concatenate([u_block(i) for i in range(nblocks)], axis=0)
+        return U, sv, V
+    return u_block, sv, V
+
+
+def synthetic_lowrank_blocks(
+    context: SketchContext,
+    m: int,
+    n: int,
+    r: int,
+    noise: float = 0.0,
+    dtype=jnp.float32,
+    decay: float = 1.0,
+):
+    """Jit-traceable row-panel generator for A = L·diag(w)·Rᵀ + noise·E,
+    with L (m, r), R (n, r), E (m, n) counter-generated (any panel is a
+    window of the logical stream — ``core/random.py::sample_window``) and
+    ``w[j] = decay^j``.  ≙ the synthetic ``--profile`` matrix of
+    ``nla/skylark_svd.cpp:37-60``, but never materialized.
+    """
+    from ..core.random import sample_window
+
+    base_L = context.reserve(m * r)
+    base_E = context.reserve(m * n)
+    R = gaussian_matrix(context, (n, r), dtype=dtype)
+    w = jnp.asarray(decay, jnp.float32) ** jnp.arange(r)
+    Rw = (R * w[None, :].astype(dtype)).T  # (r, n)
+
+    def block_fn(start_row, rows: int):
+        Lb = sample_window(
+            "normal", context.seed, base_L, (m, r),
+            offset=(start_row, 0), shape=(rows, r), dtype=dtype,
+        )
+        # highest: panels must be BIT-IDENTICAL across separately compiled
+        # programs (streaming_approximate_svd's contract) — a default-
+        # precision matmul can fuse differently per program and break it.
+        Ab = jnp.dot(Lb, Rw, precision="highest")
+        if noise:
+            Eb = sample_window(
+                "normal", context.seed, base_E, (m, n),
+                offset=(start_row, 0), shape=(rows, n), dtype=dtype,
+            )
+            Ab = Ab + jnp.asarray(noise, dtype) * Eb
+        return Ab
+
+    return block_fn

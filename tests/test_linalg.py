@@ -21,6 +21,8 @@ from libskylark_tpu.linalg import (
     approximate_symmetric_svd,
     exact_least_squares,
     power_iteration,
+    streaming_approximate_svd,
+    synthetic_lowrank_blocks,
 )
 from libskylark_tpu.parallel import default_mesh, shard_rows
 
@@ -102,6 +104,69 @@ class TestApproximateSVD:
         f = jax.jit(lambda X: approximate_svd(X, 4, SketchContext(seed=5)))
         U, s, V = f(A)
         assert U.shape == (64, 4) and s.shape == (4,) and V.shape == (32, 4)
+
+
+class TestStreamingSVD:
+    """Matrix-free row-streamed randomized SVD vs materialized oracles."""
+
+    def _materialize(self, block_fn, m, n, block_rows):
+        return np.vstack(
+            [np.asarray(block_fn(i, block_rows)) for i in range(0, m, block_rows)]
+        )
+
+    def test_exact_on_low_rank(self):
+        ctx = SketchContext(seed=31)
+        m, n, r = 256, 48, 5
+        block_fn = synthetic_lowrank_blocks(ctx, m, n, r, noise=0.0, decay=0.5)
+        A = self._materialize(block_fn, m, n, 64)
+        U, s, V = streaming_approximate_svd(
+            block_fn, (m, n), r, ctx, block_rows=64, materialize_u=True
+        )
+        rec = np.asarray(U) @ np.diag(np.asarray(s)) @ np.asarray(V).T
+        assert np.linalg.norm(rec - A) / np.linalg.norm(A) < 1e-4
+        s_true = np.linalg.svd(A, compute_uv=False)[:r]
+        np.testing.assert_allclose(np.asarray(s), s_true, rtol=1e-4)
+
+    def test_noisy_singular_values_statistical(self):
+        # ≙ tests/regression/svd_test.py bounds, streamed.
+        ctx = SketchContext(seed=33)
+        m, n, r = 512, 64, 8
+        block_fn = synthetic_lowrank_blocks(ctx, m, n, r, noise=0.05, decay=0.8)
+        A = self._materialize(block_fn, m, n, 128)
+        s_true = np.linalg.svd(A, compute_uv=False)[:r]
+        _, s, _ = streaming_approximate_svd(
+            block_fn, (m, n), r, ctx,
+            SVDParams(num_iterations=3, oversampling_ratio=3),
+            block_rows=128,
+        )
+        assert np.all(np.abs(np.asarray(s) - s_true) <= 0.5 * s_true)
+
+    def test_u_block_matches_materialized(self):
+        ctx = SketchContext(seed=35)
+        m, n, r = 128, 32, 4
+        block_fn = synthetic_lowrank_blocks(ctx, m, n, r, noise=0.01)
+        ctx2 = SketchContext(seed=35)
+        block_fn2 = synthetic_lowrank_blocks(ctx2, m, n, r, noise=0.01)
+        u_block, s1, V1 = streaming_approximate_svd(
+            block_fn, (m, n), r, ctx, block_rows=32
+        )
+        U, s2, V2 = streaming_approximate_svd(
+            block_fn2, (m, n), r, ctx2, block_rows=32, materialize_u=True
+        )
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-6)
+        got = np.vstack([np.asarray(u_block(i)) for i in range(4)])
+        np.testing.assert_allclose(got, np.asarray(U), rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(
+            got.T @ got, np.eye(r), atol=1e-3
+        )
+
+    def test_validation(self):
+        ctx = SketchContext(seed=37)
+        block_fn = synthetic_lowrank_blocks(ctx, 64, 16, 2)
+        with pytest.raises(ValueError, match="divisible"):
+            streaming_approximate_svd(block_fn, (64, 16), 2, ctx, block_rows=48)
+        with pytest.raises(ValueError, match="rank"):
+            streaming_approximate_svd(block_fn, (64, 16), 20, ctx, block_rows=32)
 
 
 class TestSymmetricSVD:
