@@ -23,7 +23,11 @@ def main(argv=None) -> int:
     p.add_argument("--rank", "-k", type=int, default=6)
     p.add_argument("--seed", type=int, default=38734)
     p.add_argument("--sparse", action="store_true", help="load as BCOO")
-    p.add_argument("--num-iterations", "-i", type=int, default=0)
+    p.add_argument(
+        "--num-iterations", "-i", type=int, default=None,
+        help="power-iteration sweeps (default 0; 1 with --stream, where "
+        "f32 q=0 is documented-inaccurate on noisy spectra)",
+    )
     p.add_argument("--oversampling-ratio", type=int, default=2)
     p.add_argument("--oversampling-additive", type=int, default=0)
     p.add_argument("--skip-qr", action="store_true")
@@ -57,6 +61,8 @@ def main(argv=None) -> int:
     from ..io import read_libsvm
     from ..linalg import SVDParams, approximate_svd
 
+    if args.num_iterations is None:
+        args.num_iterations = 1 if args.stream is not None else 0
     params = SVDParams(
         oversampling_ratio=args.oversampling_ratio,
         oversampling_additive=args.oversampling_additive,

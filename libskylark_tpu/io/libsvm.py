@@ -22,13 +22,16 @@ def read_libsvm(
     n_features: int | None = None,
     sparse: bool = False,
     dtype=np.float64,
+    max_rows: int | None = None,
 ):
     """Read a LIBSVM file → ``(X, y)``.
 
     ``sparse=True`` returns a ``jax.experimental.sparse.BCOO``; otherwise a
     dense ndarray.  ``n_features`` pads/clips the feature dimension (the
-    reference's ``min_d`` flag, ``ml/io.hpp:534``).  Indices are 1-based in
-    the file (LIBSVM standard, matching the reference reader).
+    reference's ``min_d`` flag, ``ml/io.hpp:534``); ``max_rows`` caps the
+    number of examples read (the reference's ``max_n``,
+    ``capi/cio.cpp sl_readlibsvm``).  Indices are 1-based in the file
+    (LIBSVM standard, matching the reference reader).
 
     Parsing uses the native multithreaded C++ parser when built
     (``libskylark_tpu.native``, ≙ the reference's native chunked reader);
@@ -36,8 +39,11 @@ def read_libsvm(
     """
     from .. import native
 
+    # max_rows must bound both the result AND the parsing work (the
+    # reference's reader stops early), so it bypasses the slurp-everything
+    # native fast path and breaks out of the line loop.
     parsed = None
-    if native.available():
+    if native.available() and max_rows is None:
         with open(path, "rb") as f:
             data = f.read()
         try:
@@ -47,8 +53,6 @@ def read_libsvm(
     if parsed is not None:
         y_all, rows_a, cols_a, vals_a = parsed[:4]
         n = len(y_all)
-        max_col = int(cols_a.max()) + 1 if len(cols_a) else 0
-        d = n_features if n_features is not None else max_col
         y = y_all.astype(dtype)
         vals_a = vals_a.astype(dtype)
     else:
@@ -58,14 +62,18 @@ def read_libsvm(
         vals: list[float] = []
         with open(path, "r") as f:
             for line in f:
+                if max_rows is not None and len(labels) >= max_rows:
+                    break
                 _parse_line(line, labels, rows, cols, vals)
-        max_col = max(cols) + 1 if cols else 0
         n = len(labels)
-        d = n_features if n_features is not None else max_col
         y = np.asarray(labels, dtype=dtype)
         rows_a = np.asarray(rows, dtype=np.int64)
         cols_a = np.asarray(cols, dtype=np.int64)
         vals_a = np.asarray(vals, dtype=dtype)
+    # Feature dimension is inferred AFTER the row cap, so columns that
+    # appear only in discarded rows don't widen X.
+    max_col = int(cols_a.max()) + 1 if len(cols_a) else 0
+    d = n_features if n_features is not None else max_col
     keep = cols_a < d
     rows_a, cols_a, vals_a = rows_a[keep], cols_a[keep], vals_a[keep]
     if sparse:

@@ -77,6 +77,17 @@ def gram_orth(Y, passes: int = 2):
 _orth = gram_orth
 
 
+def _sketch_size(k: int, params: SVDParams, n: int, m: int | None = None):
+    """Validated (k, s): oversampled sketch width clamped to n
+    (≙ ``nla/svd.hpp`` sizing, shared by all three SVD entry points)."""
+    k = int(k)
+    lim = n if m is None else min(m, n)
+    if k > lim:
+        raise ValueError(f"rank {k} exceeds min matrix dimension {lim}")
+    s = min(k * params.oversampling_ratio + params.oversampling_additive, n)
+    return k, max(s, k)
+
+
 def power_iteration(A, Q, num_iterations: int, orthogonalize: bool = True):
     """Subspace iteration ``Q <- orth((A·Aᵀ)·Q)``, repeated.
 
@@ -110,11 +121,7 @@ def approximate_svd(
     if not hasattr(A, "todense"):  # keep BCOO sparse inputs as-is
         A = jnp.asarray(A)
     m, n = A.shape
-    k = int(rank)
-    if k > min(m, n):
-        raise ValueError(f"rank {k} exceeds min(A.shape) = {min(m, n)}")
-    s = min(k * params.oversampling_ratio + params.oversampling_additive, n)
-    s = max(s, k)
+    k, s = _sketch_size(rank, params, n, m)
 
     # Q = A·Omegaᵀ — rowwise JLT sketch (nla/svd.hpp:255-257).
     omega = JLT(n, s, context)
@@ -151,11 +158,7 @@ def approximate_symmetric_svd(
     if not hasattr(A, "todense"):
         A = jnp.asarray(A)
     n = A.shape[0]
-    k = int(rank)
-    if k > n:
-        raise ValueError(f"rank {k} exceeds matrix dimension {n}")
-    s = min(k * params.oversampling_ratio + params.oversampling_additive, n)
-    s = max(s, k)
+    k, s = _sketch_size(rank, params, n)
 
     omega = JLT(n, s, context)
     Y = omega.apply(A, Dimension.ROWWISE)  # A·Omegaᵀ (symmetric A)
@@ -222,16 +225,12 @@ def streaming_approximate_svd(
     """
     params = params or SVDParams()
     m, n = shape
-    k = int(rank)
-    if k > min(m, n):
-        raise ValueError(f"rank {k} exceeds min(shape) = {min(m, n)}")
+    k, s = _sketch_size(rank, params, n, m)
     if block_rows <= 0:
         raise ValueError(f"block_rows must be positive, got {block_rows}")
     if m % block_rows:
         raise ValueError(f"m={m} not divisible by block_rows={block_rows}")
     nblocks = m // block_rows
-    s = min(k * params.oversampling_ratio + params.oversampling_additive, n)
-    s = max(s, k)
 
     # Accumulator dtype follows the panels (f64 panels → f64 accumulators
     # and eps — the x64 parity path must not silently demote to f32).
@@ -378,7 +377,8 @@ def synthetic_lowrank_blocks(
     base_L = context.reserve(m * r)
     base_E = context.reserve(m * n)
     R = gaussian_matrix(context, (n, r), dtype=dtype)
-    w = jnp.asarray(decay, jnp.float32) ** jnp.arange(r)
+    wdtype = jnp.promote_types(dtype, jnp.float32)
+    w = jnp.asarray(decay, wdtype) ** jnp.arange(r)
     Rw = (R * w[None, :].astype(dtype)).T  # (r, n)
 
     def block_fn(start_row, rows: int):

@@ -37,3 +37,19 @@ def test_pad_features(tmp_path):
     (tmp_path / "f").write_text("0 1:1\n")
     X, _ = read_libsvm(tmp_path / "f", n_features=5)
     assert X.shape == (1, 5)
+
+
+def test_max_rows(tmp_path):
+    (tmp_path / "f").write_text("1 1:1\n2 2:2\n3 3:3\n")
+    X, y = read_libsvm(tmp_path / "f", n_features=3, max_rows=2)
+    assert X.shape == (2, 3)
+    np.testing.assert_allclose(y, [1, 2])
+    Xs, ys = read_libsvm(tmp_path / "f", n_features=3, max_rows=2, sparse=True)
+    assert Xs.shape == (2, 3)
+    np.testing.assert_allclose(np.asarray(Xs.todense()), np.asarray(X))
+    # max_rows beyond the file is a no-op
+    X3, _ = read_libsvm(tmp_path / "f", max_rows=99)
+    assert X3.shape[0] == 3
+    # inferred width comes from the KEPT rows only
+    X4, _ = read_libsvm(tmp_path / "f", max_rows=2)
+    assert X4.shape == (2, 2)
