@@ -220,10 +220,11 @@ def streaming_approximate_svd(
     f32 note: with ``num_iterations=0`` on a noisy spectrum the Gram
     whitening's f32 error mixes signal into the oversampling directions
     and the rank-k truncation can lose real signal (measured ~0.3 relative
-    sv error on hardware); ``num_iterations >= 1`` restores ~1e-3 accuracy
-    and should be the default choice at this scale.
+    sv error on hardware); for that reason the streaming path defaults to
+    ``num_iterations=1`` when ``params`` is omitted (pass explicit params
+    to override).
     """
-    params = params or SVDParams()
+    params = params or SVDParams(num_iterations=1)
     m, n = shape
     k, s = _sketch_size(rank, params, n, m)
     if block_rows <= 0:
