@@ -158,7 +158,11 @@ class BlockADMMSolver:
                 lambda l, b: solve_triangular(l.T, b, lower=False)
             )(L, Ysol)
 
-        def step(state):
+        # Zs/Ls/Yp enter as ARGUMENTS, not closure captures: jit would
+        # embed closed-over device arrays as constants in the serialized
+        # program (gigabytes of HLO — rejected/slow on AOT compile
+        # services) instead of referencing device-resident buffers.
+        def step(state, Zs, Ls, Yp):
             Wbar, W, mu, O, Obar, nu, del_o, mu_ij, ZtObar, _ = state
             mu_ij = mu_ij - Wbar[None]
             Obar = Obar - nu
@@ -202,8 +206,6 @@ class BlockADMMSolver:
             obj = jax.vmap(loss.evaluate)(wbar_out, Yp).sum() + lam * reg.evaluate(Wbar)
             return (Wbar, W, mu, O, Obar, nu, del_o, mu_ij_new, ZtObar_new, obj)
 
-        step = jax.jit(step)
-
         state = (
             jnp.zeros((D, k), dtype),        # Wbar
             jnp.zeros((D, k), dtype),        # W
@@ -222,23 +224,43 @@ class BlockADMMSolver:
             Yv = np.asarray(Yv)
 
         history, val_history = [], []
-        for it in range(1, p.maxiter + 1):
+        if not have_val:
+            # All iterations in ONE jitted lax.scan: the per-iteration
+            # objective readback costs a full host round-trip (multi-ms on
+            # a tunnelled chip), so sync once at the end and report the
+            # whole objective trace from the returned array.
+            @jax.jit
+            def run_all(state, Zs, Ls, Yp):
+                def body(st, _):
+                    st = step(st, Zs, Ls, Yp)
+                    return st, st[-1]
+
+                return jax.lax.scan(body, state, None, length=p.maxiter)
+
             with timer.phase("iteration"):
-                state = step(state)
-                obj = float(state[-1])  # readback syncs the step
-            history.append(obj)
-            msg = f"iteration {it} objective {obj:.6e}"
-            if have_val:
+                state, objs = run_all(state, Zs, Ls, Yp)
+                history = [float(o) for o in np.asarray(objs)]
+            for it, obj in enumerate(history, 1):
+                p.log(1, f"iteration {it} objective {obj:.6e}")
+        else:
+            step = jax.jit(step)
+            for it in range(1, p.maxiter + 1):
+                with timer.phase("iteration"):
+                    state = step(state, Zs, Ls, Yp)
+                    obj = float(state[-1])  # readback syncs the step
+                history.append(obj)
+                msg = f"iteration {it} objective {obj:.6e}"
                 with timer.phase("prediction") as ph:
                     interim = FeatureMapModel(
                         self.maps, state[0], scale_maps=p.scale_maps,
                         input_dim=d,
                     )
                     if regression:
-                        pv = np.asarray(interim.predict(Xv))[:, 0]
+                        pv = np.asarray(interim.predict(Xv))
+                        Yv2 = Yv if Yv.ndim > 1 else Yv[:, None]
                         metric = float(
-                            np.linalg.norm(pv - Yv)
-                            / max(np.linalg.norm(Yv), 1e-30)
+                            np.linalg.norm(pv - Yv2)
+                            / max(np.linalg.norm(Yv2), 1e-30)
                         )
                         msg += f" val relerr {metric:.4f}"
                     else:
@@ -246,7 +268,7 @@ class BlockADMMSolver:
                         metric = float((pv == Yv).mean()) * 100
                         msg += f" val accuracy {metric:.2f}"
                 val_history.append(metric)
-            p.log(1, msg)
+                p.log(1, msg)
 
         p.log(2, timer.report())
         Wbar = state[0]
