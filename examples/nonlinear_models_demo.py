@@ -1,0 +1,59 @@
+"""Nonlinear estimator demo (≙ the python-skylark ``ml/nonlinear.py``
+doctest workflow): exact kernel RLS vs its three approximations on one
+classification problem.
+
+Run: python examples/nonlinear_models_demo.py
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import numpy as np
+
+import libskylark_tpu as sky
+from libskylark_tpu.ml import (
+    RLS,
+    GaussianKernel,
+    NystromRLS,
+    SketchPCR,
+    SketchRLS,
+    classification_accuracy,
+)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    n_per, d = 200, 10
+    X = np.vstack(
+        [rng.standard_normal((n_per, d)), rng.standard_normal((n_per, d)) + 3.0]
+    )
+    y = np.array([1] * n_per + [2] * n_per)
+    perm = rng.permutation(len(y))
+    X, y = X[perm], y[perm]
+    Xtr, ytr, Xte, yte = X[:300], y[:300], X[300:], y[300:]
+
+    kernel = GaussianKernel(d, sigma=3.0)
+    ctx = sky.SketchContext(seed=123)
+
+    models = [
+        ("RLS (exact kernel)", RLS(kernel).train(Xtr, ytr, 1e-3)),
+        (
+            "SketchRLS (256 random features)",
+            SketchRLS(kernel).train(Xtr, ytr, ctx, 256, 1e-3),
+        ),
+        (
+            "NystromRLS (64 leverage-weighted landmarks)",
+            NystromRLS(kernel).train(
+                Xtr, ytr, ctx, 64, 1e-3, probdist="leverages"
+            ),
+        ),
+        ("SketchPCR (rank 32)", SketchPCR(kernel).train(Xtr, ytr, ctx, 32)),
+    ]
+    for name, model in models:
+        acc = float(classification_accuracy(model.predict(Xte), yte))
+        print(f"{name:45s} test accuracy {acc:5.1f}%")
+
+
+if __name__ == "__main__":
+    main()
