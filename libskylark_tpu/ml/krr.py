@@ -271,7 +271,12 @@ def large_scale_kernel_ridge(
         Ws[c] = Ws[c] + delta
         R = R - Z.T @ delta
 
-    # More sweeps (krr.hpp:668-727).
+    # More sweeps (krr.hpp:668-727).  The per-chunk float() readback is a
+    # deliberate host sync: under async dispatch the next chunk's (n, sz)
+    # Z buffer is ALLOCATED at dispatch time, so without a sync several
+    # chunks can be resident at once and the one-chunk memory contract
+    # (the reason this solver exists) breaks.  At capacity scale the
+    # round-trip is ~3% of a sweep — not worth trading the bound for.
     for it in range(1, params.iter_lim):
         delsize = 0.0
         for c in range(len(maps)):
