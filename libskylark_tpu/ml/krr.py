@@ -270,6 +270,9 @@ def large_scale_kernel_ridge(
         delta = cho_solve(Lc, ZR)
         Ws[c] = Ws[c] + delta
         R = R - Z.T @ delta
+        # Same one-chunk memory contract as the later sweeps: block until
+        # this chunk executed before dispatching (= allocating) the next.
+        jax.block_until_ready(delta)
 
     # More sweeps (krr.hpp:668-727).  The per-chunk float() readback is a
     # deliberate host sync: under async dispatch the next chunk's (n, sz)

@@ -20,10 +20,12 @@ from .collectives import (
 from .mesh import (
     ROWS,
     COLS,
+    constrain_rows,
     default_mesh,
     fully_replicated,
     make_mesh,
     replicate,
+    row_sharding,
     shard,
     shard_cols,
     shard_rows,
@@ -43,6 +45,8 @@ __all__ = [
     "shard_rows",
     "shard_rows_padded",
     "sharding",
+    "row_sharding",
+    "constrain_rows",
     "rowwise_sharded",
     "columnwise_sharded",
     "rowwise_sharded_sparse",

@@ -94,11 +94,34 @@ def shard(x, mesh: Mesh, *spec):
     return jax.device_put(x, sharding(mesh, *spec))
 
 
+def row_sharding(mesh: Mesh, ndim: int = 2) -> NamedSharding:
+    """The canonical ``[VC,*]`` sharding: dim 0 over the whole mesh."""
+    axes = (
+        mesh.axis_names[0]
+        if len(mesh.axis_names) == 1
+        else tuple(mesh.axis_names)
+    )
+    return NamedSharding(mesh, P(axes, *([None] * (ndim - 1))))
+
+
 def shard_rows(x, mesh: Mesh):
     """Distribute dim 0 over the whole mesh (≙ ``[VC,*]``)."""
-    if len(mesh.axis_names) == 1:
-        return shard(x, mesh, mesh.axis_names[0])
-    return shard(x, mesh, tuple(mesh.axis_names))
+    return jax.device_put(x, row_sharding(mesh, np.ndim(x)))
+
+
+def constrain_rows(x, mesh: Mesh):
+    """Row-shard a traced value inside jit (``[VC,*]`` constraint).
+
+    Uses ``with_sharding_constraint`` for Auto-axis meshes and
+    ``jax.sharding.reshard`` for Explicit-axis ones (JAX rejects
+    constraints on explicit axes)."""
+    s = row_sharding(mesh, np.ndim(x))
+    if any(
+        t == jax.sharding.AxisType.Explicit
+        for t in getattr(mesh, "axis_types", ())
+    ):
+        return jax.sharding.reshard(x, s)
+    return jax.lax.with_sharding_constraint(x, s)
 
 
 def shard_cols(x, mesh: Mesh):

@@ -75,6 +75,35 @@ class TestShardedAlgorithms:
         pred = np.asarray(m.predict_labels(jnp.asarray(X), m.classes))
         assert (pred == y).mean() > 0.9
 
+    def test_streaming_svd_sharded_panels(self, rng, mesh):
+        from libskylark_tpu.linalg import (
+            SVDParams,
+            streaming_approximate_svd,
+            synthetic_lowrank_blocks,
+        )
+
+        ctx = SketchContext(seed=41)
+        m, n, r = 4096, 64, 5
+        bf = synthetic_lowrank_blocks(ctx, m, n, r, noise=0.01)
+        ctx2 = SketchContext(seed=41)
+        bf2 = synthetic_lowrank_blocks(ctx2, m, n, r, noise=0.01)
+        # sharded panels must produce the same factorization as unsharded
+        _, s1, V1 = streaming_approximate_svd(
+            bf, (m, n), r, ctx, SVDParams(num_iterations=1), block_rows=1024
+        )
+        _, s2, V2 = streaming_approximate_svd(
+            bf2, (m, n), r, ctx2, SVDParams(num_iterations=1),
+            block_rows=1024, mesh=mesh,
+        )
+        # f32 panels: sharded psum accumulation order differs — same
+        # factorization up to f32 roundoff (reference oracle tolerance).
+        np.testing.assert_allclose(
+            np.asarray(s1), np.asarray(s2), rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.abs(np.asarray(V1.T @ V2)), np.eye(r), atol=1e-3
+        )
+
     def test_1d_mesh_also_works(self, rng):
         mesh1 = make_mesh((8,), (ROWS,))
         A = jnp.asarray(rng.standard_normal((512, 16)))
