@@ -21,7 +21,14 @@ import threading
 
 import numpy as np
 
-__all__ = ["available", "lib", "parse_libsvm_bytes", "NativeSketch", "NativeContext"]
+__all__ = [
+    "available",
+    "lib",
+    "parse_libsvm_bytes",
+    "supported_sketch_transforms",
+    "NativeSketch",
+    "NativeContext",
+]
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "src", "skylark_native.cpp")
@@ -101,6 +108,9 @@ def lib():
             ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)
         ]
         L.sl_free_str.argtypes = [ctypes.c_char_p]
+        L.sl_supported_sketch_transforms.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p)
+        ]
         L.sl_error_string.restype = ctypes.c_char_p
         L.sl_error_string.argtypes = [ctypes.c_int]
         L.sl_sample.argtypes = [
@@ -126,6 +136,16 @@ def lib():
 
 def available() -> bool:
     return lib() is not None
+
+
+def supported_sketch_transforms():
+    """(type, input, output, direction) tuples the native C API supports
+    (≙ ``sl_supported_sketch_transforms``, capi/csketch.cpp:74+)."""
+    out = ctypes.c_char_p()
+    _check(lib().sl_supported_sketch_transforms(ctypes.byref(out)))
+    s = out.value.decode()
+    lib().sl_free_str(out)
+    return [tuple(line.split()) for line in s.splitlines()]
 
 
 def _check(code: int):

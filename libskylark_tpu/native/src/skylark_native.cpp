@@ -222,7 +222,8 @@ struct sl_context_t {
 enum sl_type_t { SL_JLT = 0, SL_CT = 1, SL_CWT = 2, SL_MMT = 3, SL_WZT = 4,
                  SL_UST = 5, SL_FJLT = 6, SL_GRFT = 7, SL_LRFT = 8,
                  SL_RLT = 9, SL_MRFT = 10, SL_FGRFT = 11, SL_FMRFT = 12,
-                 SL_GQRFT = 13, SL_LQRFT = 14, SL_QRLT = 15, SL_PPT = 16 };
+                 SL_GQRFT = 13, SL_LQRFT = 14, SL_QRLT = 15, SL_PPT = 16,
+                 SL_NUM_SKETCH_TYPES = 17 };
 
 // ---------------------------------------------------------------------------
 // Leaped Halton QMC (≙ core/quasirand.py)
@@ -330,13 +331,14 @@ static int sk_type_from_name(const char* name) {
 }
 
 static const char* sk_name_from_type(int t) {
-    static const char* names[17] = {"JLT", "CT", "CWT", "MMT", "WZT", "UST",
-                                    "FJLT", "GaussianRFT", "LaplacianRFT",
-                                    "ExpSemigroupRLT", "MaternRFT",
-                                    "FastGaussianRFT", "FastMaternRFT",
-                                    "GaussianQRFT", "LaplacianQRFT",
-                                    "ExpSemigroupQRLT", "PPT"};
-    return (t >= 0 && t < 17) ? names[t] : "?";
+    static const char* names[SL_NUM_SKETCH_TYPES] = {
+        "JLT", "CT", "CWT", "MMT", "WZT", "UST",
+        "FJLT", "GaussianRFT", "LaplacianRFT",
+        "ExpSemigroupRLT", "MaternRFT",
+        "FastGaussianRFT", "FastMaternRFT",
+        "GaussianQRFT", "LaplacianQRFT",
+        "ExpSemigroupQRLT", "PPT"};
+    return (t >= 0 && t < SL_NUM_SKETCH_TYPES) ? names[t] : "?";
 }
 
 static long sk_next_pow2(long n) {
@@ -936,6 +938,26 @@ int sl_serialize_sketch_transform(void* t_, char** out) {
 }
 
 void sl_free_str(char* s) { free(s); }
+
+// Introspection (≙ sl_supported_sketch_transforms, capi/csketch.cpp:74+).
+// The reference enumerates ~190 (type, input-dist, output-dist) combos;
+// per-distribution specializations collapse here (host arrays, sharding
+// handled by the JAX layer), so each type supports one matrix kind in
+// both directions.  One "TYPE Matrix Matrix direction" line per combo.
+int sl_supported_sketch_transforms(char** out) {
+    std::string s;
+    for (int t = 0; t < SL_NUM_SKETCH_TYPES; ++t) {
+        s += sk_name_from_type(t);
+        s += " Matrix Matrix columnwise\n";
+        s += sk_name_from_type(t);
+        s += " Matrix Matrix rowwise\n";
+    }
+    char* buf = (char*)malloc(s.size() + 1);
+    if (!buf) return 101;
+    memcpy(buf, s.c_str(), s.size() + 1);
+    *out = buf;
+    return 0;
+}
 
 // Minimal JSON field extraction (flat schema written by ourselves/Python).
 static bool js_find_num(const char* js, const char* key, double* val) {

@@ -37,6 +37,23 @@ class TestRNGParity:
             np.testing.assert_allclose(out, ref, rtol=1e-12, atol=1e-12)
 
 
+def test_supported_sketch_transforms_introspection():
+    """≙ sl_supported_sketch_transforms (capi/csketch.cpp:74+): every C-API
+    type reports both directions on the collapsed matrix kind."""
+    combos = native.supported_sketch_transforms()
+    assert len(combos) == 34  # 17 types x 2 directions
+    names = {c[0] for c in combos}
+    assert names == {
+        "JLT", "CT", "CWT", "MMT", "WZT", "UST", "FJLT", "GaussianRFT",
+        "LaplacianRFT", "ExpSemigroupRLT", "MaternRFT", "FastGaussianRFT",
+        "FastMaternRFT", "GaussianQRFT", "LaplacianQRFT",
+        "ExpSemigroupQRLT", "PPT",
+    }
+    for c in combos:
+        assert c[1:3] == ("Matrix", "Matrix")
+        assert c[3] in ("columnwise", "rowwise")
+
+
 class TestCAPI:
     def test_context_counter_matches_python(self):
         nctx = native.NativeContext(5)
