@@ -26,6 +26,9 @@ __all__ = [
     "lib",
     "parse_libsvm_bytes",
     "supported_sketch_transforms",
+    "kernel_gram",
+    "approximate_svd",
+    "approximate_least_squares",
     "NativeSketch",
     "NativeContext",
 ]
@@ -111,6 +114,19 @@ def lib():
         L.sl_supported_sketch_transforms.argtypes = [
             ctypes.POINTER(ctypes.c_char_p)
         ]
+        f64 = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
+        L.sl_kernel_gram.argtypes = [
+            ctypes.c_int, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            f64, ctypes.c_long, f64, ctypes.c_long, ctypes.c_long, f64,
+        ]
+        L.sl_approximate_svd.argtypes = [
+            ctypes.c_void_p, f64, ctypes.c_long, ctypes.c_long,
+            ctypes.c_long, ctypes.c_int, f64, f64, f64,
+        ]
+        L.sl_approximate_least_squares.argtypes = [
+            ctypes.c_void_p, f64, f64, ctypes.c_long, ctypes.c_long,
+            ctypes.c_long, ctypes.c_long, f64,
+        ]
         L.sl_error_string.restype = ctypes.c_char_p
         L.sl_error_string.argtypes = [ctypes.c_int]
         L.sl_sample.argtypes = [
@@ -146,6 +162,77 @@ def supported_sketch_transforms():
     s = out.value.decode()
     lib().sl_free_str(out)
     return [tuple(line.split()) for line in s.splitlines()]
+
+
+_KERNEL_CODES = {
+    "linear": 0, "gaussian": 1, "polynomial": 2,
+    "laplacian": 3, "expsemigroup": 4, "matern": 5,
+}
+
+
+def kernel_gram(kernel: str, X, Y=None, p1=0.0, p2=0.0, p3=0.0):
+    """Native kernel Gram K[i, j] = k(X[i], Y[j]) (≙ ``capi/ckernel.cpp``).
+
+    Params by kernel: gaussian/laplacian p1=sigma; polynomial p1=q, p2=c,
+    p3=gamma; expsemigroup p1=beta; matern p1=nu (half-integer), p2=l.
+    """
+    X = np.ascontiguousarray(X, np.float64)
+    Y = X if Y is None else np.ascontiguousarray(Y, np.float64)
+    if X.ndim != 2 or Y.ndim != 2 or X.shape[1] != Y.shape[1]:
+        raise ValueError(f"bad gram shapes {X.shape} vs {Y.shape}")
+    # Required scale parameters: a forgotten one would silently produce
+    # NaN/zero grams (exp(-d/0)) deep inside downstream solves.
+    if kernel in ("gaussian", "laplacian") and not p1 > 0:
+        raise ValueError(f"{kernel} kernel needs sigma = p1 > 0, got {p1}")
+    if kernel == "expsemigroup" and not p1 > 0:
+        raise ValueError(f"expsemigroup kernel needs beta = p1 > 0, got {p1}")
+    if kernel == "matern" and (not p1 > 0 or not p2 > 0):
+        raise ValueError(
+            f"matern kernel needs nu = p1 > 0 and l = p2 > 0, got {p1}, {p2}"
+        )
+    K = np.empty((X.shape[0], Y.shape[0]), np.float64)
+    _check(lib().sl_kernel_gram(
+        _KERNEL_CODES[kernel], p1, p2, p3,
+        X, X.shape[0], Y, Y.shape[0], X.shape[1], K,
+    ))
+    return K
+
+
+def approximate_svd(ctx, A, rank: int, num_iterations: int = 1):
+    """Native randomized truncated SVD (≙ ``capi/cnla.cpp``): returns
+    (U, S, V) with A ≈ U @ diag(S) @ V.T.  ``ctx`` is a NativeContext."""
+    A = np.ascontiguousarray(A, np.float64)
+    m, n = A.shape
+    k = int(rank)
+    U = np.empty((m, k), np.float64)
+    S = np.empty((k,), np.float64)
+    V = np.empty((n, k), np.float64)
+    _check(lib().sl_approximate_svd(
+        ctx._h, A, m, n, k, num_iterations, U, S, V
+    ))
+    return U, S, V
+
+
+def approximate_least_squares(ctx, A, b, sketch_size: int = 0):
+    """Native sketch-and-solve least squares (≙ ``capi/cnla.cpp``):
+    argmin_x ||Ax - b|| via a CWT sketch (default size 4n)."""
+    A = np.ascontiguousarray(A, np.float64)
+    b = np.ascontiguousarray(b, np.float64)
+    squeeze = b.ndim == 1
+    if squeeze:
+        b = b[:, None]
+    if b.ndim != 2 or A.ndim != 2 or b.shape[0] != A.shape[0]:
+        raise ValueError(
+            f"shape mismatch: A {A.shape} needs b with {A.shape[0]} rows, "
+            f"got {b.shape}"
+        )
+    m, n = A.shape
+    t = b.shape[1]
+    x = np.empty((n, t), np.float64)
+    _check(lib().sl_approximate_least_squares(
+        ctx._h, A, b, m, n, t, sketch_size, x
+    ))
+    return x[:, 0] if squeeze else x
 
 
 def _check(code: int):

@@ -1222,4 +1222,317 @@ int sl_libsvm_parse(const char* buf, long len, double* labels, long* rows,
     return 0;
 }
 
+
+
+// ---------------------------------------------------------------------------
+// Kernel grams + randomized NLA (≙ capi/ckernel.cpp, capi/cnla.cpp).
+// Dense row-major f64 host arrays; OpenMP loops (the C consumers the
+// reference serves are CPU-side; the TPU path lives in the JAX layer).
+// ---------------------------------------------------------------------------
+
+static void sk_matmul(const double* A, const double* B, double* C,
+                      long m, long k, long n, bool transA, bool transB) {
+    // C (m x n) = op(A) op(B), all row-major.
+#pragma omp parallel for schedule(static)
+    for (long i = 0; i < m; i++) {
+        double* crow = C + i * n;
+        for (long j = 0; j < n; j++) crow[j] = 0.0;
+        for (long p = 0; p < k; p++) {
+            double a = transA ? A[p * m + i] : A[i * k + p];
+            if (a == 0.0) continue;
+            for (long j = 0; j < n; j++) {
+                double b = transB ? B[j * k + p] : B[p * n + j];
+                crow[j] += a * b;
+            }
+        }
+    }
+}
+
+static int sk_cholesky(double* G, long s) {
+    // In-place lower Cholesky of s x s row-major G; 0 on success.
+    for (long j = 0; j < s; j++) {
+        double d = G[j * s + j];
+        for (long p = 0; p < j; p++) d -= G[j * s + p] * G[j * s + p];
+        if (d <= 0.0) return 103;
+        d = std::sqrt(d);
+        G[j * s + j] = d;
+        for (long i = j + 1; i < s; i++) {
+            double v = G[i * s + j];
+            for (long p = 0; p < j; p++) v -= G[i * s + p] * G[j * s + p];
+            G[i * s + j] = v / d;
+        }
+    }
+    return 0;
+}
+
+static void sk_chol_solve_inplace(const double* L, double* B, long s, long t) {
+    // Solve (L L^T) X = B for X in-place; B is s x t row-major.
+    for (long c = 0; c < t; c++) {
+        for (long i = 0; i < s; i++) {
+            double v = B[i * t + c];
+            for (long p = 0; p < i; p++) v -= L[i * s + p] * B[p * t + c];
+            B[i * t + c] = v / L[i * s + i];
+        }
+        for (long i = s - 1; i >= 0; i--) {
+            double v = B[i * t + c];
+            for (long p = i + 1; p < s; p++) v -= L[p * s + i] * B[p * t + c];
+            B[i * t + c] = v / L[i * s + i];
+        }
+    }
+}
+
+static int sk_cholqr(double* Y, long m, long s) {
+    // Orthonormalize columns of Y (m x s row-major) via CholeskyQR2 with
+    // a relative ridge: exactly rank-deficient Y (sketches of low-rank A)
+    // would break plain Cholesky; ridged null directions come out with
+    // ~zero singular content and are dropped by the rank-k truncation
+    // (same rationale as the JAX layer's eigh floor in gram_orth).
+    std::vector<double> G(s * s);
+    for (int pass = 0; pass < 2; pass++) {
+        sk_matmul(Y, Y, G.data(), s, m, s, true, false);
+        double trace = 0.0;
+        for (long i = 0; i < s; i++) trace += G[i * s + i];
+        double ridge = 1e-12 * (trace > 0 ? trace / s : 1.0);
+        for (long i = 0; i < s; i++) G[i * s + i] += ridge;
+        int rc = sk_cholesky(G.data(), s);
+        if (rc) return rc;
+        // Y <- Y L^{-T}: solve row-wise x L^T = y.
+#pragma omp parallel for schedule(static)
+        for (long i = 0; i < m; i++) {
+            double* row = Y + i * s;
+            for (long j = 0; j < s; j++) {
+                double v = row[j];
+                for (long p = 0; p < j; p++) v -= G[j * s + p] * row[p];
+                row[j] = v / G[j * s + j];
+            }
+        }
+    }
+    return 0;
+}
+
+static void sk_jacobi_svd(double* M, double* V, double* sig, long n, long s) {
+    // One-sided Jacobi: M (n x s, row-major) -> M = U diag(sig) V^T with
+    // the orthonormal U overwriting M's columns and V (s x s) accumulated.
+    for (long i = 0; i < s; i++)
+        for (long j = 0; j < s; j++) V[i * s + j] = (i == j) ? 1.0 : 0.0;
+    const double tol = 1e-14;
+    for (int sweep = 0; sweep < 60; sweep++) {
+        double off = 0.0;
+        for (long p = 0; p < s - 1; p++)
+            for (long q = p + 1; q < s; q++) {
+                double app = 0, aqq = 0, apq = 0;
+                for (long i = 0; i < n; i++) {
+                    double x = M[i * s + p], y = M[i * s + q];
+                    app += x * x; aqq += y * y; apq += x * y;
+                }
+                if (std::fabs(apq) <= tol * std::sqrt(app * aqq)) continue;
+                off = std::max(off, std::fabs(apq));
+                double tau = (aqq - app) / (2.0 * apq);
+                double t = (tau >= 0 ? 1.0 : -1.0) /
+                           (std::fabs(tau) + std::sqrt(1.0 + tau * tau));
+                double c = 1.0 / std::sqrt(1.0 + t * t), sn = c * t;
+                for (long i = 0; i < n; i++) {
+                    double x = M[i * s + p], y = M[i * s + q];
+                    M[i * s + p] = c * x - sn * y;
+                    M[i * s + q] = sn * x + c * y;
+                }
+                for (long i = 0; i < s; i++) {
+                    double x = V[i * s + p], y = V[i * s + q];
+                    V[i * s + p] = c * x - sn * y;
+                    V[i * s + q] = sn * x + c * y;
+                }
+            }
+        if (off == 0.0) break;
+    }
+    for (long j = 0; j < s; j++) {
+        double nrm = 0.0;
+        for (long i = 0; i < n; i++) nrm += M[i * s + j] * M[i * s + j];
+        sig[j] = std::sqrt(nrm);
+        if (sig[j] > 0)
+            for (long i = 0; i < n; i++) M[i * s + j] /= sig[j];
+    }
+}
+
+int sl_kernel_gram(int kernel_type, double p1, double p2, double p3,
+                   const double* X, long nx, const double* Y, long ny,
+                   long d, double* K) {
+    // K[i, j] = k(X[i], Y[j]); X (nx x d), Y (ny x d) row-major.
+    if (!X || !Y || !K || nx <= 0 || ny <= 0 || d <= 0) return 102;
+    if (kernel_type < 0 || kernel_type > 5) return 104;
+    // Matern coefficients depend only on p = floor(nu): hoist the
+    // factorial table out of the entry loops.
+    long mat_p = 0;
+    double mat_scale = 1.0;
+    std::vector<double> mat_coef;
+    if (kernel_type == 5) {
+        mat_p = (long)std::floor(p1);  // nu = p + 1/2
+        double fact_p = 1.0, fact_2p = 1.0;
+        for (long u = 2; u <= mat_p; u++) fact_p *= u;
+        for (long u = 2; u <= 2 * mat_p; u++) fact_2p *= u;
+        mat_scale = fact_p / fact_2p;
+        mat_coef.resize(mat_p + 1);
+        for (long i2 = 0; i2 <= mat_p; i2++) {
+            double num = 1.0, di = 1.0, dpi = 1.0;
+            for (long u = 2; u <= mat_p + i2; u++) num *= u;
+            for (long u = 2; u <= i2; u++) di *= u;
+            for (long u = 2; u <= mat_p - i2; u++) dpi *= u;
+            mat_coef[i2] = num / (di * dpi);
+        }
+    }
+#pragma omp parallel for schedule(static)
+    for (long i = 0; i < nx; i++) {
+        const double* xi = X + i * d;
+        for (long j = 0; j < ny; j++) {
+            const double* yj = Y + j * d;
+            double v = 0.0;
+            switch (kernel_type) {
+                case 0: {  // linear
+                    for (long c = 0; c < d; c++) v += xi[c] * yj[c];
+                    break;
+                }
+                case 1: {  // gaussian, p1 = sigma
+                    double d2 = 0.0;
+                    for (long c = 0; c < d; c++) {
+                        double t = xi[c] - yj[c]; d2 += t * t;
+                    }
+                    v = std::exp(-d2 / (2.0 * p1 * p1));
+                    break;
+                }
+                case 2: {  // polynomial, p1 = q, p2 = c, p3 = gamma
+                    double ip = 0.0;
+                    for (long c = 0; c < d; c++) ip += xi[c] * yj[c];
+                    v = std::pow(p3 * ip + p2, p1);
+                    break;
+                }
+                case 3: {  // laplacian, p1 = sigma
+                    double l1 = 0.0;
+                    for (long c = 0; c < d; c++) l1 += std::fabs(xi[c] - yj[c]);
+                    v = std::exp(-l1 / p1);
+                    break;
+                }
+                case 4: {  // expsemigroup, p1 = beta (nonnegative inputs)
+                    double sg = 0.0;
+                    for (long c = 0; c < d; c++) {
+                        double a = xi[c] + yj[c];
+                        sg += std::sqrt(a > 0 ? a : 0.0);
+                    }
+                    v = std::exp(-p1 * sg);
+                    break;
+                }
+                case 5: {  // matern, p1 = nu (half-integer), p2 = l
+                    double d2 = 0.0;
+                    for (long c = 0; c < d; c++) {
+                        double t = xi[c] - yj[c]; d2 += t * t;
+                    }
+                    double a = std::sqrt(2.0 * p1) * std::sqrt(d2) / p2;
+                    // k = exp(-a) * p!/(2p)! * sum_i coef[i] (2a)^{p-i}
+                    double sum = 0.0;
+                    for (long i2 = 0; i2 <= mat_p; i2++)
+                        sum += mat_coef[i2] *
+                               std::pow(2.0 * a, (double)(mat_p - i2));
+                    v = std::exp(-a) * mat_scale * sum;
+                    break;
+                }
+            }
+            K[i * ny + j] = v;
+        }
+    }
+    return 0;
+}
+
+int sl_approximate_svd(void* vctx, const double* A, long m, long n, long k,
+                       int num_iterations, double* U, double* S, double* V) {
+    // Randomized truncated SVD (≙ capi/cnla.cpp ApproximateSVD): A (m x n)
+    // row-major; U (m x k), S (k), V (n x k).  Oversampling 2k, CholQR2,
+    // one-sided Jacobi on the small factor.
+    if (!vctx || !A || !U || !S || !V) return 102;
+    if (k <= 0 || k > (m < n ? m : n)) return 102;
+    sl_context_t* ctx = (sl_context_t*)vctx;
+    long s = 2 * k; if (s > n) s = n;
+    // Omega (n x s) from the context stream (counter-deterministic).
+    std::vector<double> Om((size_t)n * s);
+    uint64_t base = ctx->counter; ctx->counter += (uint64_t)(n * s);
+#pragma omp parallel for schedule(static)
+    for (long i = 0; i < n * s; i++) {
+        uint32_t hi, lo;
+        sk_bits(ctx->seed, 0, base + (uint64_t)i, &hi, &lo);
+        Om[i] = sk_draw(SK_DIST_NORMAL, hi, lo);
+    }
+    std::vector<double> Y((size_t)m * s), W((size_t)n * s);
+    sk_matmul(A, Om.data(), Y.data(), m, n, s, false, false);
+    for (int it = 0; it < num_iterations; it++) {
+        sk_matmul(A, Y.data(), W.data(), n, m, s, true, false);  // W = A^T Y
+        int rc = sk_cholqr(W.data(), n, s);
+        if (rc) return rc;
+        sk_matmul(A, W.data(), Y.data(), m, n, s, false, false);  // Y = A W
+    }
+    int rc = sk_cholqr(Y.data(), m, s);  // Q in Y
+    if (rc) return rc;
+    // B = Q^T A (s x n); Jacobi on B^T (n x s).
+    std::vector<double> Bt((size_t)n * s), Vs((size_t)s * s), sig(s);
+    {
+        std::vector<double> B((size_t)s * n);
+        sk_matmul(Y.data(), A, B.data(), s, m, n, true, false);
+        for (long i = 0; i < s; i++)
+            for (long j = 0; j < n; j++) Bt[j * s + i] = B[i * n + j];
+    }
+    sk_jacobi_svd(Bt.data(), Vs.data(), sig.data(), n, s);
+    // B = Vs diag(sig) Bt^T: left vectors Vs, right vectors Bt columns.
+    std::vector<long> ord(s);
+    for (long i = 0; i < s; i++) ord[i] = i;
+    std::sort(ord.begin(), ord.end(),
+              [&](long a, long b) { return sig[a] > sig[b]; });
+    for (long j = 0; j < k; j++) {
+        long c = ord[j];
+        S[j] = sig[c];
+        for (long i = 0; i < n; i++) V[i * k + j] = Bt[i * s + c];
+    }
+    // U = Q (m x s) * Vs[:, ord[:k]]
+#pragma omp parallel for schedule(static)
+    for (long i = 0; i < m; i++) {
+        for (long j = 0; j < k; j++) {
+            long c = ord[j];
+            double v = 0.0;
+            for (long p = 0; p < s; p++) v += Y[i * s + p] * Vs[p * s + c];
+            U[i * k + j] = v;
+        }
+    }
+    return 0;
+}
+
+int sl_approximate_least_squares(void* vctx, const double* A, const double* b,
+                                 long m, long n, long t, long sketch_size,
+                                 double* x) {
+    // Sketch-and-solve LS (≙ capi/cnla.cpp): CWT sketch of [A b] to
+    // sketch_size rows, then normal equations on the small problem.
+    // A (m x n), b (m x t), x (n x t), all row-major.
+    if (!vctx || !A || !b || !x) return 102;
+    if (m <= 0 || n <= 0 || t <= 0) return 102;
+    long ss = sketch_size > 0 ? sketch_size : 4 * n;
+    if (ss > m) ss = m;
+    sl_context_t* ctx = (sl_context_t*)vctx;
+    void* st = nullptr;
+    int rc = sl_create_sketch_transform(vctx, "CWT", m, ss, 0.0, &st);
+    if (rc || !st) return rc ? rc : 103;
+    std::vector<double> SA((size_t)ss * n), Sb((size_t)ss * t);
+    // Columnwise apply: inputs are (m x cols) row-major, exactly A and b.
+    rc = sl_apply_sketch_transform(st, A, m, n, 0, SA.data());
+    if (!rc) rc = sl_apply_sketch_transform(st, b, m, t, 0, Sb.data());
+    sl_free_sketch_transform(st);
+    if (rc) return rc;
+    std::vector<double> G((size_t)n * n), rhs((size_t)n * t);
+    sk_matmul(SA.data(), SA.data(), G.data(), n, ss, n, true, false);
+    sk_matmul(SA.data(), Sb.data(), rhs.data(), n, ss, t, true, false);
+    // Tiny ridge for numerical safety on rank-deficient sketches.
+    double trace = 0.0;
+    for (long i = 0; i < n; i++) trace += G[i * n + i];
+    double eps = 1e-12 * (trace > 0 ? trace / n : 1.0);
+    for (long i = 0; i < n; i++) G[i * n + i] += eps;
+    rc = sk_cholesky(G.data(), n);
+    if (rc) return rc;
+    sk_chol_solve_inplace(G.data(), rhs.data(), n, t);
+    std::copy(rhs.begin(), rhs.end(), x);
+    return 0;
+}
+
 }  // extern "C"

@@ -37,6 +37,77 @@ class TestRNGParity:
             np.testing.assert_allclose(out, ref, rtol=1e-12, atol=1e-12)
 
 
+class TestNativeKernelGram:
+    """≙ capi/ckernel.cpp: native grams match the JAX kernel layer."""
+
+    @pytest.mark.parametrize("name,params,pykernel", [
+        ("linear", {}, lambda ml, d: ml.LinearKernel(d)),
+        ("gaussian", {"p1": 2.0}, lambda ml, d: ml.GaussianKernel(d, 2.0)),
+        ("polynomial", {"p1": 3, "p2": 1.5, "p3": 0.5},
+         lambda ml, d: ml.PolynomialKernel(d, 3, 1.5, 0.5)),
+        ("laplacian", {"p1": 1.5}, lambda ml, d: ml.LaplacianKernel(d, 1.5)),
+        ("matern", {"p1": 1.5, "p2": 2.0},
+         lambda ml, d: ml.MaternKernel(d, 1.5, 2.0)),
+    ])
+    def test_matches_jax_kernels(self, name, params, pykernel):
+        from libskylark_tpu import ml
+
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((12, 5))
+        Y = rng.standard_normal((7, 5))
+        K = native.kernel_gram(name, X, Y, **params)
+        ref = np.asarray(pykernel(ml, 5).gram(X, Y))
+        np.testing.assert_allclose(K, ref, rtol=1e-10, atol=1e-12)
+
+    def test_expsemigroup(self):
+        from libskylark_tpu.ml import ExpSemigroupKernel
+
+        rng = np.random.default_rng(1)
+        X = np.abs(rng.standard_normal((8, 4)))
+        K = native.kernel_gram("expsemigroup", X, p1=0.3)
+        ref = np.asarray(ExpSemigroupKernel(4, 0.3).gram(X))
+        np.testing.assert_allclose(K, ref, rtol=1e-10)
+
+
+class TestNativeNLA:
+    """≙ capi/cnla.cpp: native randomized SVD / sketch-and-solve LS."""
+
+    def test_svd_exact_on_low_rank(self):
+        rng = np.random.default_rng(2)
+        A = rng.standard_normal((120, 30)) @ rng.standard_normal((30, 40))
+        r = 30
+        ctx = native.NativeContext(seed=7)
+        U, S, V = native.approximate_svd(ctx, A, r, num_iterations=2)
+        rec = U @ np.diag(S) @ V.T
+        assert np.linalg.norm(rec - A) / np.linalg.norm(A) < 1e-8
+        np.testing.assert_allclose(U.T @ U, np.eye(r), atol=1e-10)
+        np.testing.assert_allclose(V.T @ V, np.eye(r), atol=1e-10)
+        s_true = np.linalg.svd(A, compute_uv=False)[:r]
+        np.testing.assert_allclose(S, s_true, rtol=1e-8)
+
+    def test_svd_ordering_and_shapes(self):
+        rng = np.random.default_rng(3)
+        A = rng.standard_normal((50, 20))
+        ctx = native.NativeContext(seed=9)
+        U, S, V = native.approximate_svd(ctx, A, 5, num_iterations=3)
+        assert U.shape == (50, 5) and S.shape == (5,) and V.shape == (20, 5)
+        assert np.all(np.diff(S) <= 1e-12)
+
+    def test_least_squares_residual(self):
+        rng = np.random.default_rng(4)
+        A = rng.standard_normal((2000, 30))
+        x_true = rng.standard_normal(30)
+        b = A @ x_true
+        ctx = native.NativeContext(seed=11)
+        x = native.approximate_least_squares(ctx, A, b)
+        # consistent system: sketch-and-solve recovers the solution
+        np.testing.assert_allclose(x, x_true, rtol=1e-6, atol=1e-8)
+        # multi-RHS
+        B = np.stack([b, 2 * b], axis=1)
+        X2 = native.approximate_least_squares(ctx, A, B)
+        np.testing.assert_allclose(X2[:, 1], 2 * x_true, rtol=1e-6, atol=1e-8)
+
+
 def test_supported_sketch_transforms_introspection():
     """≙ sl_supported_sketch_transforms (capi/csketch.cpp:74+): every C-API
     type reports both directions on the collapsed matrix kind."""
