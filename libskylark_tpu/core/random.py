@@ -172,7 +172,12 @@ def _uniform01(hi, lo, dtype):
         bot = lo.astype(jnp.uint64) >> 5       # 27 bits
         k = (top << 27 | bot).astype(jnp.float64)
         return (k + 0.5) * (2.0 ** -52)
-    k = (lo >> 8).astype(jnp.float32)          # 24 bits, exact in f32
+    # f32 leads with HI's top bits — the SAME leading bits as the f64
+    # value, so the two dtype streams agree to ~2^-24 (cross-precision
+    # determinism: an f32 TPU run and an f64/native-C run see the same
+    # uniforms).  A lo-based f32 stream would be statistically independent
+    # of the f64 one and silently break cross-language parity.
+    k = (hi >> 8).astype(jnp.float32)          # 24 bits, exact in f32
     return ((k + np.float32(0.5)) * np.float32(2.0 ** -24)).astype(dtype)
 
 

@@ -29,6 +29,7 @@ __all__ = [
     "kernel_gram",
     "approximate_svd",
     "approximate_least_squares",
+    "model_predict",
     "NativeSketch",
     "NativeContext",
 ]
@@ -127,6 +128,13 @@ def lib():
             ctypes.c_void_p, f64, f64, ctypes.c_long, ctypes.c_long,
             ctypes.c_long, ctypes.c_long, f64,
         ]
+        L.sl_model_info.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        L.sl_model_predict.argtypes = [
+            ctypes.c_char_p, f64, ctypes.c_long, ctypes.c_long, f64,
+        ]
         L.sl_error_string.restype = ctypes.c_char_p
         L.sl_error_string.argtypes = [ctypes.c_int]
         L.sl_sample.argtypes = [
@@ -182,6 +190,12 @@ def kernel_gram(kernel: str, X, Y=None, p1=0.0, p2=0.0, p3=0.0):
         raise ValueError(f"bad gram shapes {X.shape} vs {Y.shape}")
     # Required scale parameters: a forgotten one would silently produce
     # NaN/zero grams (exp(-d/0)) deep inside downstream solves.
+    if kernel not in _KERNEL_CODES:
+        raise ValueError(
+            f"unknown kernel {kernel!r}; choose from {sorted(_KERNEL_CODES)}"
+        )
+    if kernel == "polynomial" and not p3 > 0:
+        raise ValueError(f"polynomial kernel needs gamma = p3 > 0, got {p3}")
     if kernel in ("gaussian", "laplacian") and not p1 > 0:
         raise ValueError(f"{kernel} kernel needs sigma = p1 > 0, got {p1}")
     if kernel == "expsemigroup" and not p1 > 0:
@@ -233,6 +247,27 @@ def approximate_least_squares(ctx, A, b, sketch_size: int = 0):
         ctx._h, A, b, m, n, t, sketch_size, x
     ))
     return x[:, 0] if squeeze else x
+
+
+def model_predict(path, X):
+    """Predict with a saved ``FeatureMapModel`` entirely in native code
+    (≙ ``capi/cml.cpp`` + the streaming-predict consumer): rebuilds the
+    feature-map chain from the model JSON and applies it to X (n, d)."""
+    import os
+
+    path = os.fspath(path)
+    X = np.ascontiguousarray(X, np.float64)
+    if X.ndim != 2:
+        raise ValueError(f"X must be 2-D, got {X.shape}")
+    din = ctypes.c_long()
+    k = ctypes.c_long()
+    _check(lib().sl_model_info(path.encode(), ctypes.byref(din),
+                               ctypes.byref(k)))
+    out = np.empty((X.shape[0], k.value), np.float64)
+    _check(lib().sl_model_predict(
+        path.encode(), X, X.shape[0], X.shape[1], out
+    ))
+    return out
 
 
 def _check(code: int):

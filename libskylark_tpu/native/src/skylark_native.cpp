@@ -88,8 +88,11 @@ static inline double sk_uniform01(uint32_t hi, uint32_t lo) {
     return ((double)k + 0.5) * 0x1p-52;
 }
 
-static inline float sk_uniform01_f32(uint32_t lo) {
-    uint32_t k = lo >> 8;  // 24 bits
+static inline float sk_uniform01_f32(uint32_t hi) {
+    // HI's top bits — the same leading bits as sk_uniform01's f64 value,
+    // so f32 and f64 streams agree to ~2^-24 (cross-precision parity;
+    // mirrors core/random.py::_uniform01).
+    uint32_t k = hi >> 8;  // 24 bits
     return ((float)k + 0.5f) * 0x1p-24f;
 }
 
@@ -553,7 +556,7 @@ static void sk_ust_samples(const sl_sketch_t* t, std::vector<long>& idx) {
         for (long i = 0; i < t->n; i++) {
             uint32_t hi, lo;
             sk_bits(t->seed, 0, t->base0 + (uint64_t)i, &hi, &lo);
-            keys[i] = {sk_uniform01_f32(lo), i};
+            keys[i] = {sk_uniform01_f32(hi), i};
         }
         std::stable_sort(keys.begin(), keys.end(),
                          [](const std::pair<float, long>& a,
@@ -809,7 +812,7 @@ static void sk_apply_frft_cw(const sl_sketch_t* t, const double* A, long m,
         for (long j = 0; j < nb; j++) {
             uint32_t hi, lo;
             sk_bits(t->seed, 0, t->base3 + (uint64_t)(b * nb + j), &hi, &lo);
-            keys[j] = {sk_uniform01_f32(lo), j};
+            keys[j] = {sk_uniform01_f32(hi), j};
         }
         std::stable_sort(keys.begin(), keys.end(),
                          [](const std::pair<float, long>& a,
@@ -1533,6 +1536,175 @@ int sl_approximate_least_squares(void* vctx, const double* A, const double* b,
     sk_chol_solve_inplace(G.data(), rhs.data(), n, t);
     std::copy(rhs.begin(), rhs.end(), x);
     return 0;
+}
+
+
+
+// ---------------------------------------------------------------------------
+// Model IO + prediction (≙ capi/cml.cpp + ml/model.hpp:50-276 predict path
+// and python-skylark ml/modeling.py LinearizedKernelModel).  Reads the
+// FeatureMapModel JSON (+ .coef.npy), rebuilds the feature-map chain with
+// the native sketch core, and predicts: out = [Z_1 .. Z_J] @ W.
+// ---------------------------------------------------------------------------
+
+static bool sk_read_file(const char* path, std::string& out) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return false;
+    fseek(f, 0, SEEK_END);
+    long sz = ftell(f);
+    fseek(f, 0, SEEK_SET);
+    out.resize(sz);
+    bool ok = sz == 0 || fread(&out[0], 1, sz, f) == (size_t)sz;
+    fclose(f);
+    return ok;
+}
+
+static bool sk_npy_header(const std::string& buf, bool* f32,
+                          size_t* data_off, long* rows, long* cols) {
+    if (buf.size() < 10) return false;
+    if (memcmp(buf.data(), "\x93NUMPY", 6) != 0) return false;
+    int major = (unsigned char)buf[6];
+    size_t hlen, hoff;
+    if (major == 1) {
+        hlen = (unsigned char)buf[8] | ((unsigned char)buf[9] << 8);
+        hoff = 10;
+    } else {
+        if (buf.size() < 12) return false;
+        hlen = (unsigned char)buf[8] | ((unsigned char)buf[9] << 8) |
+               ((size_t)(unsigned char)buf[10] << 16) |
+               ((size_t)(unsigned char)buf[11] << 24);
+        hoff = 12;
+    }
+    if (buf.size() < hoff + hlen) return false;
+    std::string hdr = buf.substr(hoff, hlen);
+    *f32 = hdr.find("'<f4'") != std::string::npos;
+    if (!*f32 && hdr.find("'<f8'") == std::string::npos) return false;
+    if (hdr.find("'fortran_order': False") == std::string::npos) return false;
+    const char* sh = strstr(hdr.c_str(), "'shape':");
+    if (!sh) return false;
+    long r = 0, c = 1;
+    if (sscanf(sh, "'shape': (%ld, %ld)", &r, &c) < 1) return false;
+    if (r <= 0 || c <= 0) return false;
+    *data_off = hoff + hlen;
+    *rows = r;
+    *cols = c;
+    return true;
+}
+
+static bool sk_npy_read_f64(const char* path, std::vector<double>& data,
+                            long* rows, long* cols) {
+    // Minimal NumPy v1/v2 .npy reader for C-order f64/f32 2-D arrays
+    // (models trained without x64 save float32 coefficients).
+    std::string buf;
+    if (!sk_read_file(path, buf)) return false;
+    bool f32; size_t off;
+    if (!sk_npy_header(buf, &f32, &off, rows, cols)) return false;
+    size_t cnt = (size_t)(*rows) * (*cols);
+    size_t need = cnt * (f32 ? sizeof(float) : sizeof(double));
+    if (buf.size() < off + need) return false;
+    data.resize(cnt);
+    if (f32) {
+        const float* src = (const float*)(buf.data() + off);
+        for (size_t i = 0; i < cnt; i++) data[i] = src[i];
+    } else {
+        memcpy(data.data(), buf.data() + off, need);
+    }
+    return true;
+}
+
+static bool sk_json_map_objects(const std::string& js,
+                                std::vector<std::string>& out) {
+    // Split the top-level {...} objects inside "maps": [ ... ].
+    size_t p = js.find("\"maps\":");
+    if (p == std::string::npos) return false;
+    p = js.find('[', p);
+    if (p == std::string::npos) return false;
+    int depth = 0;
+    size_t start = 0;
+    for (size_t i = p + 1; i < js.size(); i++) {
+        char ch = js[i];
+        if (ch == '{') {
+            if (depth == 0) start = i;
+            depth++;
+        } else if (ch == '}') {
+            depth--;
+            if (depth == 0) out.push_back(js.substr(start, i - start + 1));
+        } else if (ch == ']' && depth == 0) {
+            return true;
+        }
+    }
+    return false;
+}
+
+int sl_model_info(const char* path, long* input_dim, long* num_outputs) {
+    if (!path || !input_dim || !num_outputs) return 102;
+    std::string js;
+    if (!sk_read_file(path, js)) return 105;
+    double v = 0.0;
+    *input_dim = js_find_num(js.c_str(), "input_dim", &v) ? (long)v : -1;
+    // Header-only peek at the coefficients: no full-file read here.
+    FILE* f = fopen((std::string(path) + ".coef.npy").c_str(), "rb");
+    if (!f) return 105;
+    std::string head(4096, '\0');
+    size_t got = fread(&head[0], 1, head.size(), f);
+    fclose(f);
+    head.resize(got);
+    bool f32; size_t off; long r, c;
+    if (!sk_npy_header(head, &f32, &off, &r, &c)) return 105;
+    *num_outputs = c;
+    return 0;
+}
+
+int sl_model_predict(const char* path, const double* X, long n, long d,
+                     double* out) {
+    // out (n x k) = features(X) @ W, row-major.
+    if (!path || !X || !out || n <= 0 || d <= 0) return 102;
+    std::string js;
+    if (!sk_read_file(path, js)) return 105;
+    std::vector<double> W;
+    long D, k;
+    if (!sk_npy_read_f64((std::string(path) + ".coef.npy").c_str(), W, &D, &k))
+        return 105;
+    std::vector<std::string> maps;
+    if (!sk_json_map_objects(js, maps)) return 105;
+    bool scale_maps = js.find("\"scale_maps\": true") != std::string::npos ||
+                      js.find("\"scale_maps\":true") != std::string::npos;
+    for (long i = 0; i < n * k; i++) out[i] = 0.0;
+    if (maps.empty()) {
+        if (D != d) return 102;  // linear model on raw features
+        sk_matmul(X, W.data(), out, n, d, k, false, false);
+        return 0;
+    }
+    long off = 0;
+    for (const std::string& mjs : maps) {
+        void* st = nullptr;
+        int rc = sl_deserialize_sketch_transform(mjs.c_str(), &st);
+        if (rc) return rc;
+        sl_sketch_t* t = (sl_sketch_t*)st;
+        long sj = t->s;
+        if (t->n != d || off + sj > D) {
+            sl_free_sketch_transform(st);
+            return 102;
+        }
+        std::vector<double> Z((size_t)n * sj);
+        rc = sl_apply_sketch_transform(st, X, n, d, 1, Z.data());
+        sl_free_sketch_transform(st);
+        if (rc) return rc;
+        double blk = scale_maps ? std::sqrt((double)sj / (double)d) : 1.0;
+        // out += blk * Z @ W[off:off+sj]
+#pragma omp parallel for schedule(static)
+        for (long i = 0; i < n; i++) {
+            const double* zrow = Z.data() + (size_t)i * sj;
+            double* orow = out + (size_t)i * k;
+            for (long p = 0; p < sj; p++) {
+                double zv = blk * zrow[p];
+                const double* wrow = W.data() + (size_t)(off + p) * k;
+                for (long j = 0; j < k; j++) orow[j] += zv * wrow[j];
+            }
+        }
+        off += sj;
+    }
+    return off == D ? 0 : 102;
 }
 
 }  // extern "C"

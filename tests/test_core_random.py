@@ -41,6 +41,19 @@ def test_stream_vs_window():
     np.testing.assert_array_equal(np.asarray(stream).reshape(6, 9), np.asarray(win))
 
 
+def test_uniform_cross_dtype_agreement():
+    """f32 and f64 uniforms from the same counters agree to ~2^-24: an
+    f32 (TPU) run and an f64/native-C run must see the SAME stream (a
+    dtype-dependent bit mapping silently breaks cross-language parity —
+    found as O(1) prediction differences on hardware)."""
+    u32 = np.asarray(sample("uniform", seed=9, base=0, num=4096, dtype=jnp.float32))
+    u64 = np.asarray(sample("uniform", seed=9, base=0, num=4096, dtype=jnp.float64))
+    assert np.abs(u32 - u64).max() < 2.0 ** -23
+    e32 = np.asarray(sample("exponential", seed=9, base=50, num=1024, dtype=jnp.float32))
+    e64 = np.asarray(sample("exponential", seed=9, base=50, num=1024, dtype=jnp.float64))
+    assert np.abs(e32 - e64).max() / np.abs(e64).max() < 1e-4
+
+
 def test_traced_offset_stream_matches_static():
     """sample(base, offset=traced k) == sample(base+k) — including a
     window whose counters cross the 2^32 carry boundary."""

@@ -108,6 +108,47 @@ class TestNativeNLA:
         np.testing.assert_allclose(X2[:, 1], 2 * x_true, rtol=1e-6, atol=1e-8)
 
 
+class TestNativeModelPredict:
+    """≙ capi/cml.cpp: native prediction from a saved FeatureMapModel."""
+
+    def test_matches_python_predict(self, tmp_path):
+        from libskylark_tpu.ml import FeatureMapModel, GaussianKernel
+
+        rng = np.random.default_rng(5)
+        d, s, k = 6, 32, 3
+        ctx = SketchContext(seed=31)
+        kernel = GaussianKernel(d, sigma=2.0)
+        maps = [kernel.create_rft(s, "regular", ctx) for _ in range(2)]
+        W = rng.standard_normal((2 * s, k))
+        model = FeatureMapModel(maps, W, scale_maps=True, input_dim=d)
+        path = tmp_path / "m.json"
+        model.save(path)
+
+        X = rng.standard_normal((20, d))
+        ref = np.asarray(model.predict(X))
+        out = native.model_predict(path, X)
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-8)
+
+    def test_linear_model_no_maps(self, tmp_path):
+        from libskylark_tpu.ml import FeatureMapModel
+
+        rng = np.random.default_rng(6)
+        W = rng.standard_normal((4, 2))
+        model = FeatureMapModel([], W, input_dim=4)
+        path = tmp_path / "lin.json"
+        model.save(path)
+        X = rng.standard_normal((7, 4))
+        np.testing.assert_allclose(
+            native.model_predict(path, X), X @ W, rtol=1e-12
+        )
+
+    def test_missing_file_errors(self, tmp_path):
+        from libskylark_tpu.utils.exceptions import SkylarkError
+
+        with pytest.raises(SkylarkError):
+            native.model_predict(tmp_path / "nope.json", np.zeros((2, 3)))
+
+
 def test_supported_sketch_transforms_introspection():
     """≙ sl_supported_sketch_transforms (capi/csketch.cpp:74+): every C-API
     type reports both directions on the collapsed matrix kind."""
