@@ -17,7 +17,7 @@ from typing import Any
 
 __all__ = ["SketchContext"]
 
-_SERIAL_VERSION = 1
+_SERIAL_VERSION = 2  # tracks sketch.base.SERIAL_VERSION (stream revision)
 
 
 @dataclass

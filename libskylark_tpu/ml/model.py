@@ -22,7 +22,7 @@ from ..sketch.base import Dimension, from_dict as sketch_from_dict
 
 __all__ = ["FeatureMapModel", "KernelModel"]
 
-_SERIAL_VERSION = 1
+_SERIAL_VERSION = 2  # tracks sketch.base.SERIAL_VERSION (stream revision)
 
 
 class FeatureMapModel:

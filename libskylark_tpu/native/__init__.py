@@ -30,6 +30,7 @@ __all__ = [
     "approximate_svd",
     "approximate_least_squares",
     "model_predict",
+    "NativeModel",
     "NativeSketch",
     "NativeContext",
 ]
@@ -134,6 +135,13 @@ def lib():
         ]
         L.sl_model_predict.argtypes = [
             ctypes.c_char_p, f64, ctypes.c_long, ctypes.c_long, f64,
+        ]
+        L.sl_model_load.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_void_p)
+        ]
+        L.sl_model_free.argtypes = [ctypes.c_void_p]
+        L.sl_model_predict_handle.argtypes = [
+            ctypes.c_void_p, f64, ctypes.c_long, ctypes.c_long, f64,
         ]
         L.sl_error_string.restype = ctypes.c_char_p
         L.sl_error_string.argtypes = [ctypes.c_int]
@@ -249,25 +257,60 @@ def approximate_least_squares(ctx, A, b, sketch_size: int = 0):
     return x[:, 0] if squeeze else x
 
 
-def model_predict(path, X):
-    """Predict with a saved ``FeatureMapModel`` entirely in native code
-    (≙ ``capi/cml.cpp`` + the streaming-predict consumer): rebuilds the
-    feature-map chain from the model JSON and applies it to X (n, d)."""
-    import os
+class NativeModel:
+    """Load-once handle on a saved ``FeatureMapModel`` for repeated native
+    prediction (≙ ``capi/cml.cpp`` + the streaming-predict consumer: the
+    reference CLI loads the model once, then predicts per batch)."""
 
-    path = os.fspath(path)
-    X = np.ascontiguousarray(X, np.float64)
-    if X.ndim != 2:
-        raise ValueError(f"X must be 2-D, got {X.shape}")
-    din = ctypes.c_long()
-    k = ctypes.c_long()
-    _check(lib().sl_model_info(path.encode(), ctypes.byref(din),
-                               ctypes.byref(k)))
-    out = np.empty((X.shape[0], k.value), np.float64)
-    _check(lib().sl_model_predict(
-        path.encode(), X, X.shape[0], X.shape[1], out
-    ))
-    return out
+    def __init__(self, path):
+        import json
+        import os
+
+        path = os.fspath(path)
+        h = ctypes.c_void_p()
+        _check(lib().sl_model_load(path.encode(), ctypes.byref(h)))
+        self._h = h
+        self._free = lib().sl_model_free
+        with open(path) as f:
+            meta = json.load(f)
+        if meta.get("skylark_version", 1) < 2:
+            import warnings
+
+            warnings.warn(
+                "model serialized under stream revision "
+                f"{meta.get('skylark_version', 1)} (current 2): "
+                "f32-uniform-derived map values reproduce differently "
+                "(docs/counter_contract.md, Stream revisions)",
+                stacklevel=2,
+            )
+        # (D,) coefficients predict to (n,), matching Python's
+        # FeatureMapModel.predict broadcasting.  The metadata already
+        # carries the dims — no extra native info round-trip needed.
+        shape = meta.get("coef_shape", [0, 0])
+        self._squeeze = len(shape) == 1
+        self.input_dim = meta.get("input_dim")
+        self.num_outputs = 1 if self._squeeze else int(shape[1])
+
+    def predict(self, X):
+        X = np.ascontiguousarray(X, np.float64)
+        if X.ndim != 2:
+            raise ValueError(f"X must be 2-D, got {X.shape}")
+        out = np.empty((X.shape[0], self.num_outputs), np.float64)
+        _check(lib().sl_model_predict_handle(
+            self._h, X, X.shape[0], X.shape[1], out
+        ))
+        return out[:, 0] if self._squeeze else out
+
+    def __del__(self):
+        h, self._h = getattr(self, "_h", None), None
+        if h:
+            self._free(h)
+
+
+def model_predict(path, X):
+    """One-shot native prediction from a saved ``FeatureMapModel``; for
+    repeated batches use :class:`NativeModel` (loads once)."""
+    return NativeModel(path).predict(X)
 
 
 def _check(code: int):

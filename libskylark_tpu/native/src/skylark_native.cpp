@@ -929,10 +929,10 @@ int sl_serialize_sketch_transform(void* t_, char** out) {
                  t->param, t->param2);
     char* buf = (char*)malloc(512);
     snprintf(buf, 512,
-             "{\"skylark_object_type\": \"sketch\", \"skylark_version\": 1, "
+             "{\"skylark_object_type\": \"sketch\", \"skylark_version\": 2, "
              "\"sketch_type\": \"%s\", \"N\": %ld, \"S\": %ld, "
              "\"creation_context\": {\"skylark_object_type\": \"context\", "
-             "\"skylark_version\": 1, \"seed\": %llu, \"counter\": %llu}%s}",
+             "\"skylark_version\": 2, \"seed\": %llu, \"counter\": %llu}%s}",
              sk_name_from_type(t->type), t->n, t->s,
              (unsigned long long)t->seed, (unsigned long long)t->ctx_counter,
              extra);
@@ -1550,9 +1550,12 @@ int sl_approximate_least_squares(void* vctx, const double* A, const double* b,
 static bool sk_read_file(const char* path, std::string& out) {
     FILE* f = fopen(path, "rb");
     if (!f) return false;
-    fseek(f, 0, SEEK_END);
-    long sz = ftell(f);
-    fseek(f, 0, SEEK_SET);
+    long sz = -1;
+    if (fseek(f, 0, SEEK_END) == 0) sz = ftell(f);
+    if (sz < 0 || fseek(f, 0, SEEK_SET) != 0) {  // pipes/FIFOs/ftell failure
+        fclose(f);
+        return false;
+    }
     out.resize(sz);
     bool ok = sz == 0 || fread(&out[0], 1, sz, f) == (size_t)sz;
     fclose(f);
@@ -1641,6 +1644,8 @@ int sl_model_info(const char* path, long* input_dim, long* num_outputs) {
     std::string js;
     if (!sk_read_file(path, js)) return 105;
     double v = 0.0;
+    // -1 = input_dim absent/null in the JSON (linear models constructed
+    // without input_dim); callers must treat it as unknown, not a width.
     *input_dim = js_find_num(js.c_str(), "input_dim", &v) ? (long)v : -1;
     // Header-only peek at the coefficients: no full-file read here.
     FILE* f = fopen((std::string(path) + ".coef.npy").c_str(), "rb");
@@ -1655,42 +1660,80 @@ int sl_model_info(const char* path, long* input_dim, long* num_outputs) {
     return 0;
 }
 
-int sl_model_predict(const char* path, const double* X, long n, long d,
-                     double* out) {
-    // out (n x k) = features(X) @ W, row-major.
-    if (!path || !X || !out || n <= 0 || d <= 0) return 102;
+struct sl_model_t {
+    std::vector<void*> maps;   // deserialized sketch handles (owned)
+    std::vector<double> W;     // (D, k) row-major
+    long D, k;
+    bool scale_maps;
+};
+
+void sl_model_free(void* m_) {
+    sl_model_t* m = (sl_model_t*)m_;
+    if (!m) return;
+    for (void* st : m->maps) sl_free_sketch_transform(st);
+    delete m;
+}
+
+int sl_model_load(const char* path, void** out) {
+    // Load-once handle: JSON + coefficients parsed a single time, feature
+    // maps deserialized once; batch consumers predict repeatedly
+    // (≙ the reference CLI loading the model once for streaming predict).
+    if (!path || !out) return 102;
     std::string js;
     if (!sk_read_file(path, js)) return 105;
-    std::vector<double> W;
-    long D, k;
-    if (!sk_npy_read_f64((std::string(path) + ".coef.npy").c_str(), W, &D, &k))
+    sl_model_t* m = new sl_model_t{};
+    if (!sk_npy_read_f64((std::string(path) + ".coef.npy").c_str(), m->W,
+                         &m->D, &m->k)) {
+        delete m;
         return 105;
-    std::vector<std::string> maps;
-    if (!sk_json_map_objects(js, maps)) return 105;
-    bool scale_maps = js.find("\"scale_maps\": true") != std::string::npos ||
-                      js.find("\"scale_maps\":true") != std::string::npos;
+    }
+    std::vector<std::string> mapjs;
+    if (!sk_json_map_objects(js, mapjs)) {
+        delete m;
+        return 105;
+    }
+    m->scale_maps = js.find("\"scale_maps\": true") != std::string::npos ||
+                    js.find("\"scale_maps\":true") != std::string::npos;
+    long off = 0;
+    for (const std::string& mjs : mapjs) {
+        void* st = nullptr;
+        int rc = sl_deserialize_sketch_transform(mjs.c_str(), &st);
+        if (rc) {
+            sl_model_free(m);
+            return rc;
+        }
+        off += ((sl_sketch_t*)st)->s;
+        m->maps.push_back(st);
+    }
+    if (!m->maps.empty() && off != m->D) {
+        sl_model_free(m);
+        return 102;
+    }
+    *out = m;
+    return 0;
+}
+
+int sl_model_predict_handle(void* m_, const double* X, long n, long d,
+                            double* out) {
+    // out (n x k) = features(X) @ W, row-major.
+    if (!m_ || !X || !out || n <= 0 || d <= 0) return 102;
+    sl_model_t* m = (sl_model_t*)m_;
+    long k = m->k;
     for (long i = 0; i < n * k; i++) out[i] = 0.0;
-    if (maps.empty()) {
-        if (D != d) return 102;  // linear model on raw features
-        sk_matmul(X, W.data(), out, n, d, k, false, false);
+    if (m->maps.empty()) {
+        if (m->D != d) return 102;  // linear model on raw features
+        sk_matmul(X, m->W.data(), out, n, d, k, false, false);
         return 0;
     }
     long off = 0;
-    for (const std::string& mjs : maps) {
-        void* st = nullptr;
-        int rc = sl_deserialize_sketch_transform(mjs.c_str(), &st);
-        if (rc) return rc;
+    for (void* st : m->maps) {
         sl_sketch_t* t = (sl_sketch_t*)st;
         long sj = t->s;
-        if (t->n != d || off + sj > D) {
-            sl_free_sketch_transform(st);
-            return 102;
-        }
+        if (t->n != d) return 102;
         std::vector<double> Z((size_t)n * sj);
-        rc = sl_apply_sketch_transform(st, X, n, d, 1, Z.data());
-        sl_free_sketch_transform(st);
+        int rc = sl_apply_sketch_transform(st, X, n, d, 1, Z.data());
         if (rc) return rc;
-        double blk = scale_maps ? std::sqrt((double)sj / (double)d) : 1.0;
+        double blk = m->scale_maps ? std::sqrt((double)sj / (double)d) : 1.0;
         // out += blk * Z @ W[off:off+sj]
 #pragma omp parallel for schedule(static)
         for (long i = 0; i < n; i++) {
@@ -1698,13 +1741,24 @@ int sl_model_predict(const char* path, const double* X, long n, long d,
             double* orow = out + (size_t)i * k;
             for (long p = 0; p < sj; p++) {
                 double zv = blk * zrow[p];
-                const double* wrow = W.data() + (size_t)(off + p) * k;
+                const double* wrow = m->W.data() + (size_t)(off + p) * k;
                 for (long j = 0; j < k; j++) orow[j] += zv * wrow[j];
             }
         }
         off += sj;
     }
-    return off == D ? 0 : 102;
+    return 0;
+}
+
+int sl_model_predict(const char* path, const double* X, long n, long d,
+                     double* out) {
+    // One-shot convenience: load, predict, free.
+    void* m = nullptr;
+    int rc = sl_model_load(path, &m);
+    if (rc) return rc;
+    rc = sl_model_predict_handle(m, X, n, d, out);
+    sl_model_free(m);
+    return rc;
 }
 
 }  // extern "C"

@@ -11,6 +11,7 @@ from .base import (
     Dimension,
     SketchTransform,
     create_sketch,
+    deserialize_sketch,
     from_dict,
     from_json,
     register_sketch,
@@ -41,6 +42,8 @@ __all__ = [
     "create_sketch",
     "from_dict",
     "from_json",
+    "deserialize_sketch",
+    "SUPPORTED_SKETCH_TRANSFORMS",
     "register_sketch",
     "sketch_registry",
     "DenseSketch",
@@ -70,4 +73,10 @@ __all__ = [
     "ExpSemigroupRLT",
     "ExpSemigroupQRLT",
     "PPT",
+]
+
+# ≙ python-skylark's SUPPORTED_SKETCH_TRANSFORMS (sketch.py:25-28): the
+# per-distribution matrix-type axis collapses to one kind here.
+SUPPORTED_SKETCH_TRANSFORMS = [
+    (T, "Matrix", "Matrix") for T in sorted(sketch_registry())
 ]

@@ -48,7 +48,11 @@ __all__ = [
     "SERIAL_VERSION",
 ]
 
-SERIAL_VERSION = 1
+# Version 2: the f32 uniform stream switched to hi-leading bits (see
+# docs/counter_contract.md "Stream revisions") — version-1 artifacts whose
+# f32-uniform-derived values matter (UST/NURST selections, RFT shifts,
+# Fastfood permutations realized in f32) reproduce differently.
+SERIAL_VERSION = 2
 
 
 class Dimension(enum.Enum):
@@ -141,6 +145,19 @@ class SketchTransform(abc.ABC):
     def to_json(self) -> str:
         return json.dumps(self.to_dict())
 
+    # python-skylark compatibility surface (sketch.py:94-232).
+    def serialize(self) -> dict[str, Any]:
+        """≙ python-skylark ``serialize()`` (dict form of the transform)."""
+        return self.to_dict()
+
+    def getindim(self) -> int:
+        """≙ python-skylark ``getindim()``."""
+        return self.n
+
+    def getsketchdim(self) -> int:
+        """≙ python-skylark ``getsketchdim()``."""
+        return self.s
+
     @classmethod
     def _from_param_dict(
         cls, d: dict[str, Any], context: SketchContext
@@ -167,11 +184,27 @@ def from_dict(d: dict[str, Any]) -> SketchTransform:
         raise ValueError(
             f"unknown sketch_type {t!r}; known: {sorted(_REGISTRY)}"
         )
+    if d.get("skylark_version", 1) < SERIAL_VERSION:
+        import warnings
+
+        warnings.warn(
+            f"sketch serialized under stream revision "
+            f"{d.get('skylark_version', 1)} (current {SERIAL_VERSION}): "
+            "f32-uniform-derived values reproduce differently "
+            "(docs/counter_contract.md, Stream revisions)",
+            stacklevel=2,
+        )
     return _REGISTRY[t].from_dict(d)
 
 
 def from_json(s: str) -> SketchTransform:
     return from_dict(json.loads(s))
+
+
+def deserialize_sketch(sketch_dict: dict[str, Any]) -> SketchTransform:
+    """≙ python-skylark ``deserialize_sketch`` (sketch.py:33-42): rebuild a
+    transform from its ``serialize()`` dict."""
+    return from_dict(sketch_dict)
 
 
 def create_sketch(

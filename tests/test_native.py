@@ -148,6 +148,52 @@ class TestNativeModelPredict:
         with pytest.raises(SkylarkError):
             native.model_predict(tmp_path / "nope.json", np.zeros((2, 3)))
 
+    def test_1d_coef_squeezes_like_python(self, tmp_path):
+        from libskylark_tpu.ml import FeatureMapModel, GaussianKernel
+
+        rng = np.random.default_rng(7)
+        ctx = SketchContext(seed=41)
+        maps = [GaussianKernel(4, 1.5).create_rft(16, "regular", ctx)]
+        W1 = rng.standard_normal(16)  # 1-D coefficients
+        model = FeatureMapModel(maps, W1, input_dim=4)
+        path = tmp_path / "m1d.json"
+        model.save(path)
+        X = rng.standard_normal((9, 4))
+        ref = np.asarray(model.predict(X))
+        out = native.model_predict(path, X)
+        assert out.shape == ref.shape  # (9,), not (9, 1)
+        np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-9)
+
+    def test_handle_reuse(self, tmp_path):
+        from libskylark_tpu.ml import FeatureMapModel, GaussianKernel
+
+        rng = np.random.default_rng(8)
+        ctx = SketchContext(seed=43)
+        maps = [GaussianKernel(3, 2.0).create_rft(8, "regular", ctx)]
+        model = FeatureMapModel(maps, rng.standard_normal((8, 2)), input_dim=3)
+        path = tmp_path / "mh.json"
+        model.save(path)
+        nm = native.NativeModel(path)
+        assert nm.num_outputs == 2
+        X = rng.standard_normal((5, 3))
+        out1 = nm.predict(X)
+        out2 = nm.predict(X)  # repeated predicts on one handle
+        np.testing.assert_array_equal(out1, out2)
+        np.testing.assert_allclose(
+            out1, np.asarray(model.predict(X)), rtol=1e-6, atol=1e-9
+        )
+
+    def test_old_version_sketch_warns(self):
+        from libskylark_tpu.sketch import JLT, from_json
+
+        S = JLT(10, 4, SketchContext(seed=1))
+        d = S.serialize()
+        d["skylark_version"] = 1
+        import json as _json
+
+        with pytest.warns(UserWarning, match="stream revision"):
+            from_json(_json.dumps(d))
+
 
 def test_supported_sketch_transforms_introspection():
     """≙ sl_supported_sketch_transforms (capi/csketch.cpp:74+): every C-API
