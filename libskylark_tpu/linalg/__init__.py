@@ -15,7 +15,7 @@ from ..solvers.accelerated import (
     faster_least_squares,
     lsrn_least_squares,
 )
-from ..solvers.cond_est import cond_est
+from ..solvers.cond_est import CondEstParams, CondEstResult, cond_est
 from .least_squares import (
     LeastSquaresParams,
     approximate_least_squares,
@@ -44,4 +44,6 @@ __all__ = [
     "faster_least_squares",
     "lsrn_least_squares",
     "cond_est",
+    "CondEstParams",
+    "CondEstResult",
 ]

@@ -143,6 +143,10 @@ def lib():
         L.sl_model_predict_handle.argtypes = [
             ctypes.c_void_p, f64, ctypes.c_long, ctypes.c_long, f64,
         ]
+        L.sl_model_stream_version.restype = ctypes.c_int
+        L.sl_model_stream_version.argtypes = [ctypes.c_void_p]
+        L.sl_stream_revision.restype = ctypes.c_int
+        L.sl_stream_revision.argtypes = []
         L.sl_error_string.restype = ctypes.c_char_p
         L.sl_error_string.argtypes = [ctypes.c_int]
         L.sl_sample.argtypes = [
@@ -273,12 +277,15 @@ class NativeModel:
         self._free = lib().sl_model_free
         with open(path) as f:
             meta = json.load(f)
-        if meta.get("skylark_version", 1) < 2:
+        # The native handle parses the version itself (sl_model_stream_
+        # version), so pure-C consumers see the same diagnostic signal.
+        ver = lib().sl_model_stream_version(self._h)
+        if ver < lib().sl_stream_revision():
             import warnings
 
             warnings.warn(
-                "model serialized under stream revision "
-                f"{meta.get('skylark_version', 1)} (current 2): "
+                f"model serialized under stream revision {ver} "
+                f"(current {lib().sl_stream_revision()}): "
                 "f32-uniform-derived map values reproduce differently "
                 "(docs/counter_contract.md, Stream revisions)",
                 stacklevel=2,

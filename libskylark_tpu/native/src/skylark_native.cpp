@@ -1665,7 +1665,25 @@ struct sl_model_t {
     std::vector<double> W;     // (D, k) row-major
     long D, k;
     bool scale_maps;
+    int version;               // skylark_version the model was saved under
 };
+
+// Current RNG stream revision: revision 2 made the f32 uniform stream
+// share the f64 value's leading bits.  Models saved under revision 1
+// reproduce f32-uniform-derived map internals (UST/NURST selections,
+// Fastfood permutations) differently; consumers should compare
+// sl_model_stream_version() against sl_stream_revision() and warn, as
+// the Python NativeModel wrapper does.
+static const int SL_STREAM_REVISION = 2;
+
+int sl_stream_revision(void) { return SL_STREAM_REVISION; }
+
+int sl_model_stream_version(void* m_) {
+    // Stream revision the loaded model was serialized under (1 when the
+    // JSON predates version tagging); < 0 on a null handle.
+    if (!m_) return -1;
+    return ((sl_model_t*)m_)->version;
+}
 
 void sl_model_free(void* m_) {
     sl_model_t* m = (sl_model_t*)m_;
@@ -1687,6 +1705,9 @@ int sl_model_load(const char* path, void** out) {
         delete m;
         return 105;
     }
+    double ver = 0.0;
+    m->version =
+        js_find_num(js.c_str(), "skylark_version", &ver) ? (int)ver : 1;
     std::vector<std::string> mapjs;
     if (!sk_json_map_objects(js, mapjs)) {
         delete m;

@@ -169,12 +169,24 @@ def columnwise_sharded_sparse(S, A, mesh: Mesh, scatter: bool = False):
     d, lr, cc = _shard_coo_rows(A, p, block)
     dtype = _coo_dtype(d)
 
+    if n >= (1 << 32):
+        # Traced shard offsets ride raw_bits' uint32 lane; the static
+        # h·N part of the window start is folded into the 64-bit counter
+        # base below, so only N itself must stay below 2^32.
+        raise ValueError(
+            f"columnwise_sharded_sparse supports N < 2^32, got N={n}"
+        )
+
     def local(d, lr, cc):
         d, lr, cc = d[0].astype(dtype), lr[0], cc[0]
         idx = jax.lax.axis_index(axes)
         acc = jnp.zeros((S.s * m,), dtype)
+        # uint32 shard offset + static h·N base: exact for any nnz·N
+        # (an int32 product here would wrap at 2^31 and silently select
+        # wrong counter windows).
+        off = jnp.uint32(idx) * jnp.uint32(block)
         for h in range(S.nnz):
-            start = h * S.n + idx * block
+            start = (h * S.n, off)
             b = S.buckets(start=start, num=block)  # (block,) in-shard
             v = S.values(dtype, start=start, num=block)
             acc = acc + jax.ops.segment_sum(

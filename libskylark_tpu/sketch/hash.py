@@ -61,7 +61,16 @@ class HashSketch(SketchTransform):
     def _window(self, start, num, total):
         """(static_base_add, traced_offset, num) for a counter window.
         ``start`` may be a traced scalar (shard-dependent under
-        ``shard_map``), in which case ``num`` is required."""
+        ``shard_map``), in which case ``num`` is required — traced starts
+        must stay below 2^32 (``raw_bits`` offset contract).  A
+        ``(static_int, traced)`` pair splits a large window start exactly:
+        the static part is folded into the 64-bit counter base, only the
+        shard-local remainder is traced."""
+        if isinstance(start, tuple):
+            static, traced = start
+            if num is None:
+                raise ValueError("num is required when start is traced")
+            return int(static), traced, num
         if isinstance(start, (int, np.integer)):
             return int(start), 0, (total - int(start) if num is None else num)
         if num is None:

@@ -14,7 +14,7 @@
 
 from .accelerated import FasterLeastSquaresParams, faster_least_squares, lsrn_least_squares
 from .asynch import asy_fcg
-from .cond_est import cond_est
+from .cond_est import CondEstParams, CondEstResult, cond_est
 from .gauss_seidel import randomized_block_gauss_seidel
 from .krylov import KrylovParams, cg, chebyshev, flexible_cg, lsqr
 from .precond import IdPrecond, MatPrecond, TriInversePrecond
@@ -34,6 +34,8 @@ __all__ = [
     "faster_least_squares",
     "lsrn_least_squares",
     "cond_est",
+    "CondEstParams",
+    "CondEstResult",
     "randomized_block_gauss_seidel",
     "LOSSES",
     "REGULARIZERS",

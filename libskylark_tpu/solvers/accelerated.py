@@ -53,6 +53,18 @@ def _sketch_once(A, s, sketch_type, context):
     return S.apply(A, Dimension.COLUMNWISE)
 
 
+def _tri_condest(R) -> float:
+    """1-norm condition estimate of upper-triangular R — ≙ the reference's
+    ``utcondest`` (LAPACK ``trcon``-style, ``accelerated_...Elemental.hpp:
+    25-66``): ‖R‖₁·‖R⁻¹‖₁ via a triangular solve against the identity."""
+    import jax.scipy.linalg as jsl
+
+    n = R.shape[0]
+    Rinv = jsl.solve_triangular(R, jnp.eye(n, dtype=R.dtype), lower=False)
+    one_norm = lambda M: jnp.max(jnp.sum(jnp.abs(M), axis=0))
+    return float(one_norm(R) * one_norm(Rinv))
+
+
 def faster_least_squares(
     A,
     B,
@@ -79,16 +91,32 @@ def faster_least_squares(
         s = min(int(gamma * n), m)
         SA = _sketch_once(A, s, stype, context)
         R_try = jnp.linalg.qr(SA, mode="r")
-        # Condition estimate of the preconditioned system (≙ CondEst call
-        # in the reference's retry loop; R is n×n so exact cond is cheap).
-        cond = float(jnp.linalg.cond(R_try))
+        # 1-norm triangular condition estimate of the preconditioner, the
+        # quantity the reference's retry loop consumes (``utcondest`` in
+        # ``build_precond``, accelerated_...Elemental.hpp:68-77, 225-246).
+        cond = _tri_condest(R_try)
         R = R_try
         if np.isfinite(cond) and cond < threshold:
             break
         gamma *= 2  # re-sketch larger (accelerated_...hpp:241-252)
+    if not (np.isfinite(cond) and cond < threshold):
+        # All attempts produced a bad preconditioner: fall back to the
+        # exact SVD solver, as the reference does after its retry budget
+        # (``_alt_solver``, accelerated_...Elemental.hpp:247-257, 275-280).
+        from ..linalg.least_squares import exact_least_squares
+
+        A_d = A.todense() if hasattr(A, "todense") else A
+        X = exact_least_squares(A_d, B, alg="svd")
+        return X, {
+            "attempts": attempt,
+            "condest": cond,
+            "fallback": "svd",
+            "iterations": 0,
+        }
     precond = TriInversePrecond(R, lower=False)
     X, info = lsqr(A, B, precond=precond, params=params.krylov)
     info["attempts"] = attempt
+    info["condest"] = cond
     return X, info
 
 

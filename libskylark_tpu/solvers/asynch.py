@@ -14,7 +14,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from ..core.context import SketchContext
-from .gauss_seidel import randomized_block_gauss_seidel
+from .gauss_seidel import gs_num_blocks, randomized_block_gauss_seidel
 from .krylov import KrylovParams, flexible_cg
 
 __all__ = ["asy_fcg"]
@@ -31,18 +31,25 @@ def asy_fcg(
     """Solve SPD ``A X = B`` by FCG with a randomized block-GS inner
     preconditioner.  Returns ``(X, info)``."""
     A = jnp.asarray(A)
-    # One reserved block drives the inner sweeps' schedule.  The schedule
-    # is fixed across outer iterations (its length must be trace-static);
-    # the preconditioner still varies because GS runs from the current
-    # residual — which is what makes FCG (not plain CG) necessary.
+    params = params or KrylovParams()
+    # One counter block PER OUTER ITERATION drives the inner sweeps'
+    # schedule, so each FCG iteration sees a fresh randomized GS sweep —
+    # matching AsyFCG's per-call randomization (``AsyFCG.hpp:8``,
+    # ``asynch/precond.hpp:7-22``).  The schedule LENGTH is trace-static;
+    # the traced outer-iteration index only shifts the counter window.
     seed = context.seed
-    nblocks = (A.shape[0] + block_size - 1) // block_size
-    base = context.reserve(inner_sweeps * nblocks)
+    per_iter = inner_sweeps * gs_num_blocks(A.shape[0], block_size)
+    base = context.reserve(params.iter_lim * per_iter)
 
     def precond(R, it):
         inner_ctx = SketchContext(seed=seed, counter=base)
         Z, _ = randomized_block_gauss_seidel(
-            A, R, inner_ctx, block_size=block_size, sweeps=inner_sweeps
+            A,
+            R,
+            inner_ctx,
+            block_size=block_size,
+            sweeps=inner_sweeps,
+            counter_offset=it.astype(jnp.uint32) * jnp.uint32(per_iter),
         )
         return Z
 

@@ -1,13 +1,39 @@
-"""Condition-number estimation (≙ ``nla/CondEst.hpp:67-305``).
+"""Condition-number estimation with certificates (≙ ``nla/CondEst.hpp:22-301``).
 
-The reference estimates σ_max by power iteration and σ_min by an LSQR-like
-Golub-Kahan bidiagonalization sweep, tracking the bidiagonal's smallest
-singular value as a certificate.  Here: power iteration on AᵀA for σ_max;
-k steps of Golub-Kahan with full reorthogonalization, σ_min from the small
-bidiagonal SVD.  All matmul-bound; jit-compatible (static step counts).
+Implements the Avron-Druinsky-Toledo estimator the reference ships:
+
+- σ_max by power iteration, with a certificate pair ``(u_max, v_max)``:
+  ``A @ v_max ≈ sigma_max * u_max`` with unit-norm vectors
+  (``CondEst.hpp:92-97``).
+- σ_min by an LSQR sweep on ``A x = b`` where ``b = A @ xhat`` for a known
+  random ``xhat``: the forward error ``d = xhat - x`` yields a *certified*
+  estimate ``sigma_min_c = ‖A d‖/‖d‖`` with certificate pair
+  ``(u_min, v_min)`` whenever it improves (``CondEst.hpp:200-224``), plus
+  an uncertified estimate from the smallest singular value of the LSQR
+  R-factor bidiagonal (``CondEst.hpp:176-187, 282-296``).
+- The τ machinery: ``tau = sqrt(2)·erfinv(c2)/‖xhat‖`` bounds how small the
+  forward error of a *random* xhat can get before further shrinkage is
+  statistically uninformative; reaching it stops the sweep
+  (``CondEst.hpp:108-117, 248-255``).
+
+Stopping flags mirror the reference's return codes: ``-1`` cond ≈ 1
+detected, ``-2`` C1 backward-style convergence, ``-3`` C2 forward error
+below τ, ``-4`` C3 numerically singular, ``-6`` no convergence within the
+iteration limit.  As in the reference, after a criterion first fires the
+sweep continues to ``1.25·itn + 1`` iterations before exiting
+(``CondEst.hpp:238-264``).
+
+TPU notes: the whole sweep is ONE jitted ``lax.while_loop`` over fixed-size
+buffers (no per-iteration host sync); the final bidiagonal SVD pads unused
+slots with σ_max on the diagonal, which adds singular values ≥ the true
+minimum and so cannot perturb it.
 """
 
 from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -15,75 +41,301 @@ from jax import lax
 
 from ..core.context import SketchContext
 from ..core.matrices import gaussian_matrix
+from ..core.params import Params
 
-__all__ = ["cond_est"]
+__all__ = ["CondEstParams", "CondEstResult", "cond_est"]
+
+
+@dataclass
+class CondEstParams(Params):
+    """≙ ``condest_params_t`` (``CondEst.hpp:22-45``).
+
+    ``c1..c4, c1t`` default from machine epsilon exactly as the reference
+    does (there in f64; here from the input dtype's eps so f32 runs get
+    consistent thresholds).  ``None`` → derive at call time.
+    """
+
+    iter_lim: int = 300
+    powerits: int = 100
+    c1: float | None = None  # 8·eps      (C1 convergence scale)
+    c2: float = 1e-3  #                    (τ quantile)
+    c3: float | None = None  # 64/eps     (declare singular)
+    c4: float | None = None  # sqrt(eps)  (ill-conditioning gate)
+    c1t: float | None = None  # 4·eps     (tightened C1)
+
+
+class CondEstResult(NamedTuple):
+    """First three fields are the round-1 ``(cond, sigma_max, sigma_min)``
+    triple — access them by name or index (``r.cond`` / ``r[0]``; note a
+    bare 3-way tuple unpack no longer works since the certificate fields
+    follow); the rest are the reference's certificate outputs."""
+
+    cond: jax.Array
+    sigma_max: jax.Array
+    sigma_min: jax.Array
+    sigma_min_c: jax.Array  # certified estimate (≥ sigma_min)
+    u_max: jax.Array  # (m,) left certificate: A v_max ≈ σ_max u_max
+    v_max: jax.Array  # (n,) right certificate
+    u_min: jax.Array  # (m,) left certificate: A v_min ≈ σ_min_c u_min
+    v_min: jax.Array  # (n,) right certificate
+    flag: jax.Array  # int32 reference return code (-1..-4, -6)
+
+
+def _power_sigma_max(matvec, rmatvec, v0, powerits):
+    """Dominant singular triplet by power iteration on AᵀA
+    (≙ ``PowerIteration`` call, ``CondEst.hpp:92-97``)."""
+
+    def body(_, v):
+        w = rmatvec(matvec(v))
+        return w / jnp.linalg.norm(w)
+
+    v = lax.fori_loop(0, powerits, body, v0 / jnp.linalg.norm(v0))
+    u = matvec(v)
+    sigma = jnp.linalg.norm(u)
+    return sigma, u / sigma, v
 
 
 def cond_est(
     A,
     context: SketchContext,
-    power_its: int = 30,
-    lanczos_steps: int = 40,
+    params: CondEstParams | None = None,
+    # Round-1 keywords kept for compatibility; map onto powerits/iter_lim.
+    power_its: int | None = None,
+    lanczos_steps: int | None = None,
 ):
-    """Returns ``(cond, sigma_max, sigma_min)`` estimates for tall A."""
-    A = A if hasattr(A, "todense") else jnp.asarray(A)
-    m, n = A.shape
-    steps = min(lanczos_steps, n)
+    """Estimate cond(A) with certificates for tall (or square) A.
+
+    A may be dense or BCOO (only matvecs are taken, as in the reference).
+    Returns a :class:`CondEstResult`; ``r.cond, r.sigma_max, r.sigma_min``
+    are the round-1 triple (by name/index; positional 3-unpack no longer
+    applies).
+    """
+    params = params or CondEstParams()
+    if power_its is not None or lanczos_steps is not None:
+        params = replace(
+            params,
+            powerits=params.powerits if power_its is None else power_its,
+            iter_lim=(
+                params.iter_lim if lanczos_steps is None else lanczos_steps
+            ),
+        )
+    if not hasattr(A, "todense"):
+        A = jnp.asarray(A)
+    n = A.shape[1]
     dtype = A.data.dtype if hasattr(A, "todense") else A.dtype
+    eps = float(jnp.finfo(dtype).eps)
+    c1 = params.c1 if params.c1 is not None else 8 * eps
+    c2 = params.c2
+    c3 = params.c3 if params.c3 is not None else 64.0 / eps
+    c4 = params.c4 if params.c4 is not None else float(jnp.sqrt(eps))
+    c1t = params.c1t if params.c1t is not None else 4 * eps
+    T_max = int(params.iter_lim)
 
-    # sigma_max: power iteration on AᵀA (CondEst.hpp power loop).
-    v = gaussian_matrix(context, (n, 1), dtype=dtype)[:, 0]
-    v = v / jnp.linalg.norm(v)
-
-    def pbody(_, v):
-        w = A.T @ (A @ v)
-        return w / jnp.linalg.norm(w)
-
-    v = lax.fori_loop(0, power_its, pbody, v)
-    sigma_max = jnp.sqrt(jnp.linalg.norm(A.T @ (A @ v)))
-
-    # sigma_min: Golub-Kahan bidiagonalization with reorthogonalization,
-    # smallest singular value of the (steps+1, steps) bidiagonal matrix
-    # (≙ the R-diagonal tracking sweep, CondEst.hpp:150-260).
-    u0 = gaussian_matrix(context, (m, 1), dtype=dtype)[:, 0]
-    beta0 = jnp.linalg.norm(u0)
-    u0 = u0 / beta0
-    Us = jnp.zeros((steps + 1, m), dtype).at[0].set(u0)
-    Vs = jnp.zeros((steps, n), dtype)
-    alphas = jnp.zeros((steps,), dtype)
-    betas = jnp.zeros((steps,), dtype)
-
-    def gkbody(i, carry):
-        Us, Vs, alphas, betas = carry
-        u = Us[i]
-        v = A.T @ u
-        # Full reorthogonalization against previous V's (covers the
-        # classical -beta*v_prev term and keeps the basis numerically
-        # orthogonal; rows > i are zero so they contribute nothing).
-        v = v - Vs.T @ (Vs @ v)
-        alpha = jnp.linalg.norm(v)
-        v = v / jnp.where(alpha > 0, alpha, 1)
-        Vs = Vs.at[i].set(v)
-        alphas = alphas.at[i].set(alpha)
-        unew = A @ v - alpha * u
-        unew = unew - Us.T @ (Us @ unew)
-        beta = jnp.linalg.norm(unew)
-        unew = unew / jnp.where(beta > 0, beta, 1)
-        Us = Us.at[i + 1].set(unew)
-        betas = betas.at[i].set(beta)
-        return (Us, Vs, alphas, betas)
-
-    Us, Vs, alphas, betas = lax.fori_loop(
-        0, steps, gkbody, (Us, Vs, alphas, betas)
+    v0 = gaussian_matrix(context, (n, 1), dtype=dtype)[:, 0]
+    xhat0 = gaussian_matrix(context, (n, 1), dtype=dtype)[:, 0]
+    return _cond_est_impl(
+        A, v0, xhat0, int(params.powerits), T_max, c1, c2, c3, c4, c1t
     )
-    # Bidiagonal B: diag(alphas), subdiag(betas[:-1]) — (steps+1, steps).
-    Bmat = (
-        jnp.zeros((steps + 1, steps), dtype)
-        .at[jnp.arange(steps), jnp.arange(steps)]
-        .set(alphas)
-        .at[jnp.arange(1, steps + 1), jnp.arange(steps)]
-        .set(betas)
-    )
-    sv = jnp.linalg.svd(Bmat, compute_uv=False)
-    sigma_min = sv[-1]
-    return sigma_max / sigma_min, sigma_max, sigma_min
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "powerits", "T_max", "c1", "c2", "c3", "c4", "c1t",
+    ),
+)
+def _cond_est_impl(A, v0, xhat0, powerits, T_max, c1, c2, c3, c4, c1t):
+    dtype = v0.dtype
+    matvec = lambda x: A @ x
+    rmatvec = lambda y: A.T @ y
+
+    def _run(v0, xhat0):
+        sigma_max, u_max, v_max = _power_sigma_max(
+            matvec, rmatvec, v0, powerits
+        )
+
+        # xhat / tau (CondEst.hpp:108-117).
+        nrm_xhat = jnp.linalg.norm(xhat0)
+        tau = (
+            jnp.sqrt(jnp.asarray(2.0, dtype))
+            * jax.scipy.special.erfinv(jnp.asarray(c2, dtype))
+            / nrm_xhat
+        )
+        xhat = xhat0 / nrm_xhat
+
+        # b and LSQR initialization (CondEst.hpp:119-152).
+        b = matvec(xhat)
+        nrm_b = jnp.linalg.norm(b)
+        beta0 = nrm_b
+        u = b / beta0
+        v_init = rmatvec(u)
+        alpha0 = jnp.linalg.norm(v_init)
+        v = v_init / alpha0
+
+        Rdiag = jnp.zeros((T_max,), dtype)
+        Rsub = jnp.zeros((T_max,), dtype)
+
+        state = dict(
+            itn=jnp.asarray(0, jnp.int32),
+            T=jnp.asarray(T_max, jnp.int32),
+            flag=jnp.asarray(-6, jnp.int32),
+            c1=jnp.asarray(c1, dtype),
+            u=u,
+            v=v,
+            x=jnp.zeros_like(xhat0),
+            w=v,
+            alpha=alpha0,
+            phibar=beta0,
+            rhobar=alpha0,
+            theta=jnp.asarray(0.0, dtype),
+            Rdiag=Rdiag,
+            Rsub=Rsub,
+            sigma_min=sigma_max,
+            u_min=u_max,
+            v_min=v_max,
+            done_one=jnp.asarray(False),
+        )
+
+        def cond_fn(s):
+            return jnp.logical_and(s["itn"] < s["T"], ~s["done_one"])
+
+        def body_fn(s):
+            itn = s["itn"]
+            # 1-2. Golub-Kahan updates (CondEst.hpp:161-174), with exact-
+            # breakdown guards (beta or alpha == 0 on low-rank/structured
+            # A must not NaN-poison the remaining extension iterations).
+            u_new = matvec(s["v"]) - s["alpha"] * s["u"]
+            beta = jnp.linalg.norm(u_new)
+            u_new = u_new / jnp.where(beta > 0, beta, 1)
+            v_new = rmatvec(u_new) - beta * s["v"]
+            alpha = jnp.linalg.norm(v_new)
+            v_new = v_new / jnp.where(alpha > 0, alpha, 1)
+
+            # 3. Givens rotation; store R entries (CondEst.hpp:176-188).
+            rho = jnp.sqrt(s["rhobar"] ** 2 + beta**2)
+            Rdiag = s["Rdiag"].at[itn].set(rho)
+            Rsub = jnp.where(
+                itn > 0, s["Rsub"].at[itn - 1].set(s["theta"]), s["Rsub"]
+            )
+            cs = s["rhobar"] / rho
+            sn = beta / rho
+            theta = sn * alpha
+            rhobar = -cs * alpha
+            phi = cs * s["phibar"]
+            phibar = sn * s["phibar"]
+
+            # 4. x / w updates (CondEst.hpp:190-198).
+            x = s["x"] + (phi / rho) * s["w"]
+            w = v_new - (theta / rho) * s["w"]
+
+            # 5. Forward error; cond≈1 early exit (CondEst.hpp:200-214).
+            d = xhat - x
+            nrm_d = jnp.linalg.norm(d)
+            done_one = nrm_d == 0.0
+
+            # 6. Certified sigma_min update (CondEst.hpp:216-224).
+            Ad = matvec(d)
+            nrm_ad = jnp.linalg.norm(Ad)
+            improves = (nrm_ad <= s["sigma_min"] * nrm_d) & (nrm_d > 0)
+            sigma_min = jnp.where(
+                improves, nrm_ad / jnp.where(nrm_d > 0, nrm_d, 1),
+                s["sigma_min"],
+            )
+            safe_ad = jnp.where(nrm_ad > 0, nrm_ad, 1)
+            u_min = jnp.where(improves, Ad / safe_ad, s["u_min"])
+            v_min = jnp.where(
+                improves, d / jnp.where(nrm_d > 0, nrm_d, 1), s["v_min"]
+            )
+
+            # 7. Tighten C1 when highly ill-conditioned (CondEst.hpp:227-234).
+            c1_cur = jnp.where(
+                sigma_min / sigma_max <= c4, jnp.asarray(c1t, dtype), s["c1"]
+            )
+
+            # 8. Stopping criteria; first trigger sets T = 1.25·itn + 1
+            # (CondEst.hpp:236-264).
+            nrm_x = jnp.linalg.norm(x)
+            open_ = s["T"] == T_max
+            itf = itn.astype(dtype)
+            T_ext = jnp.minimum(
+                (1.25 * itf + 1).astype(jnp.int32), jnp.asarray(T_max)
+            )
+            hit_c1 = jnp.logical_and(
+                open_, nrm_ad <= c1_cur * (sigma_max * nrm_x + nrm_b)
+            )
+            hit_c2 = jnp.logical_and(open_, nrm_d <= tau)
+            hit_c3 = jnp.logical_and(open_, sigma_max / sigma_min >= c3)
+            hit = hit_c1 | hit_c2 | hit_c3
+            flag = jnp.where(
+                hit_c1,
+                -2,
+                jnp.where(hit_c2, -3, jnp.where(hit_c3, -4, s["flag"])),
+            ).astype(jnp.int32)
+            T = jnp.where(hit, T_ext, s["T"])
+
+            return dict(
+                itn=itn + 1,
+                T=T,
+                flag=flag,
+                c1=c1_cur,
+                u=u_new,
+                v=v_new,
+                x=x,
+                w=w,
+                alpha=alpha,
+                phibar=phibar,
+                rhobar=rhobar,
+                theta=theta,
+                Rdiag=Rdiag,
+                Rsub=Rsub,
+                sigma_min=sigma_min,
+                u_min=u_min,
+                v_min=v_min,
+                done_one=done_one,
+            )
+
+        s = lax.while_loop(cond_fn, body_fn, state)
+
+        # R-based (uncertified) sigma_min: smallest singular value of the
+        # bidiagonal R over iterations actually run (CondEst.hpp:282-296).
+        # Unused slots pad the diagonal with sigma_max (decoupled singular
+        # values equal to sigma_max — can't go below the true minimum).
+        count = s["itn"]
+        idx = jnp.arange(T_max)
+        diag = jnp.where(idx < count, s["Rdiag"], sigma_max)
+        sub = jnp.where(idx + 1 < count, s["Rsub"], 0.0)
+        Bmat = (
+            jnp.zeros((T_max, T_max), dtype)
+            .at[idx, idx]
+            .set(diag)
+            .at[idx[:-1], idx[:-1] + 1]
+            .set(sub[:-1])
+        )
+        sigma_min_R = jnp.linalg.svd(Bmat, compute_uv=False)[-1]
+        sigma_min_R = jnp.where(count > 0, sigma_min_R, sigma_max)
+
+        sigma_min_c = s["sigma_min"]
+        sigma_min = jnp.minimum(sigma_min_c, sigma_min_R)
+
+        # cond ≈ 1 early exit overrides (CondEst.hpp:204-214).
+        one = s["done_one"]
+        sigma_min = jnp.where(one, sigma_max, sigma_min)
+        sigma_min_c = jnp.where(one, sigma_max, sigma_min_c)
+        u_min = jnp.where(one, u_max, s["u_min"])
+        v_min = jnp.where(one, v_max, s["v_min"])
+        flag = jnp.where(one, -1, s["flag"]).astype(jnp.int32)
+        cond = jnp.where(one, 1.0, sigma_max / sigma_min)
+
+        return CondEstResult(
+            cond=cond,
+            sigma_max=sigma_max,
+            sigma_min=sigma_min,
+            sigma_min_c=sigma_min_c,
+            u_max=u_max,
+            v_max=v_max,
+            u_min=u_min,
+            v_min=v_min,
+            flag=flag,
+        )
+
+    return _run(v0, xhat0)

@@ -20,7 +20,16 @@ from jax import lax
 from ..core.context import SketchContext
 from ..core.random import sample
 
-__all__ = ["randomized_block_gauss_seidel"]
+__all__ = ["randomized_block_gauss_seidel", "gs_num_blocks"]
+
+
+def gs_num_blocks(n: int, block_size: int) -> int:
+    """Number of (clamped, possibly overlapping) blocks a GS sweep visits —
+    the schedule consumes ``sweeps * gs_num_blocks(n, bs)`` counters.
+    Exposed so callers reserving per-outer-iteration counter windows
+    (``asy_fcg``) share this arithmetic instead of re-deriving it."""
+    bs = min(block_size, n)
+    return (n + bs - 1) // bs
 
 
 def randomized_block_gauss_seidel(
@@ -30,12 +39,18 @@ def randomized_block_gauss_seidel(
     block_size: int = 64,
     sweeps: int = 10,
     x0=None,
+    counter_offset=0,
 ):
     """Solve SPD ``A X = B`` by randomized block Gauss-Seidel sweeps.
 
     Returns ``(X, info)``.  n must be ≥ block_size; a trailing ragged block
     is padded into the last full block (updates overlap harmlessly — GS
     tolerates overlapping blocks).
+
+    ``counter_offset`` may be a traced scalar shifting the schedule's
+    counter window (callers embedding GS in a jitted outer loop — e.g.
+    ``asy_fcg`` — reserve one block per outer iteration and pass
+    ``it * sweeps * nblocks``).
     """
     A = jnp.asarray(A)
     B = jnp.asarray(B)
@@ -44,7 +59,7 @@ def randomized_block_gauss_seidel(
         B = B[:, None]
     n = A.shape[0]
     bs = min(block_size, n)
-    nblocks = (n + bs - 1) // bs
+    nblocks = gs_num_blocks(n, block_size)
     # Block start offsets; last block clamped (overlap instead of ragged).
     starts = jnp.minimum(jnp.arange(nblocks) * bs, n - bs)
     seed = context.seed
@@ -54,7 +69,14 @@ def randomized_block_gauss_seidel(
 
     # All sweep orders generated up-front from the counter stream (static
     # shapes for the jitted loop; ≙ the per-sweep RNG draws of AsyRGS).
-    u = sample("uniform", seed, base, sweeps * nblocks, dtype=jnp.float32)
+    u = sample(
+        "uniform",
+        seed,
+        base,
+        sweeps * nblocks,
+        dtype=jnp.float32,
+        offset=counter_offset,
+    )
     orders = jnp.argsort(u.reshape(sweeps, nblocks), axis=1)
 
     def sweep(s, X):

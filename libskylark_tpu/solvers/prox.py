@@ -100,15 +100,26 @@ class HingeLoss:
 
 class LogisticLoss:
     """Multinomial logistic −log softmax (≙ ``logisticloss_t``,
-    loss.hpp:309+; the reference solves the prox with an iterative inner
-    method — here a fixed number of Newton steps on the softmax fixed
-    point, jit-compatible)."""
+    loss.hpp:309-440).
+
+    The prox is solved the way the reference's ``logexp`` does: damped
+    Newton with Armijo backtracking (α=0.1, β=0.5), stopping on the Newton
+    decrement ``gᵀu < 2ε`` with ε=1e-4 or after MAXITER=100 iterations
+    (``loss.hpp:365-420``).  Multiclass uses the exact softmax Hessian via
+    a Sherman-Morrison solve (diag + rank-1, as the reference's
+    ``u/z/pu/pptil`` recurrence); everything is vectorized over examples
+    with per-example convergence masks inside one ``lax.while_loop``."""
 
     name = "logistic"
     label_based = True
 
-    def __init__(self, newton_steps: int = 20):
-        self.newton_steps = newton_steps
+    def __init__(self, max_newton_steps: int = 100, epsilon: float = 1e-4):
+        self.max_newton_steps = max_newton_steps
+        self.epsilon = epsilon
+
+    _ALPHA = 0.1  # Armijo slope fraction (loss.hpp:370)
+    _BETA = 0.5  # step halving factor (loss.hpp:371)
+    _MAX_HALVINGS = 30
 
     def _is_binary(self, O):
         return O.ndim < 2 or O.shape[0] == 1
@@ -123,32 +134,97 @@ class LogisticLoss:
         picked = jnp.take_along_axis(O, cls[None, :], axis=0)[0]
         return jnp.sum(logZ - picked)
 
+    def _damped_newton(self, V, x0, obj, grad_dir):
+        """Shared guarded-Newton loop: ``grad_dir(X) -> (G, U)`` gives the
+        gradient and Newton direction; Armijo backtracking per example;
+        stop when every example's Newton decrement ``ΣG·U`` is below 2ε
+        (≙ the decrement test + line search of ``loss.hpp:389-416``)."""
+        eps2 = 2.0 * self.epsilon
+
+        def cond(s):
+            return (s["it"] < self.max_newton_steps) & ~jnp.all(s["done"])
+
+        def body(s):
+            X = s["X"]
+            G, U = grad_dir(X)
+            dec = jnp.sum(G * U, axis=0)  # per-example Newton decrement
+            done = s["done"] | (dec < eps2)
+            f0 = obj(X)
+
+            # Backtracking with one objective evaluation per step size:
+            # carry (t, need-mask); halve only still-failing examples.
+            def ls_cond(ts):
+                _, need, k = ts
+                return jnp.any(need & ~done) & (k < self._MAX_HALVINGS)
+
+            def ls_body(ts):
+                t, need, k = ts
+                t = jnp.where(need, self._BETA * t, t)
+                trial = obj(X - t[None, :] * U)
+                return t, trial > f0 - self._ALPHA * t * dec, k + 1
+
+            t1 = jnp.ones_like(dec)
+            need0 = obj(X - t1[None, :] * U) > f0 - self._ALPHA * t1 * dec
+            t, _, _ = lax.while_loop(
+                ls_cond, ls_body, (t1, need0, jnp.asarray(0))
+            )
+            X_new = jnp.where(done[None, :], X, X - t[None, :] * U)
+            return dict(it=s["it"] + 1, X=X_new, done=done)
+
+        n = V.shape[1]
+        state = dict(
+            it=jnp.asarray(0), X=x0, done=jnp.zeros((n,), bool)
+        )
+        return lax.while_loop(cond, body, state)["X"]
+
     def prox(self, V, lam, Y):
         if self._is_binary(V):
-            # Newton on  lam·log(1+exp(−y·x)) + ½(x−v)²  per element.
-            yv = Y.reshape(V.shape).astype(V.dtype)
+            # Guarded Newton on  lam·log(1+exp(−y·x)) + ½(x−v)²  per
+            # element (shape (1, n) or (n,)).
+            shape = V.shape
+            V2 = V.reshape(1, -1)
+            yv = Y.reshape(V2.shape).astype(V.dtype)
 
-            def nbody(_, X):
+            def obj(X):
+                return jnp.sum(
+                    lam * jnp.logaddexp(0.0, -yv * X)
+                    + 0.5 * (X - V2) ** 2,
+                    axis=0,
+                )
+
+            def grad_dir(X):
                 sig = jax.nn.sigmoid(-yv * X)
-                g = -lam * yv * sig + (X - V)
+                g = -lam * yv * sig + (X - V2)
                 h = lam * sig * (1.0 - sig) + 1.0
-                return X - g / h
+                return g, g / h
 
-            return lax.fori_loop(0, self.newton_steps, nbody, V)
+            return self._damped_newton(V2, V2, obj, grad_dir).reshape(shape)
 
         cls = Y.astype(jnp.int32).reshape(-1)
         k, n = V.shape
         E = jax.nn.one_hot(cls, k, dtype=V.dtype).T  # (k, n)
 
-        # Solve X = V − lam·(softmax(X) − e_y) by diagonal-Hessian Newton;
-        # a few iterations suffice (prox is well-conditioned).
-        def body(_, X):
-            Pr = jax.nn.softmax(X, axis=0)
-            G = Pr - E
-            H = lam * Pr * (1 - Pr) + 1.0
-            return X - (X - V + lam * G) / H
+        def obj(X):
+            logZ = jax.scipy.special.logsumexp(X, axis=0)
+            return lam * (logZ - jnp.sum(E * X, axis=0)) + 0.5 * jnp.sum(
+                (X - V) ** 2, axis=0
+            )
 
-        return lax.fori_loop(0, self.newton_steps, body, V)
+        def grad_dir(X):
+            # Hessian = diag(lam·p + 1) − lam·p pᵀ per example; exact
+            # Newton direction by Sherman-Morrison (≙ the u/z/pu/pptil
+            # recurrence of loss.hpp:381-397).
+            Pr = jax.nn.softmax(X, axis=0)
+            G = lam * (Pr - E) + (X - V)
+            D = lam * Pr + 1.0
+            U0 = G / D
+            Z = Pr / D
+            pu = jnp.sum(Pr * U0, axis=0)
+            pptil = 1.0 - lam * jnp.sum(Pr * Z, axis=0)
+            U = U0 + (lam * pu / pptil)[None, :] * Z
+            return G, U
+
+        return self._damped_newton(V, V, obj, grad_dir)
 
 
 class EmptyRegularizer:

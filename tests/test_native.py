@@ -194,6 +194,43 @@ class TestNativeModelPredict:
         with pytest.warns(UserWarning, match="stream revision"):
             from_json(_json.dumps(d))
 
+    def test_c_side_stream_version(self, tmp_path):
+        """Pure-C consumers detect pre-revision models: sl_model_load
+        parses skylark_version; sl_model_stream_version exposes it
+        (ADVICE round 1, skylark_native.cpp sl_model_load)."""
+        import ctypes
+        import json as _json
+
+        from libskylark_tpu.ml import FeatureMapModel, GaussianKernel
+
+        L = native.lib()
+        assert L.sl_stream_revision() == 2
+
+        rng = np.random.default_rng(9)
+        ctx = SketchContext(seed=47)
+        maps = [GaussianKernel(3, 2.0).create_rft(8, "regular", ctx)]
+        model = FeatureMapModel(maps, rng.standard_normal((8, 2)), input_dim=3)
+        path = tmp_path / "mv.json"
+        model.save(path)
+
+        h = ctypes.c_void_p()
+        assert L.sl_model_load(str(path).encode(), ctypes.byref(h)) == 0
+        assert L.sl_model_stream_version(h) == 2
+        L.sl_model_free(h)
+
+        # Rewrite as a version-1 model; the C parser must report 1 and
+        # the Python wrapper must warn off the C-side value.
+        d = _json.loads(path.read_text())
+        d2 = {"skylark_version": 1}
+        d2.update({k: v for k, v in d.items() if k != "skylark_version"})
+        path.write_text(_json.dumps(d2))
+        h = ctypes.c_void_p()
+        assert L.sl_model_load(str(path).encode(), ctypes.byref(h)) == 0
+        assert L.sl_model_stream_version(h) == 1
+        L.sl_model_free(h)
+        with pytest.warns(UserWarning, match="stream revision 1"):
+            native.NativeModel(path)
+
 
 def test_supported_sketch_transforms_introspection():
     """≙ sl_supported_sketch_transforms (capi/csketch.cpp:74+): every C-API

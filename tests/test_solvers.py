@@ -166,15 +166,70 @@ class TestLSRN:
 
 
 class TestCondEst:
+    def _spectrum_matrix(self, rng, m, n, s):
+        U = np.linalg.qr(rng.standard_normal((m, n)))[0]
+        V = np.linalg.qr(rng.standard_normal((n, n)))[0]
+        return jnp.asarray(U @ np.diag(s) @ V)
+
     def test_known_condition(self, rng):
-        U = np.linalg.qr(rng.standard_normal((400, 20)))[0]
-        V = np.linalg.qr(rng.standard_normal((20, 20)))[0]
         s = np.logspace(0, -3, 20)
-        A = jnp.asarray(U @ np.diag(s) @ V)
-        cond, smax, smin = cond_est(A, SketchContext(seed=21))
+        A = self._spectrum_matrix(rng, 400, 20, s)
+        r = cond_est(A, SketchContext(seed=21))
+        cond, smax, smin = r.cond, r.sigma_max, r.sigma_min
         assert abs(float(smax) - 1.0) < 0.05
         assert abs(float(smin) - 1e-3) / 1e-3 < 0.2
         assert abs(float(cond) - 1e3) / 1e3 < 0.25
+
+    def test_certificates(self, rng):
+        """The certificate contract of CondEst.hpp:55-63: unit vectors with
+        A v_max ≈ σ_max u_max and A v_min ≈ σ_min_c u_min."""
+        s = np.logspace(0, -2, 30)
+        A = self._spectrum_matrix(rng, 300, 30, s)
+        r = cond_est(A, SketchContext(seed=5))
+        for v in (r.u_max, r.v_max, r.u_min, r.v_min):
+            assert abs(float(jnp.linalg.norm(v)) - 1.0) < 1e-4
+        res_max = float(
+            jnp.linalg.norm(A @ r.v_max - r.sigma_max * r.u_max)
+        )
+        assert res_max < 1e-3 * float(r.sigma_max)
+        res_min = float(
+            jnp.linalg.norm(A @ r.v_min - r.sigma_min_c * r.u_min)
+        )
+        # v_min certifies sigma_min_c exactly by construction.
+        assert res_min < 1e-4 * float(r.sigma_max)
+        # Certified estimate upper-bounds the best estimate, and both
+        # bracket the true sigma_min from above.
+        assert float(r.sigma_min_c) >= float(r.sigma_min) - 1e-7
+        assert float(r.sigma_min) >= 1e-2 * (1 - 0.05)
+
+    def test_identity_flags_cond_one(self, rng):
+        A = jnp.eye(50)
+        r = cond_est(A, SketchContext(seed=3))
+        assert float(r.cond) < 1.0 + 1e-3
+        # Either the cond-1 early exit (-1) or C1/C2 convergence fired.
+        assert int(r.flag) in (-1, -2, -3)
+
+    def test_flag_convergence(self, rng):
+        s = np.logspace(0, -1, 10)
+        A = self._spectrum_matrix(rng, 200, 10, s)
+        r = cond_est(A, SketchContext(seed=9))
+        assert int(r.flag) in (-1, -2, -3)  # converged, not -6
+
+    def test_blendenpik_precond_is_certified_wellconditioned(self, rng):
+        """Wiring check: Blendenpik's R-preconditioned operator A·R⁻¹ has
+        CondEst-certified condition ≈ 1 (the property the retry loop in
+        accelerated_...Elemental.hpp:225-246 exists to guarantee)."""
+        from libskylark_tpu.solvers.accelerated import _sketch_once
+        from libskylark_tpu.sketch.base import Dimension
+
+        A = jnp.asarray(rng.standard_normal((600, 15)))
+        SA = _sketch_once(A, 60, "FJLT", SketchContext(seed=33))
+        R = jnp.linalg.qr(SA, mode="r")
+        import jax.scipy.linalg as jsl
+
+        A_pre = jsl.solve_triangular(R.T, A.T, lower=True).T  # A R⁻¹
+        r = cond_est(A_pre, SketchContext(seed=34))
+        assert float(r.cond) < 3.0
 
 
 class TestBlockGaussSeidel:
