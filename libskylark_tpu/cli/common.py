@@ -4,16 +4,12 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["save_classes", "load_classes", "print_test_metrics"]
-
-
-def save_classes(modelfile, classes) -> None:
-    """Persist the label decoding sidecar next to a saved model."""
-    if classes is not None:
-        np.save(str(modelfile) + ".classes.npy", np.asarray(classes))
+__all__ = ["load_classes", "print_test_metrics"]
 
 
 def load_classes(modelfile):
+    """Read the legacy label-decoding sidecar (pre-round-2 models; the
+    coding now rides the model JSON itself — ``ml/model.py``)."""
     try:
         return np.load(str(modelfile) + ".classes.npy")
     except FileNotFoundError:

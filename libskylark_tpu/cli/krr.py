@@ -110,10 +110,10 @@ def main(argv=None) -> int:
     dt = time.perf_counter() - t0
     print(f"Training ({alg}) took {dt:.3f} sec")
 
-    from .common import print_test_metrics, save_classes
+    from .common import print_test_metrics
 
+    # Label coding rides the model JSON (≙ get_column_coding).
     model.save(args.modelfile)
-    save_classes(args.modelfile, getattr(model, "classes", None))
     print(f"Model saved to {args.modelfile}")
 
     if args.testfile:

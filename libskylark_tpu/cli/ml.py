@@ -110,16 +110,17 @@ def main(argv=None) -> int:
         )
         print(f"Training took {time.perf_counter() - t0:.3f} sec; "
               f"final objective {model.history[-1]:.6e}")
-        from .common import save_classes
-
+        # The model JSON embeds the label coding (≙ get_column_coding).
         model.save(args.modelfile)
-        save_classes(args.modelfile, getattr(model, "classes", None))
         print(f"Model saved to {args.modelfile}")
     else:
+        from ..ml import load_model
         from .common import load_classes
 
-        model = FeatureMapModel.load(args.modelfile)
-        model.classes = load_classes(args.modelfile)
+        model = load_model(args.modelfile)
+        if getattr(model, "classes", None) is None:
+            # Legacy sidecar from pre-embedded-coding saves.
+            model.classes = load_classes(args.modelfile)
 
     if args.testfile:
         d = model.input_dim

@@ -1,9 +1,15 @@
 """skylark-svd: randomized SVD driver (≙ ``nla/skylark_svd.cpp:1-477``).
 
-Reads LIBSVM (or .npy), runs ``approximate_svd``, writes U/S/V as .npy.
-``--profile`` generates a synthetic low-rank + noise matrix instead of
-reading a file (≙ the reference's ``--profile`` synthetic mode,
-``nla/skylark_svd.cpp:37-60``).
+Reads LIBSVM, arc-list graphs (``--filetype arclist`` ≙ the reference's
+``ARC_LIST`` + ``ReadArcList``, ``skylark_svd.cpp:169-171,246-248``),
+HDF5 (reference layout, ``io/hdf5.py``), or .npy; runs
+``approximate_svd`` (or ``approximate_symmetric_svd`` under
+``--symmetric`` ≙ ``execute_sym``, ``skylark_svd.cpp:120-222``); writes
+U/S/V as .npy, or as the reference's ASCII convention (``El::Write(...,
+El::ASCII)`` to ``prefix.U`` / ``prefix.S`` / ``prefix.V``,
+``skylark_svd.cpp:110-112``) with ``--ascii``.  ``--profile`` generates a
+synthetic low-rank + noise matrix instead of reading a file (≙
+``skylark_svd.cpp:37-60``).
 """
 
 from __future__ import annotations
@@ -19,10 +25,35 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="skylark-svd", description="Randomized (approximate) SVD"
     )
-    p.add_argument("inputfile", nargs="?", help="LIBSVM or .npy matrix")
+    p.add_argument(
+        "inputfile", nargs="?",
+        help="LIBSVM / arc-list / HDF5 / .npy matrix",
+    )
     p.add_argument("--rank", "-k", type=int, default=6)
     p.add_argument("--seed", type=int, default=38734)
     p.add_argument("--sparse", action="store_true", help="load as BCOO")
+    p.add_argument(
+        "--filetype",
+        choices=("auto", "libsvm", "arclist", "hdf5", "npy"),
+        default="auto",
+        help="input format (auto: by extension, arc-lists need an "
+        "explicit 'arclist' like the reference's --filetype ARC_LIST)",
+    )
+    p.add_argument(
+        "--symmetric", action="store_true",
+        help="treat the matrix as symmetric (eigendecomposition; writes "
+        "S and V only, as the reference's execute_sym)",
+    )
+    p.add_argument(
+        "--lower", action="store_true",
+        help="with --symmetric: access the lower triangle (symmetrize "
+        "from the lower part; upper is the default)",
+    )
+    p.add_argument(
+        "--ascii", action="store_true",
+        help="write prefix.U/.S/.V as ASCII text (the reference's "
+        "El::Write convention) instead of .npy",
+    )
     p.add_argument(
         "--num-iterations", "-i", type=int, default=None,
         help="power-iteration sweeps (default 0; 1 with --stream, where "
@@ -104,12 +135,63 @@ def main(argv=None) -> int:
         )
         A += 0.01 * rng.standard_normal((m, n)).astype(A.dtype)
     elif args.inputfile:
-        if args.inputfile.endswith(".npy"):
+        ftype = args.filetype
+        if ftype == "auto":
+            if args.inputfile.endswith(".npy"):
+                ftype = "npy"
+            elif args.inputfile.endswith((".h5", ".hdf5")):
+                ftype = "hdf5"
+            else:
+                ftype = "libsvm"
+        if ftype == "npy":
             A = np.load(args.inputfile)
+        elif ftype == "hdf5":
+            from ..io import read_hdf5
+
+            A, _ = read_hdf5(args.inputfile, sparse=args.sparse)
+        elif ftype == "arclist":
+            # ≙ ReadArcList → adjacency SVD (spectral embedding input).
+            from ..graph import read_arc_list
+
+            G = read_arc_list(args.inputfile)
+            A = G.adjacency_bcoo() if args.sparse else G.adjacency()
         else:
             A, _ = read_libsvm(args.inputfile, sparse=args.sparse)
     else:
         p.error("need an inputfile or --profile M N")
+
+    def write(suffix, arr):
+        # --ascii ≙ El::Write(X, prefix + suffix, El::ASCII): plain text,
+        # one matrix row per line (skylark_svd.cpp:110-112).
+        if args.ascii:
+            np.savetxt(f"{args.prefix}{suffix}", np.atleast_1d(np.asarray(arr)))
+        else:
+            np.save(f"{args.prefix}{suffix}.npy", np.asarray(arr))
+
+    ctx = SketchContext(seed=args.seed)
+    if args.symmetric:
+        # Runs on the unsharded matrix (the eigendecomposition densifies
+        # and replicates anyway); --shard row-padding would break the
+        # squareness check for genuinely square inputs.
+        from ..linalg import approximate_symmetric_svd
+
+        Ad = jnp.asarray(A.todense() if hasattr(A, "todense") else A)
+        if Ad.shape[0] != Ad.shape[1]:
+            p.error("--symmetric needs a square matrix")
+        # Access one triangle only (≙ the uplo argument of
+        # ApproximateSymmetricSVD; reference defaults to upper).
+        tri = jnp.tril(Ad) if args.lower else jnp.triu(Ad)
+        Ad = tri + tri.T - jnp.diag(jnp.diagonal(Ad))
+        t0 = time.perf_counter()
+        V, lam = approximate_symmetric_svd(Ad, args.rank, ctx, params)
+        jax.block_until_ready((V, lam))
+        dt = time.perf_counter() - t0
+        write(".S", lam)
+        write(".V", V)
+        print(f"Rank-{args.rank} symmetric SVD of {Ad.shape[0]}"
+              f"x{Ad.shape[1]} in {dt:.3f}s")
+        print(f"Leading eigenvalues: {np.asarray(lam)[: min(5, len(lam))]}")
+        return 0
 
     n_orig = None
     if args.shard:
@@ -121,16 +203,15 @@ def main(argv=None) -> int:
 
             # Zero rows don't affect singular values/V; U is trimmed below.
             A, n_orig = shard_rows_padded(jnp.asarray(A), default_mesh())
-    ctx = SketchContext(seed=args.seed)
     t0 = time.perf_counter()
     U, s, V = approximate_svd(A, args.rank, ctx, params)
     jax.block_until_ready((U, s, V))
     dt = time.perf_counter() - t0
     if n_orig is not None:
         U = U[:n_orig]
-    np.save(f"{args.prefix}.U.npy", np.asarray(U))
-    np.save(f"{args.prefix}.S.npy", np.asarray(s))
-    np.save(f"{args.prefix}.V.npy", np.asarray(V))
+    write(".U", U)
+    write(".S", s)
+    write(".V", V)
     print(f"Rank-{args.rank} SVD of {U.shape[0]}x{V.shape[0]} in {dt:.3f}s")
     print(f"Leading singular values: {np.asarray(s)[: min(5, len(s))]}")
     return 0

@@ -12,6 +12,8 @@ Elemental matrix; its columnwise/rowwise sketch tags already abstract this).
 
 from __future__ import annotations
 
+import io
+
 import numpy as np
 
 __all__ = ["read_libsvm", "write_libsvm", "stream_libsvm"]
@@ -38,13 +40,16 @@ def read_libsvm(
     otherwise the pure-Python path below.
     """
     from .. import native
+    from .source import open_source
+
+    src = open_source(path)
 
     # max_rows must bound both the result AND the parsing work (the
     # reference's reader stops early), so it bypasses the slurp-everything
     # native fast path and breaks out of the line loop.
     parsed = None
     if native.available() and max_rows is None:
-        with open(path, "rb") as f:
+        with src.open() as f:
             data = f.read()
         try:
             parsed = native.parse_libsvm_bytes(data)
@@ -60,8 +65,8 @@ def read_libsvm(
         rows: list[int] = []
         cols: list[int] = []
         vals: list[float] = []
-        with open(path, "r") as f:
-            for line in f:
+        with src.open() as f:
+            for line in io.TextIOWrapper(f, encoding="utf-8"):
                 if max_rows is not None and len(labels) >= max_rows:
                     break
                 _parse_line(line, labels, rows, cols, vals)
@@ -139,12 +144,16 @@ def stream_libsvm(
     """Yield ``(X, y)`` batches of up to ``batch`` examples (dense ndarray,
     or BCOO when ``sparse``).
 
-    ≙ the reference's streaming line-by-line predict IO (``ml/io.hpp``):
-    bounded memory for test files larger than RAM.  Byte chunks go through
+    ≙ the reference's streaming line-by-line predict IO (``ml/io.hpp``)
+    and its HDFS readers (``utility/io/libsvm_io.hpp:1495-1638``):
+    bounded memory for files larger than RAM, from any byte source —
+    ``path`` may be a local path, a ``scheme://`` URL, raw bytes, or a
+    :class:`~libskylark_tpu.io.source.ByteSource`.  Byte chunks go through
     the native multithreaded parser when built; the pure-Python per-line
     parser is the fallback.
     """
     from .. import native
+    from .source import open_source
 
     def parse_chunk(block: bytes):
         """Parse a newline-aligned byte chunk → numpy arrays.  The native
@@ -179,7 +188,7 @@ def stream_libsvm(
     p_cols = np.empty(0, np.int64)
     p_vals = np.empty(0, np.float64)
 
-    with open(path, "rb") as f:
+    with open_source(path).open() as f:
         carry = b""
         eof = False
         while not eof:

@@ -29,7 +29,7 @@ from .krr import (
     large_scale_kernel_ridge,
     sketched_approximate_kernel_ridge,
 )
-from .model import FeatureMapModel, KernelModel
+from .model import FeatureMapModel, KernelModel, load_model
 from .rlsc import (
     approximate_kernel_rlsc,
     faster_kernel_rlsc,
@@ -71,4 +71,5 @@ __all__ = [
     "BlockADMMSolver",
     "FeatureMapModel",
     "KernelModel",
+    "load_model",
 ]

@@ -273,9 +273,9 @@ class BlockADMMSolver:
         p.log(2, timer.report())
         Wbar = state[0]
         model = FeatureMapModel(
-            self.maps, Wbar, scale_maps=p.scale_maps, input_dim=d
+            self.maps, Wbar, scale_maps=p.scale_maps, input_dim=d,
+            classes=classes,
         )
-        model.classes = classes
         model.history = history
         model.val_history = val_history
         model.timers = timer

@@ -7,6 +7,11 @@
   JSON + the coefficients).
 - ``KernelModel`` ≙ the kernel models that hold the training X
   (model.hpp:278-1255): predict via k(X_train, X_test)ᵀ·A.
+- ``load_model`` ≙ ``model_container_t`` (model.hpp:1138-1255): the
+  polymorphic loader that dispatches a saved model's JSON to the right
+  class; the persisted ``classes`` field plays the container's
+  ``get_column_coding`` role (classification models carry their label
+  decoding with them).
 """
 
 from __future__ import annotations
@@ -20,7 +25,7 @@ import numpy as np
 
 from ..sketch.base import Dimension, from_dict as sketch_from_dict
 
-__all__ = ["FeatureMapModel", "KernelModel"]
+__all__ = ["FeatureMapModel", "KernelModel", "load_model"]
 
 _SERIAL_VERSION = 2  # tracks sketch.base.SERIAL_VERSION (stream revision)
 
@@ -33,11 +38,17 @@ class FeatureMapModel:
     ``sqrt(sj/d)`` block scaling (``BlockADMM.hpp:425-426``).
     """
 
-    def __init__(self, maps: Sequence, W, scale_maps: bool = False, input_dim=None):
+    def __init__(self, maps: Sequence, W, scale_maps: bool = False,
+                 input_dim=None, classes=None):
         self.maps = list(maps)
         self.W = jnp.asarray(W)
         self.scale_maps = bool(scale_maps)
         self.input_dim = input_dim or (self.maps[0].n if self.maps else None)
+        # Label coding for classification models (≙ get_column_coding,
+        # model.hpp:1242-1244); None for regression.
+        self.classes = None if classes is None else list(
+            np.asarray(classes).tolist()
+        )
 
     def features(self, X):
         """Concatenated (n, D) feature matrix for X (n, d)."""
@@ -62,6 +73,7 @@ class FeatureMapModel:
     def predict_labels(self, X, classes=None):
         O = self.predict(X)
         idx = jnp.argmax(O, axis=-1)
+        classes = classes if classes is not None else self.classes
         if classes is not None:
             return jnp.asarray(classes)[idx]
         return idx
@@ -75,6 +87,9 @@ class FeatureMapModel:
             "model_type": "feature_map",
             "scale_maps": self.scale_maps,
             "input_dim": self.input_dim,
+            # normalize post-hoc numpy assignments to JSON scalars
+            "classes": (None if self.classes is None
+                        else np.asarray(self.classes).tolist()),
             "maps": [S.to_dict() for S in self.maps],
             "coef_shape": list(self.W.shape),
         }
@@ -96,7 +111,7 @@ class FeatureMapModel:
         W = np.load(cls._coef_path(path))
         maps = [sketch_from_dict(md) for md in d["maps"]]
         return cls(maps, jnp.asarray(W), scale_maps=d.get("scale_maps", False),
-                   input_dim=d.get("input_dim"))
+                   input_dim=d.get("input_dim"), classes=d.get("classes"))
 
     @staticmethod
     def _coef_path(path):
@@ -106,11 +121,15 @@ class FeatureMapModel:
 class KernelModel:
     """Kernel-space model: predict = k(X_test, X_train) @ A."""
 
-    def __init__(self, kernel, X_train, A):
+    def __init__(self, kernel, X_train, A, classes=None):
         self.kernel = kernel
         self.X_train = jnp.asarray(X_train)
         self.A = jnp.asarray(A)
+        self.input_dim = int(self.X_train.shape[1])
         self.info = None
+        self.classes = None if classes is None else list(
+            np.asarray(classes).tolist()
+        )
 
     def predict(self, X):
         K = self.kernel.gram(jnp.asarray(X), self.X_train)  # (m, n)
@@ -119,6 +138,7 @@ class KernelModel:
     def predict_labels(self, X, classes=None):
         O = self.predict(X)
         idx = jnp.argmax(O, axis=-1)
+        classes = classes if classes is not None else self.classes
         if classes is not None:
             return jnp.asarray(classes)[idx]
         return idx
@@ -130,6 +150,8 @@ class KernelModel:
             "skylark_object_type": "model",
             "skylark_version": _SERIAL_VERSION,
             "model_type": "kernel",
+            "classes": (None if self.classes is None
+                        else np.asarray(self.classes).tolist()),
             "kernel": self.kernel.to_dict(),
         }
         with open(path, "w") as f:
@@ -153,4 +175,28 @@ class KernelModel:
             kernel_from_dict(d["kernel"]),
             jnp.asarray(data["X_train"]),
             jnp.asarray(data["A"]),
+            classes=d.get("classes"),
         )
+
+
+_MODEL_TYPES = {
+    "feature_map": FeatureMapModel,
+    "kernel": KernelModel,
+}
+
+
+def load_model(path: str):
+    """Polymorphic model loader (≙ ``model_container_t``'s ptree dispatch,
+    ``ml/model.hpp:1155-1166, 1208-1220``): reads the JSON header's
+    ``model_type`` and loads through the right class.  The returned model
+    carries its own label coding (``.classes``) when it was trained for
+    classification."""
+    with open(path) as f:
+        d = json.load(f)
+    mtype = d.get("model_type")
+    if mtype not in _MODEL_TYPES:
+        raise ValueError(
+            f"unknown model_type {mtype!r} (expected one of "
+            f"{sorted(_MODEL_TYPES)})"
+        )
+    return _MODEL_TYPES[mtype].load(path)

@@ -82,11 +82,19 @@ def wht(x, axis: int = 0):
     lead_l = letters[:nlead]
     fac_l = letters[nlead : nlead + nfac]
     trail_l = letters[nlead + nfac : nlead + nfac + ntrail]
+    # f32/f64 inputs pin full matmul precision: the TPU MXU's default
+    # drops f32 operands to bf16 mantissas, which silently degraded the
+    # transform to ~1e-2 absolute error on hardware (caught by the
+    # compiled-kernel parity test, tests/test_pallas_hw.py).  H is ±1, so
+    # only the input mantissa width matters.
+    prec = None if x.dtype == jnp.bfloat16 else "highest"
     for i, c in enumerate(chunks):
         H = jnp.asarray(_hadamard(c), x.dtype)
         in_sub = lead_l + fac_l + trail_l
         out_sub = in_sub.replace(fac_l[i], "z")
-        x = jnp.einsum(f"{in_sub},z{fac_l[i]}->{out_sub}", x, H)
+        x = jnp.einsum(
+            f"{in_sub},z{fac_l[i]}->{out_sub}", x, H, precision=prec
+        )
     x = x.reshape(*lead, n, *trail)
     return x * jnp.asarray(1.0 / np.sqrt(n), x.dtype)
 

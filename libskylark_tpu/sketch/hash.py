@@ -147,6 +147,15 @@ class HashSketch(SketchTransform):
             )
         return M
 
+    def _sign_scale(self):
+        """Scalar c such that the hash matrix is ``c · M_int`` with
+        small-integer entries (collision counts with signs) — exact in
+        bf16 — or None when the values aren't sign-structured.  Lets the
+        one-hot matmul ride the bf16 MXU at full precision."""
+        if self.value_dist != "rademacher":
+            return None
+        return 1.0
+
     def _apply_dense(self, A, dim: Dimension):
         dtype = A.dtype if jnp.issubdtype(A.dtype, jnp.floating) else jnp.float32
         if dim is Dimension.COLUMNWISE:
@@ -162,6 +171,9 @@ class HashSketch(SketchTransform):
         # over enough batch vectors; thin inputs keep the O(N·nnz) scatter.
         batch = A.shape[1] if dim is Dimension.COLUMNWISE else A.shape[0]
         if self.n * self.s <= self._ONEHOT_LIMIT and batch >= 16:
+            c = self._sign_scale()
+            if c is not None and dtype in (jnp.bfloat16, jnp.float32):
+                return self._apply_onehot_bf16(A, dim, dtype, c)
             M = self._hash_matrix(dtype)
             if dim is Dimension.COLUMNWISE:
                 return M.T @ A.astype(dtype)
@@ -178,6 +190,55 @@ class HashSketch(SketchTransform):
         return jax.ops.segment_sum(
             stacked.T, b.reshape(-1), num_segments=self.s
         ).T
+
+    def _apply_onehot_bf16(self, A, dim: Dimension, dtype, c):
+        """Sign-valued hash sketches on the bf16 MXU at full precision:
+        the hash matrix is c·M_int with small-integer entries (exact in
+        bf16); bf16 inputs take one matmul, f32 inputs a 3-pass
+        ``hi + lo + lo2`` bf16 split (each pass an exact sign-gather
+        accumulated in f32), ~3x the f32 matmul rate on v5e.  Same trick
+        as FJLT's subsampled-Hadamard gemm (``fjlt.py``)."""
+        # Build the integer sign matrix directly in bf16 (entries are
+        # signed collision counts — exact): one (N, S) bf16 pass instead
+        # of an f32 build + rescale + round + cast chain (halves the
+        # build's HBM traffic at CWT's 128K x 1024 bench shape).
+        b = self.buckets().reshape(self.nnz, self.n)
+        v = self.values(jnp.float32).reshape(self.nnz, self.n)
+        iota = jnp.arange(self.s, dtype=b.dtype)
+        Mi = jnp.zeros((self.n, self.s), jnp.bfloat16)
+        for h in range(self.nnz):
+            vi = jnp.round(v[h] * jnp.float32(1.0 / c)).astype(jnp.bfloat16)
+            Mi = Mi + jnp.where(
+                b[h][:, None] == iota[None, :],
+                vi[:, None],
+                jnp.zeros((), jnp.bfloat16),
+            )
+        contract = (
+            (((0,), (0,)), ((), ()))
+            if dim is Dimension.COLUMNWISE
+            else (((1,), (0,)), ((), ()))
+        )
+
+        def mm(x):
+            # Contracts A's n axis against Mi's rows in either
+            # orientation; columnwise yields (batch, S) → transposed.
+            return jax.lax.dot_general(
+                x, Mi, contract, preferred_element_type=jnp.float32
+            )
+
+        if dim is Dimension.COLUMNWISE:
+            run = lambda x: mm(x).T  # (S, batch) = Miᵀ @ A
+        else:
+            run = mm
+        if dtype == jnp.bfloat16:
+            out = run(A)
+        else:
+            hi = A.astype(jnp.bfloat16)
+            r1 = A - hi.astype(jnp.float32)
+            lo = r1.astype(jnp.bfloat16)
+            lo2 = (r1 - lo.astype(jnp.float32)).astype(jnp.bfloat16)
+            out = run(hi) + run(lo) + run(lo2)
+        return (out * jnp.float32(c)).astype(dtype)
 
     def _apply_sparse(self, A: jsparse.BCOO, dim: Dimension):
         """BCOO → BCOO: relabel hashed indices per hash function, scale
@@ -234,6 +295,9 @@ class SJLT(HashSketch):
     def values(self, dtype=jnp.float32, start: int = 0, num: int | None = None):
         v = super().values(dtype, start, num)
         return v / jnp.sqrt(jnp.asarray(float(self.nnz), dtype))
+
+    def _sign_scale(self):
+        return 1.0 / float(np.sqrt(self.nnz))
 
     def _param_dict(self):
         return {"nnz": self.nnz}

@@ -99,7 +99,7 @@ def cond_est(
     A,
     context: SketchContext,
     params: CondEstParams | None = None,
-    # Round-1 keywords kept for compatibility; map onto powerits/iter_lim.
+    *,  # keyword-only: the round-1 shim must not bind positionally
     power_its: int | None = None,
     lanczos_steps: int | None = None,
 ):

@@ -172,14 +172,43 @@ class TestFJLTSrhtGemm:
 
     def test_gate(self, monkeypatch):
         ctx = SketchContext(seed=1)
-        # the four measured configs from BASELINE.md (n=4096):
-        assert FJLT(4096, 256, ctx)._gemm_wins(jnp.float32)       # 30 < 38 ms
-        assert not FJLT(4096, 1024, ctx)._gemm_wins(jnp.float32)  # 55 > 45 ms
-        assert FJLT(4096, 1024, ctx)._gemm_wins(jnp.bfloat16)     # 16 < 26 ms
+        # measured configs from BASELINE.md (n=4096):
+        assert FJLT(4096, 256, ctx)._gemm_wins(jnp.float32)
+        # f32 s=1024 now WINS via the 3-pass bf16 split (round-2 fix of
+        # the documented large-S f32 gather bottleneck)
+        assert FJLT(4096, 1024, ctx)._gemm_wins(jnp.float32)
+        assert FJLT(4096, 1024, ctx)._gemm_wins(jnp.bfloat16)
         # huge S: matmul flops dominate → streamed path
         assert not FJLT(4096, 4096, ctx)._gemm_wins(jnp.float32)
+        # f64 keeps the exact-matmul gate (CPU parity runs): tighter
+        # crossover than the f32 split (fpb 80 vs 500/3 per pass)
+        assert not FJLT(4096, 2048, ctx)._gemm_wins(jnp.float64)
+        # element cap (ADVICE r1): a huge realized G must not transiently
+        # blow HBM even when the flops gate would fire (large-n small-S
+        # columnwise case)
+        assert not FJLT(1 << 20, 128, ctx)._gemm_wins(jnp.bfloat16)
         monkeypatch.setenv("SKYLARK_NO_SRHT_GEMM", "1")
         assert not FJLT(4096, 128, ctx)._gemm_wins(jnp.float32)
+
+    def test_f32_split_accuracy(self, rng, monkeypatch):
+        """The 3-pass bf16 split reproduces the f32 WHT+gather transform
+        to f32-accumulation accuracy (the split itself is exact to ~24
+        mantissa bits; only summation order differs)."""
+        import jax
+
+        n, s = 512, 128
+        A32 = jnp.asarray(rng.standard_normal((16, n)), jnp.float32)
+        S = FJLT(n, s, SketchContext(seed=71))
+        monkeypatch.setenv("SKYLARK_NO_SRHT_GEMM", "1")
+        ref = S.apply(A32, "rowwise")
+        monkeypatch.delenv("SKYLARK_NO_SRHT_GEMM")
+        out = S._apply_srht_gemm(A32, rowwise=True)
+        assert out.dtype == jnp.float32
+        scale = float(jnp.linalg.norm(A32) / np.sqrt(s))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5,
+            atol=2e-5 * scale,
+        )
 
 
 def _kernel_mse(Z, K):

@@ -1,6 +1,7 @@
 """LIBSVM IO round-trip tests (≙ reference ``tests/unit/io_test.py``)."""
 
 import numpy as np
+import pytest
 
 from libskylark_tpu.io import read_libsvm, write_libsvm
 
@@ -53,3 +54,74 @@ def test_max_rows(tmp_path):
     # inferred width comes from the KEPT rows only
     X4, _ = read_libsvm(tmp_path / "f", max_rows=2)
     assert X4.shape == (2, 2)
+
+
+# -- byte-source seam (≙ the HDFS reader role, libsvm_io.hpp:1495-1638) ----
+
+
+def test_memory_source_read(rng):
+    from libskylark_tpu.io import MemorySource, read_libsvm
+
+    data = b"1 1:2.0 2:3.0\n-1 2:1.5\n"
+    X, y = read_libsvm(MemorySource(data), n_features=2)
+    np.testing.assert_allclose(X, [[2.0, 3.0], [0.0, 1.5]])
+    np.testing.assert_allclose(y, [1, -1])
+    # raw bytes coerce too
+    X2, _ = read_libsvm(data, n_features=2)
+    np.testing.assert_allclose(X2, X)
+
+
+def test_stream_from_source(rng):
+    from libskylark_tpu.io import MemorySource, stream_libsvm
+
+    lines = [f"{i % 2} 1:{i}.0" for i in range(10)]
+    src = MemorySource(("\n".join(lines) + "\n").encode())
+    batches = list(stream_libsvm(src, n_features=1, batch=4))
+    assert [len(b[1]) for b in batches] == [4, 4, 2]
+    got = np.concatenate([np.asarray(b[0])[:, 0] for b in batches])
+    np.testing.assert_allclose(got, np.arange(10.0))
+
+
+def test_file_url_and_scheme_registry(tmp_path):
+    from libskylark_tpu.io import (
+        MemorySource,
+        open_source,
+        read_libsvm,
+        register_scheme,
+    )
+
+    (tmp_path / "f").write_text("1 1:4.0\n")
+    X, _ = read_libsvm(f"file://{tmp_path}/f")
+    np.testing.assert_allclose(X, [[4.0]])
+
+    register_scheme("testmem", lambda url: MemorySource(b"1 1:7.0\n"))
+    X2, _ = read_libsvm("testmem://whatever")
+    np.testing.assert_allclose(X2, [[7.0]])
+    assert open_source("testmem://x").size() == len(b"1 1:7.0\n")
+
+
+def test_fsspec_backend_roundtrip():
+    """The generic-scheme path goes through fsspec when present (this
+    environment bundles it): memory:// is fsspec's built-in store, so this
+    exercises the exact code path an hdfs://-style URL takes."""
+    pytest.importorskip("fsspec")
+    import fsspec
+
+    from libskylark_tpu.io import read_libsvm, stream_libsvm
+
+    with fsspec.open("memory://sky/t.libsvm", "wb") as f:
+        f.write(b"1 1:2.0\n0 1:3.0\n")
+    X, y = read_libsvm("memory://sky/t.libsvm")
+    np.testing.assert_allclose(X, [[2.0], [3.0]])
+    np.testing.assert_allclose(y, [1, 0])
+    batches = list(stream_libsvm("memory://sky/t.libsvm", n_features=1))
+    assert len(batches) == 1 and len(batches[0][1]) == 2
+
+
+def test_unknown_remote_scheme_raises():
+    from libskylark_tpu.io import open_source
+
+    # Without fsspec the ImportError fires at construction; with it, the
+    # unknown protocol errors at open() — both inside the raises block.
+    with pytest.raises(Exception, match="no-such-proto-xyz|fsspec"):
+        open_source("no-such-proto-xyz://bucket/key").open()

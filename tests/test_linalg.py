@@ -268,3 +268,77 @@ class TestCLI:
         )
         assert rc == 0
         assert np.load(tmp_path / "o.S.npy").shape == (3,)
+
+    def test_svd_cli_hdf5(self, tmp_path, rng):
+        """HDF5 input parity (≙ skylark_svd's HDF5 role, VERDICT item 6)."""
+        from libskylark_tpu.cli.svd import main
+        from libskylark_tpu.io import write_hdf5
+
+        X = rng.standard_normal((40, 12))
+        write_hdf5(tmp_path / "d.h5", X, np.ones(40))
+        rc = main(
+            [str(tmp_path / "d.h5"), "--rank", "3",
+             "--prefix", str(tmp_path / "h")]
+        )
+        assert rc == 0
+        s = np.load(tmp_path / "h.S.npy")
+        s_ref = np.linalg.svd(X, compute_uv=False)[:3]
+        np.testing.assert_allclose(s, s_ref, rtol=0.5)
+
+    def test_svd_cli_arclist(self, tmp_path, rng):
+        """Arc-list input ≙ ReadArcList (skylark_svd.cpp:169-171): SVD of
+        the graph adjacency."""
+        from libskylark_tpu.cli.svd import main
+
+        lines = ["# comment"]
+        edges = {(int(a), int(b)) for a, b in rng.integers(0, 20, (60, 2))
+                 if a != b}
+        lines += [f"{a} {b}" for a, b in sorted(edges)]
+        (tmp_path / "g.txt").write_text("\n".join(lines) + "\n")
+        rc = main(
+            [str(tmp_path / "g.txt"), "--filetype", "arclist", "--rank", "3",
+             "--prefix", str(tmp_path / "g")]
+        )
+        assert rc == 0
+        U = np.load(tmp_path / "g.U.npy")
+        assert U.shape[1] == 3 and np.isfinite(U).all()
+
+    def test_svd_cli_ascii_output(self, tmp_path, rng):
+        """--ascii writes the reference's El::Write convention:
+        prefix.U/.S/.V plain-text (skylark_svd.cpp:110-112)."""
+        from libskylark_tpu.cli.svd import main
+
+        X = rng.standard_normal((25, 8))
+        np.save(tmp_path / "a.npy", X)
+        rc = main(
+            [str(tmp_path / "a.npy"), "--rank", "2", "--ascii",
+             "--prefix", str(tmp_path / "a"), "--x64"]
+        )
+        assert rc == 0
+        U = np.loadtxt(tmp_path / "a.U")
+        s = np.loadtxt(tmp_path / "a.S")
+        V = np.loadtxt(tmp_path / "a.V")
+        assert U.shape == (25, 2) and s.shape == (2,) and V.shape == (8, 2)
+        rec = U @ np.diag(s) @ V.T
+        # Rank-2 truncation of a random matrix: just check the pieces
+        # compose finitely and s is descending.
+        assert np.isfinite(rec).all() and s[0] >= s[1]
+
+    def test_svd_cli_symmetric(self, tmp_path, rng):
+        """--symmetric ≙ execute_sym: eigendecomposition, writes S/V only."""
+        from libskylark_tpu.cli.svd import main
+
+        B = rng.standard_normal((15, 6))
+        A = B @ B.T  # PSD, rank 6
+        np.save(tmp_path / "s.npy", A)
+        rc = main(
+            [str(tmp_path / "s.npy"), "--rank", "4", "--symmetric",
+             "--prefix", str(tmp_path / "s"), "--x64"]
+        )
+        assert rc == 0
+        lam = np.load(tmp_path / "s.S.npy")
+        V = np.load(tmp_path / "s.V.npy")
+        assert not (tmp_path / "s.U.npy").exists()
+        lam_ref = np.linalg.eigvalsh(A)[::-1][:4]
+        np.testing.assert_allclose(np.sort(lam)[::-1], lam_ref, rtol=0.2)
+        assert V.shape == (15, 4)

@@ -314,3 +314,64 @@ class TestModelPersistence:
         np.testing.assert_allclose(
             np.asarray(m.predict(X)), np.asarray(m2.predict(X)), rtol=1e-8
         )
+
+
+class TestModelContainer:
+    """≙ model_container_t (model.hpp:1138-1255): polymorphic load +
+    embedded label coding."""
+
+    def test_load_model_dispatch_feature_map(self, tmp_path, rng):
+        from libskylark_tpu.core.context import SketchContext
+        from libskylark_tpu.ml import FeatureMapModel, GaussianKernel, load_model
+
+        ctx = SketchContext(seed=11)
+        maps = [GaussianKernel(4, 1.0).create_rft(8, "regular", ctx)]
+        m = FeatureMapModel(maps, rng.standard_normal((8, 3)), input_dim=4,
+                            classes=[3, 7, 9])
+        m.save(tmp_path / "fm.json")
+        m2 = load_model(tmp_path / "fm.json")
+        assert isinstance(m2, FeatureMapModel)
+        assert m2.classes == [3, 7, 9]
+        X = rng.standard_normal((6, 4))
+        # predict_labels decodes through the embedded coding by default
+        lbl = np.asarray(m2.predict_labels(X))
+        assert set(lbl.tolist()) <= {3, 7, 9}
+        np.testing.assert_allclose(
+            np.asarray(m2.predict(X)), np.asarray(m.predict(X)),
+            rtol=1e-6, atol=1e-7,
+        )
+
+    def test_load_model_dispatch_kernel(self, tmp_path, rng):
+        from libskylark_tpu.ml import GaussianKernel, KernelModel, load_model
+
+        Xtr = rng.standard_normal((10, 3))
+        m = KernelModel(GaussianKernel(3, 1.5), Xtr,
+                        rng.standard_normal((10, 2)), classes=[0, 1])
+        m.save(tmp_path / "km.json")
+        m2 = load_model(tmp_path / "km.json")
+        assert isinstance(m2, KernelModel)
+        assert m2.classes == [0, 1]
+        X = rng.standard_normal((4, 3))
+        np.testing.assert_allclose(
+            np.asarray(m2.predict(X)), np.asarray(m.predict(X)),
+            rtol=1e-6, atol=1e-7,
+        )
+
+    def test_posthoc_numpy_classes_serialize(self, tmp_path, rng):
+        from libskylark_tpu.ml import FeatureMapModel, load_model
+
+        m = FeatureMapModel([], rng.standard_normal((5, 2)), input_dim=5)
+        m.classes = np.asarray([1.0, 2.0])  # legacy post-hoc assignment
+        m.save(tmp_path / "p.json")
+        assert load_model(tmp_path / "p.json").classes == [1.0, 2.0]
+
+    def test_unknown_model_type_raises(self, tmp_path):
+        import json
+
+        import pytest
+
+        from libskylark_tpu.ml import load_model
+
+        (tmp_path / "x.json").write_text(json.dumps({"model_type": "mystery"}))
+        with pytest.raises(ValueError, match="mystery"):
+            load_model(tmp_path / "x.json")

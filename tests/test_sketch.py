@@ -289,3 +289,41 @@ class TestHashScatterFallback:
             np.testing.assert_allclose(
                 np.asarray(out_r), np.asarray(ref_r), rtol=1e-10, atol=1e-12
             )
+
+
+class TestHashBf16Split:
+    """Sign-valued hash sketches ride the bf16 MXU (hash matrix =
+    c * small-integer matrix, exact in bf16); the f32 3-pass split must
+    reproduce the exact-f32 one-hot result to f32-accumulation accuracy."""
+
+    def test_f32_split_matches_exact(self, rng):
+        import jax.numpy as jnp
+        from libskylark_tpu import SketchContext
+        from libskylark_tpu.sketch import CWT, SJLT
+
+        A32 = jnp.asarray(rng.standard_normal((64, 40)), jnp.float32)
+        for cls, kw in ((CWT, {}), (SJLT, {"nnz": 2}),):
+            S = cls(64, 16, SketchContext(seed=5), **kw)
+            out = S.apply(A32, "columnwise")
+            assert out.dtype == jnp.float32
+            M = np.asarray(S._hash_matrix(jnp.float64))
+            ref = M.T @ np.asarray(A32, np.float64)
+            scale = np.abs(ref).max() + 1e-30
+            np.testing.assert_allclose(
+                np.asarray(out, np.float64), ref,
+                rtol=5e-6, atol=5e-6 * scale,
+            )
+
+    def test_nonsign_values_keep_full_precision_path(self, rng):
+        import jax.numpy as jnp
+        from libskylark_tpu import SketchContext
+        from libskylark_tpu.sketch import MMT
+
+        S = MMT(30, 8, SketchContext(seed=6))
+        assert S._sign_scale() is None
+        A32 = jnp.asarray(rng.standard_normal((30, 20)), jnp.float32)
+        out = S.apply(A32, "columnwise")  # exact f32 one-hot matmul
+        M = np.asarray(S._hash_matrix(jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(out), M.T @ np.asarray(A32), rtol=2e-5, atol=1e-5
+        )
