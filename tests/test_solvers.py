@@ -296,3 +296,53 @@ class TestProx:
         np.testing.assert_allclose(
             np.asarray(get_regularizer("none").prox(V, 2.0)), np.asarray(V)
         )
+
+
+class TestAsyFcgSchedules:
+    def test_per_iteration_schedules_differ(self, rng):
+        """≙ AsyFCG's fresh randomized sweep per outer iteration
+        (AsyFCG.hpp:8): the counter window shifts with the iteration
+        index, so two iterations draw different GS schedules."""
+        import jax.numpy as jnp
+
+        from libskylark_tpu.core.random import sample
+        from libskylark_tpu.solvers.gauss_seidel import gs_num_blocks
+
+        n, bs, sweeps = 64, 16, 2
+        nblocks = gs_num_blocks(n, bs)
+        per_iter = sweeps * nblocks
+        ctx = SketchContext(seed=77)
+        base = ctx.reserve(10 * per_iter)
+        u0 = sample("uniform", 77, base, per_iter, offset=jnp.uint32(0))
+        u1 = sample(
+            "uniform", 77, base, per_iter, offset=jnp.uint32(per_iter)
+        )
+        assert not np.array_equal(np.asarray(u0), np.asarray(u1))
+
+    def test_converges_and_deterministic(self, rng):
+        from libskylark_tpu.solvers.asynch import asy_fcg
+
+        M = rng.standard_normal((60, 60))
+        A = jnp.asarray(M @ M.T + 60 * np.eye(60))
+        b = A @ jnp.asarray(rng.standard_normal(60))
+        x1, info1 = asy_fcg(A, b, SketchContext(seed=13), block_size=16)
+        x2, info2 = asy_fcg(A, b, SketchContext(seed=13), block_size=16)
+        np.testing.assert_array_equal(np.asarray(x1), np.asarray(x2))
+        assert float(jnp.linalg.norm(A @ x1 - b)) < 1e-3 * float(
+            jnp.linalg.norm(b)
+        )
+
+
+class TestCondEstSparse:
+    def test_bcoo_stays_sparse(self, rng):
+        """cond_est takes BCOO without densifying (matvec-only, as the
+        reference's template works on any multipliable type)."""
+        from jax.experimental import sparse as jsparse
+
+        D = rng.standard_normal((150, 20)) * (rng.random((150, 20)) < 0.1)
+        Asp = jsparse.BCOO.fromdense(jnp.asarray(D))
+        r = cond_est(Asp, SketchContext(seed=15))
+        dense = np.asarray(Asp.todense())
+        sv = np.linalg.svd(dense, compute_uv=False)
+        sv = sv[sv > 1e-10]
+        assert abs(float(r.sigma_max) - sv[0]) / sv[0] < 0.05
