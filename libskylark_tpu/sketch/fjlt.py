@@ -175,11 +175,13 @@ class FJLT(SketchTransform):
         if dtype == jnp.bfloat16:
             out = mm(A2, self._srht_matrix(dtype))
         elif dtype == jnp.float32:
+            from ..core.precision import bf16_split3
+
             G16 = self._srht_matrix(jnp.bfloat16)  # ±1: exact in bf16
-            hi = A2.astype(jnp.bfloat16)
-            r1 = A2 - hi.astype(acc)
-            lo = r1.astype(jnp.bfloat16)
-            lo2 = (r1 - lo.astype(acc)).astype(jnp.bfloat16)
+            # Bit-mask split (NOT astype round-trips — XLA's excess-
+            # precision rules elide f32→bf16→f32 convert pairs, which
+            # zeroed lo/lo2 on hardware; see core/precision.py).
+            hi, lo, lo2 = bf16_split3(A2)
             out = mm(hi, G16) + mm(lo, G16) + mm(lo2, G16)
         else:  # f64 (CPU parity): exact full-precision matmul
             out = jax.lax.dot_general(

@@ -233,10 +233,13 @@ class HashSketch(SketchTransform):
         if dtype == jnp.bfloat16:
             out = run(A)
         else:
-            hi = A.astype(jnp.bfloat16)
-            r1 = A - hi.astype(jnp.float32)
-            lo = r1.astype(jnp.bfloat16)
-            lo2 = (r1 - lo.astype(jnp.float32)).astype(jnp.bfloat16)
+            from ..core.precision import bf16_split3
+
+            # Bit-mask split — astype round-trips get elided by XLA's
+            # excess-precision rules on TPU (see core/precision.py).
+            # Integer inputs (dtype mapped to f32 by _apply_dense) must
+            # be value-converted BEFORE the bitcast-based split.
+            hi, lo, lo2 = bf16_split3(A.astype(jnp.float32))
             out = run(hi) + run(lo) + run(lo2)
         return (out * jnp.float32(c)).astype(dtype)
 

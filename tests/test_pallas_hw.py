@@ -66,6 +66,44 @@ print("RFUT-COMPILED-OK")
     assert "RFUT-COMPILED-OK" in out
 
 
+def test_bf16_split_accuracy_on_tpu():
+    """The f32 hi/lo/lo2 bf16-split paths must keep ~f32 accuracy on
+    hardware.  An astype-based split (``x - bf16(x)``) collapses to
+    single-bf16 accuracy on TPU because XLA's excess-precision rules
+    elide the f32→bf16→f32 convert pair, zeroing lo/lo2 (measured
+    1.6e-3 max-rel vs 8e-8 for the bit-mask split in core/precision.py).
+    CPU CI cannot see this — the elision fires in the TPU pipeline."""
+    out = _run_on_default_backend(
+        _PRELUDE
+        + """
+from libskylark_tpu.core.context import SketchContext
+from libskylark_tpu.sketch.fjlt import FJLT
+from libskylark_tpu.sketch.hash import CWT
+rng = np.random.default_rng(0)
+n, s, m = 1024, 256, 512
+A = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+S = FJLT(n, s, SketchContext(seed=3))
+assert S._gemm_wins(jnp.float32)
+out = np.asarray(jax.jit(lambda A: S._apply_srht_gemm(A, rowwise=True))(A),
+                 np.float64)
+G = np.asarray(S._srht_matrix(jnp.float32), np.float64)
+ref = (np.asarray(A, np.float64) @ G) / np.sqrt(s)
+rel = np.abs(out - ref).max() / np.abs(ref).max()
+assert rel < 2e-5, f"FJLT split degraded on hardware: {rel}"
+Sc = CWT(m, 64, SketchContext(seed=5))
+outc = np.asarray(jax.jit(lambda A: Sc.apply(A, "columnwise"))(A), np.float64)
+M = np.asarray(Sc._hash_matrix(jnp.float32), np.float64)
+refc = M.T @ np.asarray(A, np.float64)
+relc = np.abs(outc - refc).max() / np.abs(refc).max()
+assert relc < 2e-5, f"CWT split degraded on hardware: {relc}"
+print("SPLIT-ACCURACY-OK")
+"""
+    )
+    if "SKIP-NOT-TPU" in out:
+        pytest.skip(f"default backend is not TPU: {out.strip()}")
+    assert "SPLIT-ACCURACY-OK" in out
+
+
 def test_fjlt_pallas_branch_compiled_on_tpu():
     out = _run_on_default_backend(
         _PRELUDE

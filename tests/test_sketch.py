@@ -327,3 +327,19 @@ class TestHashBf16Split:
         np.testing.assert_allclose(
             np.asarray(out), M.T @ np.asarray(A32), rtol=2e-5, atol=1e-5
         )
+
+    def test_integer_input_onehot_path(self, rng):
+        """Int inputs are value-converted before the bitcast split (a raw
+        bitcast would turn negative ints into NaNs — review regression)."""
+        import jax.numpy as jnp
+        from libskylark_tpu import SketchContext
+        from libskylark_tpu.sketch import CWT
+
+        A = jnp.asarray(rng.integers(-50, 50, (64, 20)), jnp.int32)
+        S = CWT(64, 16, SketchContext(seed=8))
+        out = np.asarray(S.apply(A, "columnwise"))
+        assert np.isfinite(out).all()
+        M = np.asarray(S._hash_matrix(jnp.float64))
+        np.testing.assert_allclose(
+            out, M.T @ np.asarray(A, np.float64), rtol=1e-5, atol=1e-4
+        )
