@@ -86,7 +86,10 @@ def wht(x, axis: int = 0):
     # drops f32 operands to bf16 mantissas, which silently degraded the
     # transform to ~1e-2 absolute error on hardware (caught by the
     # compiled-kernel parity test, tests/test_pallas_hw.py).  H is ±1, so
-    # only the input mantissa width matters.
+    # only the input mantissa width matters.  (A bf16_split3 chain was
+    # measured SLOWER than precision="highest" here — the factor einsums
+    # are layout-bound, not MXU-bound — so the simple pin stays; the
+    # split pays only in the single big-GEMM paths, fjlt.py/hash.py.)
     prec = None if x.dtype == jnp.bfloat16 else "highest"
     for i, c in enumerate(chunks):
         H = jnp.asarray(_hadamard(c), x.dtype)

@@ -104,6 +104,31 @@ print("SPLIT-ACCURACY-OK")
     assert "SPLIT-ACCURACY-OK" in out
 
 
+def test_wht_f32_accuracy_on_tpu():
+    """The f32 WHT (bf16-split chain on TPU) must match a host f64
+    reference to ~f32 accuracy — guards both the MXU default-precision
+    hazard and any future regression of the split."""
+    out = _run_on_default_backend(
+        _PRELUDE
+        + """
+from libskylark_tpu.sketch.fut import wht, _hadamard
+rng = np.random.default_rng(2)
+m, n = 256, 4096
+x = rng.standard_normal((m, n)).astype(np.float32)
+got = np.asarray(jax.jit(lambda x: wht(x, axis=1))(jnp.asarray(x)),
+                 np.float64)
+H = np.asarray(_hadamard(12), np.float64)
+ref = (x.astype(np.float64) @ H.T) / np.sqrt(n)
+rel = np.abs(got - ref).max() / np.abs(ref).max()
+assert rel < 2e-5, f"wht f32 degraded on hardware: {rel}"
+print("WHT-F32-OK")
+"""
+    )
+    if "SKIP-NOT-TPU" in out:
+        pytest.skip(f"default backend is not TPU: {out.strip()}")
+    assert "WHT-F32-OK" in out
+
+
 def test_fjlt_pallas_branch_compiled_on_tpu():
     out = _run_on_default_backend(
         _PRELUDE
