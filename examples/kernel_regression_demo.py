@@ -7,9 +7,11 @@ pipeline without the CLI.
 Run: python examples/kernel_regression_demo.py
 """
 
+import os
 import sys
 
-sys.path.insert(0, ".")
+# runnable from anywhere: repo root is one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax.numpy as jnp
 import numpy as np

@@ -8,9 +8,11 @@ example prints.
 Run: python examples/least_squares_demo.py [m] [n]
 """
 
+import os
 import sys
 
-sys.path.insert(0, ".")
+# runnable from anywhere: repo root is one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax.numpy as jnp
 import numpy as np

@@ -5,9 +5,11 @@ classification problem.
 Run: python examples/nonlinear_models_demo.py
 """
 
+import os
 import sys
 
-sys.path.insert(0, ".")
+# runnable from anywhere: repo root is one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 

@@ -7,9 +7,11 @@ reference's distribution-combination sweep collapsed to sharding specs.
 Run: python examples/sketch_demo.py [m] [n] [s]
 """
 
+import os
 import sys
 
-sys.path.insert(0, ".")
+# runnable from anywhere: repo root is one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax.numpy as jnp
 import numpy as np

@@ -9,9 +9,11 @@ scale m up to the 1e7-row regime with the same code).
 Run: python examples/streaming_svd_demo.py [m] [n] [rank]
 """
 
+import os
 import sys
 
-sys.path.insert(0, ".")
+# runnable from anywhere: repo root is one level up
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
