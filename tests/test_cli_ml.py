@@ -176,3 +176,26 @@ class TestStreamLibsvmSparse:
         for (Xd, yd), (Xs, ys) in zip(dense, sparse):
             np.testing.assert_allclose(np.asarray(Xs.todense()), Xd, rtol=1e-15)
             np.testing.assert_allclose(ys, yd)
+
+
+class TestModelRoundTripAcrossCLIs:
+    def test_krr_kernel_model_reloads_with_classes(self, blob_files):
+        """A kernel-space model saved by skylark-krr (-a 0) reloads via
+        the polymorphic load_model with its label coding intact
+        (≙ model_container_t dispatch, model.hpp:1138-1255)."""
+        from libskylark_tpu.cli.krr import main
+        from libskylark_tpu.io import read_libsvm
+        from libskylark_tpu.ml import KernelModel, load_model
+
+        rc = main([
+            "--trainfile", str(blob_files / "train"),
+            "--modelfile", str(blob_files / "km.json"),
+            "-a", "0", "--sigma", "2.0",
+        ])
+        assert rc == 0
+        m = load_model(blob_files / "km.json")
+        assert isinstance(m, KernelModel)
+        assert m.classes is not None and len(m.classes) >= 2
+        Xt, yt = read_libsvm(blob_files / "test")
+        pred = np.asarray(m.predict_labels(Xt))
+        assert (pred == yt).mean() > 0.85
