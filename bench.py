@@ -51,7 +51,7 @@ def _timed(fn, *args) -> float:
     return time.perf_counter() - t0
 
 
-def _rep_diff(build, A, r1=4, r2=16, rounds=15) -> float:
+def _rep_diff(build, A, r1=4, r2=16, rounds=25) -> float:
     """Seconds per single apply, by differencing two rep counts.
 
     ``build(k)`` must return a jitted callable running k independent
@@ -140,7 +140,7 @@ def bench_fjlt(on_tpu, dtype, baseline_ms, table):
         return jax.jit(run)
 
     A = jax.random.normal(jax.random.PRNGKey(1), (m, n), dtype=dtype)
-    per = _rep_diff(build, A, r1=2, r2=8)
+    per = _rep_diff(build, A, r1=2, r2=8, rounds=20)
     name = "bf16" if dtype == jnp.bfloat16 else "f32"
     _emit(
         f"FJLT {m}x{n}->{s} {name} apply",
@@ -172,7 +172,7 @@ def bench_cwt(on_tpu, table):
         return jax.jit(run)
 
     A = jax.random.normal(jax.random.PRNGKey(2), (m, n), jnp.float32)
-    per = _rep_diff(build, A, r1=2, r2=10)
+    per = _rep_diff(build, A, r1=2, r2=10, rounds=20)
     _emit(
         f"CWT {m}x{n}->{s} dense columnwise apply",
         per * 1e3,
