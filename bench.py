@@ -59,10 +59,16 @@ def _rep_diff(build, A, r1=4, r2=16, rounds=25) -> float:
     """
     f1, f2 = build(r1), build(r2)
     _timed(f1, A), _timed(f2, A)  # compile both
+    # Two pooling passes separated by a pause: transient host/tunnel
+    # contention (shared machine) then has a second chance to clear —
+    # min-plus-noise justifies taking the minimum across both.
     t1s, t2s = [], []
-    for _ in range(rounds):
-        t1s.append(_timed(f1, A))
-        t2s.append(_timed(f2, A))
+    for burst in range(2):
+        if burst:
+            time.sleep(10)
+        for _ in range(rounds):
+            t1s.append(_timed(f1, A))
+            t2s.append(_timed(f2, A))
     t1, t2 = min(t1s), min(t2s)
     if t2 <= t1:
         raise RuntimeError(
