@@ -146,7 +146,7 @@ def bench_fjlt(on_tpu, dtype, baseline_ms, table):
         return jax.jit(run)
 
     A = jax.random.normal(jax.random.PRNGKey(1), (m, n), dtype=dtype)
-    per = _rep_diff(build, A, r1=2, r2=8, rounds=20)
+    per = _rep_diff(build, A, r1=4, r2=16, rounds=20)
     name = "bf16" if dtype == jnp.bfloat16 else "f32"
     _emit(
         f"FJLT {m}x{n}->{s} {name} apply",
@@ -178,7 +178,7 @@ def bench_cwt(on_tpu, table):
         return jax.jit(run)
 
     A = jax.random.normal(jax.random.PRNGKey(2), (m, n), jnp.float32)
-    per = _rep_diff(build, A, r1=2, r2=10, rounds=20)
+    per = _rep_diff(build, A, r1=4, r2=12, rounds=20)
     _emit(
         f"CWT {m}x{n}->{s} dense columnwise apply",
         per * 1e3,
@@ -300,8 +300,12 @@ def bench_admm(on_tpu, table):
     # one trace+compile; the two programs (scan length 1 vs N) have near-
     # identical structure, so compile time CANCELS in the difference.
     # min over repeats suppresses compile/tunnel jitter.
-    t1 = min(_timed(lambda _: run(1), None) for _ in range(2))
-    tN = min(_timed(lambda _: run(iters), None) for _ in range(2))
+    for attempt in range(2):
+        t1 = min(_timed(lambda _: run(1), None) for _ in range(2))
+        tN = min(_timed(lambda _: run(iters), None) for _ in range(2))
+        if tN > t1:
+            break
+        time.sleep(10)  # transient contention: let it clear, retry once
     if tN <= t1:
         raise RuntimeError(
             f"ADMM timing inconsistent (t1={t1:.2f}s >= tN={tN:.2f}s)"
