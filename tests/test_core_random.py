@@ -190,3 +190,30 @@ def test_halton_low_discrepancy():
     pts = np.asarray(seq.window(0, 2000, dtype=jnp.float64))
     assert st.kstest(pts[:, 0], st.uniform.cdf).pvalue > 1e-4
     assert pts.min() >= 0 and pts.max() < 1
+
+
+class TestBf16Split3:
+    def test_exact_reconstruction(self, rng):
+        import jax.numpy as jnp
+
+        from libskylark_tpu.core.precision import bf16_split3
+
+        x = jnp.asarray(
+            rng.standard_normal(4096) * 10.0 ** rng.integers(-8, 8, 4096),
+            jnp.float32,
+        )
+        hi, lo, lo2 = bf16_split3(x)
+        rec = (np.asarray(hi, np.float64) + np.asarray(lo, np.float64)
+               + np.asarray(lo2, np.float64))
+        ref = np.asarray(x, np.float64)
+        scale = np.maximum(np.abs(ref), 1e-30)
+        assert (np.abs(rec - ref) / scale).max() < 2**-22
+
+    def test_rejects_non_f32(self, rng):
+        import jax.numpy as jnp
+        import pytest
+
+        from libskylark_tpu.core.precision import bf16_split3
+
+        with pytest.raises(TypeError, match="float32"):
+            bf16_split3(jnp.arange(4))
