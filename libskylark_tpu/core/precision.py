@@ -35,7 +35,17 @@ def _mask_top(x):
 def bf16_split3(x):
     """``(hi, lo, lo2)`` bf16 arrays with ``hi + lo + lo2 ≈ x`` to ~2^-24
     relative.  ``x`` must be f32 — the split bitcasts, so value-convert
-    other dtypes first (an int bit pattern would masquerade as floats)."""
+    other dtypes first (an int bit pattern would masquerade as floats).
+
+    Magnitude contract: the ~2^-24-relative bound holds for
+    ``|x| ≳ 2^-110``.  Below that, ``lo``/``lo2`` (whose exponents sit
+    ~8/16 binades under ``x``'s) fall beneath bf16's subnormal floor
+    (2^-133; f32 reaches 2^-149) and round to zero, so the split
+    gracefully degrades toward single-bf16 relative accuracy as ``|x|``
+    approaches f32's own subnormal range.  Harmless for sketching
+    workloads — inputs that tiny are already below any sketch tolerance —
+    but callers needing the full contract at extreme denormal scales
+    should pre-scale (round-2 advisor finding)."""
     if x.dtype != jnp.float32:
         raise TypeError(
             f"bf16_split3 needs float32 input, got {x.dtype}; astype first"

@@ -32,6 +32,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..linalg.spectral import chebyshev_diff_matrix
+from ..utils.deps import require
 
 __all__ = ["time_dependent_ppr", "find_local_cluster"]
 
@@ -39,7 +40,7 @@ __all__ = ["time_dependent_ppr", "find_local_cluster"]
 def _min_chebyshev_points(gamma: float, epsilon: float) -> int:
     """Bessel-function bound for the number of time collocation points
     (≙ local_computations.hpp:64-77)."""
-    from scipy.special import iv
+    iv = require("scipy.special").iv
 
     minN = 10
     C = 20.0 * np.sqrt(minN) * np.exp(-gamma / 2)
@@ -88,7 +89,7 @@ def time_dependent_ppr(
     the graph but only the active support's columns are nonzero; the
     computation never touches vertices outside support ∪ frontier.
     """
-    from scipy import sparse as sp
+    sp = require("scipy.sparse")
 
     n = G.n
     minN = _min_chebyshev_points(gamma, epsilon)

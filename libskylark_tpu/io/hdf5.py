@@ -10,11 +10,13 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..utils.deps import require
+
 __all__ = ["read_hdf5", "write_hdf5"]
 
 
 def write_hdf5(path, X, y, sparse: bool = False) -> None:
-    import h5py
+    h5py = require("h5py")
 
     with h5py.File(path, "w") as f:
         y = np.asarray(y)
@@ -49,7 +51,7 @@ def write_hdf5(path, X, y, sparse: bool = False) -> None:
 def read_hdf5(path, sparse: bool | None = None):
     """Returns (X, y); X is BCOO if the file holds sparse data (or
     ``sparse=True`` forces conversion of dense data)."""
-    import h5py
+    h5py = require("h5py")
 
     with h5py.File(path, "r") as f:
         y = np.asarray(f["Y"])

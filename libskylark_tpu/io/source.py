@@ -82,15 +82,9 @@ class FsspecSource(ByteSource):
     """
 
     def __init__(self, url: str):
-        try:
-            import fsspec  # noqa: F401  (optional dependency)
-        except ImportError as e:
-            raise ImportError(
-                f"reading {url!r} needs the optional 'fsspec' package "
-                "(not bundled in this environment); install it or "
-                "register_scheme() a custom ByteSource for the scheme"
-            ) from e
-        self._fsspec = fsspec
+        from ..utils.deps import require
+
+        self._fsspec = require("fsspec")
         self.url = url
         self.name = url
 

@@ -972,6 +972,46 @@ static bool js_find_num(const char* js, const char* key, double* val) {
     return true;
 }
 
+// Span of the "maps": [ ... ] array: *key_pos = start of the "maps" key,
+// *arr_open / *arr_close = the bracket positions.  Shared by the map-
+// object splitter and the top-level-key scoping below so the two never
+// drift on bracket-matching rules.  Returns false when no complete array
+// exists; an unterminated array reports close = npos with key/open set.
+static bool js_maps_span(const std::string& js, size_t* key_pos,
+                         size_t* arr_open, size_t* arr_close) {
+    *arr_open = *arr_close = std::string::npos;
+    *key_pos = js.find("\"maps\":");
+    if (*key_pos == std::string::npos) return false;
+    *arr_open = js.find('[', *key_pos);
+    if (*arr_open == std::string::npos) return false;
+    int depth = 0;
+    for (size_t i = *arr_open + 1; i < js.size(); i++) {
+        char ch = js[i];
+        if (ch == '{') depth++;
+        else if (ch == '}') depth--;
+        else if (ch == ']' && depth == 0) {
+            *arr_close = i;
+            return true;
+        }
+    }
+    return false;
+}
+
+// Model JSON with the "maps" array excised: top-level keys only.  A
+// per-map "skylark_version" in a hand-edited / foreign-writer file whose
+// top-level key is absent or ordered after "maps" must not masquerade as
+// the model's stream version (round-2 advisor finding).
+static std::string js_without_maps(const std::string& js) {
+    size_t key, open, close;
+    if (!js_maps_span(js, &key, &open, &close))
+        // No maps key/bracket: nothing to excise.  Unterminated array:
+        // keep the prefix only (close == npos distinguishes the cases).
+        return key == std::string::npos || open == std::string::npos
+                   ? js
+                   : js.substr(0, key);
+    return js.substr(0, key) + js.substr(close + 1);
+}
+
 // Full 64-bit precision (seed/counter can exceed 2^53).
 static bool js_find_u64(const char* js, const char* key, uint64_t* val) {
     std::string pat = std::string("\"") + key + "\":";
@@ -1617,14 +1657,13 @@ static bool sk_npy_read_f64(const char* path, std::vector<double>& data,
 
 static bool sk_json_map_objects(const std::string& js,
                                 std::vector<std::string>& out) {
-    // Split the top-level {...} objects inside "maps": [ ... ].
-    size_t p = js.find("\"maps\":");
-    if (p == std::string::npos) return false;
-    p = js.find('[', p);
-    if (p == std::string::npos) return false;
+    // Split the top-level {...} objects inside "maps": [ ... ] (bounds
+    // from js_maps_span — the one bracket-matching implementation).
+    size_t key, open, close;
+    if (!js_maps_span(js, &key, &open, &close)) return false;
     int depth = 0;
     size_t start = 0;
-    for (size_t i = p + 1; i < js.size(); i++) {
+    for (size_t i = open + 1; i < close; i++) {
         char ch = js[i];
         if (ch == '{') {
             if (depth == 0) start = i;
@@ -1632,11 +1671,9 @@ static bool sk_json_map_objects(const std::string& js,
         } else if (ch == '}') {
             depth--;
             if (depth == 0) out.push_back(js.substr(start, i - start + 1));
-        } else if (ch == ']' && depth == 0) {
-            return true;
         }
     }
-    return false;
+    return true;
 }
 
 int sl_model_info(const char* path, long* input_dim, long* num_outputs) {
@@ -1706,15 +1743,17 @@ int sl_model_load(const char* path, void** out) {
         return 105;
     }
     double ver = 0.0;
+    std::string toplevel = js_without_maps(js);
     m->version =
-        js_find_num(js.c_str(), "skylark_version", &ver) ? (int)ver : 1;
+        js_find_num(toplevel.c_str(), "skylark_version", &ver) ? (int)ver : 1;
     std::vector<std::string> mapjs;
     if (!sk_json_map_objects(js, mapjs)) {
         delete m;
         return 105;
     }
-    m->scale_maps = js.find("\"scale_maps\": true") != std::string::npos ||
-                    js.find("\"scale_maps\":true") != std::string::npos;
+    m->scale_maps =
+        toplevel.find("\"scale_maps\": true") != std::string::npos ||
+        toplevel.find("\"scale_maps\":true") != std::string::npos;
     long off = 0;
     for (const std::string& mjs : mapjs) {
         void* st = nullptr;

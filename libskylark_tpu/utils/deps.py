@@ -1,0 +1,37 @@
+"""Optional-dependency import with an actionable install hint.
+
+The base install depends only on ``jax`` + ``numpy``
+(``pyproject.toml``); scipy / h5py / fsspec live behind extras.  Features
+that need them (skylark-community, skylark-convert2hdf5, HDF5 IO, remote
+fsspec sources) import through this helper so a bare install fails with
+the pip command to run, not a raw ``ModuleNotFoundError`` (round-2
+advisor finding).
+"""
+
+from __future__ import annotations
+
+import importlib
+
+__all__ = ["require"]
+
+# module name -> the extra that provides it
+_EXTRAS = {"scipy": "ml", "h5py": "io", "fsspec": "io"}
+
+
+def require(module: str):
+    """Import ``module`` (dotted paths allowed), or raise ImportError
+    naming the ``pip install 'libskylark-tpu[extra]'`` that provides it."""
+    try:
+        return importlib.import_module(module)
+    except ImportError as e:
+        root = module.split(".", 1)[0]
+        extra = _EXTRAS.get(root)
+        hint = (
+            f"pip install 'libskylark-tpu[{extra}]'"
+            if extra
+            else f"pip install {root}"
+        )
+        raise ImportError(
+            f"{root!r} is required for this feature but is not installed; "
+            f"run: {hint}"
+        ) from e

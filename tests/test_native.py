@@ -231,6 +231,44 @@ class TestNativeModelPredict:
         with pytest.warns(UserWarning, match="stream revision 1"):
             native.NativeModel(path)
 
+    def test_version_scoped_to_toplevel(self, tmp_path):
+        """A per-map skylark_version must not masquerade as the model's
+        stream version when the top-level key is absent or ordered after
+        the maps array (ADVICE round 2, js_without_maps)."""
+        import ctypes
+        import json as _json
+
+        from libskylark_tpu.ml import FeatureMapModel, GaussianKernel
+
+        L = native.lib()
+        rng = np.random.default_rng(11)
+        ctx = SketchContext(seed=48)
+        maps = [GaussianKernel(3, 2.0).create_rft(8, "regular", ctx)]
+        model = FeatureMapModel(maps, rng.standard_normal((8, 2)), input_dim=3)
+        path = tmp_path / "mv2.json"
+        model.save(path)
+        d = _json.loads(path.read_text())
+        assert d["maps"][0]["skylark_version"] >= 2  # per-map key exists
+
+        # Top-level version absent: default 1, NOT the per-map value.
+        d_no_top = {k: v for k, v in d.items() if k != "skylark_version"}
+        d_no_top = {"maps": d_no_top.pop("maps"), **d_no_top}
+        path.write_text(_json.dumps(d_no_top))
+        h = ctypes.c_void_p()
+        assert L.sl_model_load(str(path).encode(), ctypes.byref(h)) == 0
+        assert L.sl_model_stream_version(h) == 1
+        L.sl_model_free(h)
+
+        # Top-level version ordered AFTER maps (foreign writer): found.
+        d_after = {k: v for k, v in d.items() if k != "skylark_version"}
+        d_after = {"maps": d_after.pop("maps"), **d_after,
+                   "skylark_version": d["skylark_version"]}
+        path.write_text(_json.dumps(d_after))
+        h = ctypes.c_void_p()
+        assert L.sl_model_load(str(path).encode(), ctypes.byref(h)) == 0
+        assert L.sl_model_stream_version(h) == d["skylark_version"]
+        L.sl_model_free(h)
+
 
 def test_supported_sketch_transforms_introspection():
     """≙ sl_supported_sketch_transforms (capi/csketch.cpp:74+): every C-API
