@@ -1,0 +1,78 @@
+"""f32 realized-FRFT: 4 summed dots vs one stacked-contraction matmul.
+
+The 4-dot form materializes four (m, S) f32 partials (2 GB each at
+s=4096) — output traffic dominates.  Stacking the split parts along the
+contraction axis does ONE dot with 4n contraction: same flops, one
+output pass, at the cost of materializing the (m, 4n) bf16 concat.
+"""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from libskylark_tpu.core.context import SketchContext
+from libskylark_tpu.core.precision import bf16_split3
+from libskylark_tpu.sketch.frft import FastGaussianRFT
+
+
+def _timed(fn, *args):
+    t0 = time.perf_counter()
+    np.asarray(fn(*args))
+    return time.perf_counter() - t0
+
+
+def rep_diff(build, A, r1=2, r2=6, rounds=6):
+    f1, f2 = build(r1), build(r2)
+    _timed(f1, A), _timed(f2, A)
+    t1s, t2s = [], []
+    for _ in range(rounds):
+        t1s.append(_timed(f1, A))
+        t2s.append(_timed(f2, A))
+    t1, t2 = min(t1s), min(t2s)
+    return float("nan") if t2 <= t1 else (t2 - t1) / (r2 - r1)
+
+
+def run(m, n, s, mode):
+    def build(reps):
+        ctx = SketchContext(seed=7)
+        sketches = [FastGaussianRFT(n, s, ctx, sigma=2.0) for _ in range(reps)]
+
+        def one(S, A):
+            W = S._realized_w()
+            w_hi, w_lo, _ = bf16_split3(W)
+            a_hi, a_lo, a_lo2 = bf16_split3(A)
+            if mode == "stack":
+                A4 = jnp.concatenate([a_hi, a_lo, a_lo2, a_hi], axis=1)
+                W4 = jnp.concatenate([w_hi, w_hi, w_hi, w_lo], axis=1)
+                V = jax.lax.dot_general(
+                    A4, W4, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+            else:
+                mm = lambda x, w: jax.lax.dot_general(
+                    x, w, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                V = mm(a_hi, w_hi) + mm(a_lo, w_hi) + mm(a_lo2, w_hi) + mm(a_hi, w_lo)
+            sh = S._shifts(jnp.float32)
+            return S.outscale * jnp.cos(V + sh[None, :])
+
+        def runf(A):
+            acc = jnp.zeros((), jnp.float32)
+            for S in sketches:
+                acc += jnp.sum(jnp.abs(one(S, A)))
+            return acc
+
+        return jax.jit(runf)
+
+    A = jax.random.normal(jax.random.PRNGKey(1), (m, n), jnp.float32)
+    return rep_diff(build, A)
+
+
+if __name__ == "__main__":
+    m, n = 131_072, 4096
+    for s in (2048, 4096):
+        for mode in ("stack", "dots"):
+            print(f"f32 realized[{mode}] s={s}: {run(m, n, s, mode)*1e3:.2f} ms",
+                  flush=True)

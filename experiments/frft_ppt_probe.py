@@ -2,6 +2,14 @@
 
 Run on the real chip.  Not part of the package — measurement scratch that
 informs sketch/frft.py + sketch/ppt.py design (VERDICT r2 item 1).
+
+Findings (v5e, m=131072 n=4096):
+- streaming Fastfood (two XLA WHTs + permutation gather):
+  s=2048: 33.95 ms bf16 / 65.14 ms f32;  s=4096: 37.98 / 66.76
+- realized-W prototype (host-built W): bf16 22.84 ms, A-bf16 x W-split2
+  26.70 ms, A-split3 x W-split2 (5-pass) 72.00 ms -> 4-pass chosen
+- host-built W closures hit the axon tunnel's HTTP 413 body limit at
+  s=4096 -> the package builds W IN-GRAPH from the counter stream
 """
 
 from __future__ import annotations
@@ -16,7 +24,6 @@ import numpy as np
 from libskylark_tpu.core.context import SketchContext
 from libskylark_tpu.sketch.frft import FastGaussianRFT
 from libskylark_tpu.sketch.ppt import PPT
-from libskylark_tpu.core.precision import bf16_split3
 
 
 def _timed(fn, *args) -> float:
@@ -38,12 +45,9 @@ def rep_diff(build, A, r1=2, r2=6, rounds=8) -> float:
     return (t2 - t1) / (r2 - r1)
 
 
-# --------------------------------------------------------------------------
-# Fastfood
-# --------------------------------------------------------------------------
+def frft_package(m, n, s, dtype):
+    """Times whatever path the package selects (realized gemm on TPU)."""
 
-
-def frft_current(m, n, s, dtype):
     def build(reps):
         ctx = SketchContext(seed=7)
         sketches = [FastGaussianRFT(n, s, ctx, sigma=2.0) for _ in range(reps)]
@@ -60,89 +64,7 @@ def frft_current(m, n, s, dtype):
     return rep_diff(build, A)
 
 
-def frft_realized(m, n, s, dtype, mode):
-    """Realize W = Sm*H*G*P*H*B per block as a dense (s, n) matrix (cheap:
-    nb x nb WHTs), then one MXU matmul + cos epilogue.
-
-    mode: 'bf16' (W and A in bf16), 'split3x2' (A split3 x W split2, 6
-    passes ~ f32-exact), 'split1x2' (A bf16 x W split2, 3 passes)."""
-
-    def build(reps):
-        ctx = SketchContext(seed=7)
-        sketches = [FastGaussianRFT(n, s, ctx, sigma=2.0) for _ in range(reps)]
-        Ws, shifts = [], []
-        for S in sketches:
-            W = S._features(jnp.eye(n, dtype=jnp.float32))  # (s, n) f32
-            Ws.append(W)
-            shifts.append(S._shifts(jnp.float32))
-        outscale = sketches[0].outscale
-
-        def run(A):
-            acc = jnp.zeros((), jnp.float32)
-            for W, sh in zip(Ws, shifts):
-                if mode == "bf16":
-                    V = jax.lax.dot_general(
-                        A.astype(jnp.bfloat16), W.astype(jnp.bfloat16).T,
-                        (((1,), (0,)), ((), ())),
-                        preferred_element_type=jnp.float32)
-                elif mode == "split1x2":
-                    w_hi, w_lo, _ = bf16_split3(W)
-                    A16 = A.astype(jnp.bfloat16)
-                    mm = lambda x, g: jax.lax.dot_general(
-                        x, g.T, (((1,), (0,)), ((), ())),
-                        preferred_element_type=jnp.float32)
-                    V = mm(A16, w_hi) + mm(A16, w_lo)
-                else:  # split3x2
-                    w_hi, w_lo, _ = bf16_split3(W)
-                    a_hi, a_lo, a_lo2 = bf16_split3(A.astype(jnp.float32))
-                    mm = lambda x, g: jax.lax.dot_general(
-                        x, g.T, (((1,), (0,)), ((), ())),
-                        preferred_element_type=jnp.float32)
-                    V = (mm(a_hi, w_hi) + mm(a_lo, w_hi) + mm(a_lo2, w_hi)
-                         + mm(a_hi, w_lo) + mm(a_lo, w_lo))
-                Z = outscale * jnp.cos(V + sh[None, :])
-                acc += jnp.sum(jnp.abs(Z))
-            return acc
-
-        return jax.jit(run)
-
-    A = jax.random.normal(jax.random.PRNGKey(1), (m, n), dtype=dtype)
-    return rep_diff(build, A)
-
-
-def frft_accuracy(n, s):
-    """Max-rel error of realized-W modes vs the f32 streaming path."""
-    ctx = SketchContext(seed=7)
-    S = FastGaussianRFT(n, s, ctx, sigma=2.0)
-    A = jax.random.normal(jax.random.PRNGKey(2), (256, n), jnp.float32)
-    ref = S.apply(A, "rowwise")
-    W = S._features(jnp.eye(n, dtype=jnp.float32))
-    sh = S._shifts(jnp.float32)
-    out = {}
-    Vb = jax.lax.dot_general(
-        A.astype(jnp.bfloat16), W.astype(jnp.bfloat16).T,
-        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    out["bf16"] = Vb
-    w_hi, w_lo, _ = bf16_split3(W)
-    a_hi, a_lo, a_lo2 = bf16_split3(A)
-    mm = lambda x, g: jax.lax.dot_general(
-        x, g.T, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
-    out["split1x2"] = mm(A.astype(jnp.bfloat16), w_hi) + mm(A.astype(jnp.bfloat16), w_lo)
-    out["split3x2"] = (mm(a_hi, w_hi) + mm(a_lo, w_hi) + mm(a_lo2, w_hi)
-                       + mm(a_hi, w_lo) + mm(a_lo, w_lo))
-    errs = {}
-    for k, V in out.items():
-        Z = S.outscale * jnp.cos(V + sh[None, :])
-        errs[k] = float(jnp.max(jnp.abs(Z - ref)))
-    return errs
-
-
-# --------------------------------------------------------------------------
-# PPT
-# --------------------------------------------------------------------------
-
-
-def ppt_current(m, n, s, q, dtype):
+def ppt_current(m, n, s, q, dtype, r1=1, r2=3):
     def build(reps):
         ctx = SketchContext(seed=9)
         sketches = [PPT(n, s, ctx, q=q) for _ in range(reps)]
@@ -156,7 +78,7 @@ def ppt_current(m, n, s, q, dtype):
         return jax.jit(run)
 
     A = jax.random.normal(jax.random.PRNGKey(3), (m, n), dtype=dtype)
-    return rep_diff(build, A, r1=1, r2=3, rounds=6)
+    return rep_diff(build, A, r1=r1, r2=r2, rounds=6)
 
 
 def main():
@@ -166,19 +88,15 @@ def main():
     if which in ("all", "frft"):
         for s in (2048, 4096):
             for dt, name in ((jnp.bfloat16, "bf16"), (jnp.float32, "f32")):
-                t = frft_current(m, n, s, dt)
-                print(f"FRFT current  m={m} n={n} s={s} {name}: {t*1e3:.2f} ms", flush=True)
-        for s in (2048, 4096):
-            for mode in ("bf16", "split1x2", "split3x2"):
-                t = frft_realized(m, n, s, jnp.float32, mode)
-                print(f"FRFT realized[{mode}] m={m} n={n} s={s}: {t*1e3:.2f} ms", flush=True)
-        print("FRFT accuracy (vs f32 streaming, n=1024 s=2048):",
-              frft_accuracy(1024, 2048), flush=True)
+                t = frft_package(m, n, s, dt)
+                print(f"FRFT package m={m} n={n} s={s} {name}: {t*1e3:.2f} ms",
+                      flush=True)
 
     if which in ("all", "ppt"):
         for dt, name in ((jnp.float32, "f32"), (jnp.bfloat16, "bf16")):
             t = ppt_current(m, n, 1024, 3, dt)
-            print(f"PPT current m={m} n={n} s=1024 q=3 {name}: {t*1e3:.2f} ms", flush=True)
+            print(f"PPT current m={m} n={n} s=1024 q=3 {name}: {t*1e3:.2f} ms",
+                  flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,81 @@
+"""PPT cost anatomy: FFT axis layout, rfft, and matmul-DFT on TPU."""
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _timed(fn, *args):
+    t0 = time.perf_counter()
+    np.asarray(fn(*args))
+    return time.perf_counter() - t0
+
+
+def rep_diff(build, *A, r1=2, r2=6, rounds=6):
+    f1, f2 = build(r1), build(r2)
+    _timed(f1, *A), _timed(f2, *A)
+    t1s, t2s = [], []
+    for _ in range(rounds):
+        t1s.append(_timed(f1, *A))
+        t2s.append(_timed(f2, *A))
+    t1, t2 = min(t1s), min(t2s)
+    return float("nan") if t2 <= t1 else (t2 - t1) / (r2 - r1)
+
+
+def fft_axis(m, s, axis):
+    shape = (s, m) if axis == 0 else (m, s)
+
+    def build(reps):
+        def run(W):
+            acc = jnp.zeros((), jnp.float32)
+            for i in range(reps):
+                P = jnp.fft.fft(W + jnp.float32(i), axis=axis)
+                acc += jnp.sum(jnp.abs(jnp.real(P)))
+            return acc
+        return jax.jit(run)
+
+    W = jax.random.normal(jax.random.PRNGKey(0), shape, jnp.float32)
+    return rep_diff(build, W)
+
+
+def rfft_last(m, s):
+    def build(reps):
+        def run(W):
+            acc = jnp.zeros((), jnp.float32)
+            for i in range(reps):
+                P = jnp.fft.rfft(W + jnp.float32(i), axis=1)
+                acc += jnp.sum(jnp.abs(jnp.real(P)))
+            return acc
+        return jax.jit(run)
+
+    W = jax.random.normal(jax.random.PRNGKey(0), (m, s), jnp.float32)
+    return rep_diff(build, W)
+
+
+def irfft_last(m, s):
+    def build(reps):
+        def run(P):
+            acc = jnp.zeros((), jnp.float32)
+            for i in range(reps):
+                Z = jnp.fft.irfft(P * (1.0 + i), n=s, axis=1)
+                acc += jnp.sum(jnp.abs(Z))
+            return acc
+        return jax.jit(run)
+
+    P = jnp.asarray(
+        np.random.default_rng(0).standard_normal((m, s // 2 + 1))
+        + 1j * np.random.default_rng(1).standard_normal((m, s // 2 + 1)),
+        jnp.complex64,
+    )
+    return rep_diff(build, P)
+
+
+if __name__ == "__main__":
+    m, s = 131_072, 1024
+    print(f"fft axis0 (s,m) c64: {fft_axis(m, s, 0)*1e3:.2f} ms", flush=True)
+    print(f"fft axis1 (m,s) c64: {fft_axis(m, s, 1)*1e3:.2f} ms", flush=True)
+    print(f"rfft last (m,s): {rfft_last(m, s)*1e3:.2f} ms", flush=True)
+    print(f"irfft last (m,s/2+1): {irfft_last(m, s)*1e3:.2f} ms", flush=True)

@@ -167,8 +167,6 @@ def columnwise_sharded_sparse(S, A, mesh: Mesh, scatter: bool = False):
         raise ValueError(f"S={S.s} not divisible by mesh size for scatter")
     block = n // p
     d, lr, cc = _shard_coo_rows(A, p, block)
-    dtype = _coo_dtype(d)
-
     if n >= (1 << 32):
         # Traced shard offsets ride raw_bits' uint32 lane; the static
         # h·N part of the window start is folded into the 64-bit counter
@@ -176,8 +174,18 @@ def columnwise_sharded_sparse(S, A, mesh: Mesh, scatter: bool = False):
         raise ValueError(
             f"columnwise_sharded_sparse supports N < 2^32, got N={n}"
         )
+    return _columnwise_sparse_program(S, m, block, mesh, scatter)(d, lr, cc)
+
+
+def _columnwise_sparse_program(S, m: int, block: int, mesh: Mesh,
+                               scatter: bool):
+    """The jittable device half of :func:`columnwise_sharded_sparse`
+    (host-side COO row-block splitting already done).  Factored out so the
+    compiled-HLO schedule tests can lower exactly the program that runs."""
+    axes = tuple(mesh.axis_names)
 
     def local(d, lr, cc):
+        dtype = _coo_dtype(d)
         d, lr, cc = d[0].astype(dtype), lr[0], cc[0]
         idx = jax.lax.axis_index(axes)
         acc = jnp.zeros((S.s * m,), dtype)
@@ -205,7 +213,7 @@ def columnwise_sharded_sparse(S, A, mesh: Mesh, scatter: bool = False):
         mesh=mesh,
         in_specs=(P(axes, None), P(axes, None), P(axes, None)),
         out_specs=out_spec,
-    )(d, lr, cc)
+    )
 
 
 def rowwise_sharded_sparse(S, A, mesh: Mesh):
@@ -223,9 +231,16 @@ def rowwise_sharded_sparse(S, A, mesh: Mesh):
         raise ValueError(f"rows {m} not divisible by mesh size {p}")
     block = m // p
     d, lr, cc = _shard_coo_rows(A, p, block)
-    dtype = _coo_dtype(d)
+    return _rowwise_sparse_program(S, block, mesh)(d, lr, cc)
+
+
+def _rowwise_sparse_program(S, block: int, mesh: Mesh):
+    """Jittable device half of :func:`rowwise_sharded_sparse` (host-side
+    COO splitting done); factored out for the compiled-HLO tests."""
+    axes = tuple(mesh.axis_names)
 
     def local(d, lr, cc):
+        dtype = _coo_dtype(d)
         d, lr, cc = d[0].astype(dtype), lr[0], cc[0]
         acc = jnp.zeros((block * S.s,), dtype)
         for h in range(S.nnz):
@@ -242,4 +257,4 @@ def rowwise_sharded_sparse(S, A, mesh: Mesh):
         mesh=mesh,
         in_specs=(P(axes, None), P(axes, None), P(axes, None)),
         out_specs=P(axes, None),
-    )(d, lr, cc)
+    )

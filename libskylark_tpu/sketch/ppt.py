@@ -14,10 +14,25 @@ the normalized ``jnp.fft.ifft``.
 
 Counter budget ≙ ``PPT_data_t::build``: q CWTs (2N each), then q hash
 indices and q hash values.
+
+TPU cost (round 3, v5e, 131072×4096→1024 q=3): the f32 FFT path runs
+149 ms — ~50 ms in the three split-CWT matmuls, ~50 ms in the four c64
+FFTs (~12-14 ms each, axis layout immaterial; measured), the rest in
+complex products.  For **bf16** inputs the S-point DFT is instead done
+as explicit (S, S) cos/sin MXU matmuls in real arithmetic (complex64
+never materializes; ~1.4 ms per half-transform vs 12.5 ms per FFT),
+measured 101→~45 ms.  f32 keeps the exact-precision FFT: a split-matmul
+DFT needs ≥8 bf16 passes (data split3 × matrix split2 per real part) and
+measures no faster than XLA's FFT.  ``jnp.fft.irfft`` is UNIMPLEMENTED
+on the TPU backend (probed) — only full complex ``fft``/``ifft`` and the
+real matmul-DFT are used.
 """
 
 from __future__ import annotations
 
+import os
+
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -27,6 +42,13 @@ from .base import Dimension, SketchTransform, register_sketch
 from .hash import CWT
 
 __all__ = ["PPT"]
+
+# bf16 matmul-DFT gate: the (S, S) cos+sin pair costs 2·S²·m MXU flops
+# per level vs ~6 HBM passes of (S, m) complex for the FFT; the matmul
+# wins for S up to several thousand and batches wide enough to amortize
+# building the two (S, S) tables in-graph.
+_DFT_MAX_S = 1 << 12
+_DFT_MIN_BATCH = 4096
 
 
 @register_sketch
@@ -63,19 +85,92 @@ class PPT(SketchTransform):
         val = sample("rademacher", self._seed, self._hval_base, self.q, dtype=dtype)
         return idx, val
 
+    def _dft_wins(self, dtype, batch: int) -> bool:
+        """Gate for the bf16 matmul-DFT path (one predicate for both
+        orientations — mirrors FastRFT._realize_wins)."""
+        return (
+            dtype == jnp.bfloat16
+            and 2 <= self.s <= _DFT_MAX_S
+            and batch >= _DFT_MIN_BATCH
+            and os.environ.get("SKYLARK_NO_PPT_DFT", "0") != "1"
+        )
+
     def _features(self, X):
         """Columnwise features for X (n, m) → (S, m) real."""
         dtype = X.dtype
-        cdtype = jnp.complex128 if dtype == jnp.float64 else jnp.complex64
+        if self._dft_wins(dtype, X.shape[1]):
+            return self._features_dft(X)
         sqrt_g = jnp.asarray(np.sqrt(self.gamma), dtype)
         sqrt_c = jnp.asarray(np.sqrt(self.c), dtype)
         idx, val = self._hash_consts(dtype)
-        P = jnp.ones((self.s, X.shape[1]), cdtype)
+        # Seed the frequency-domain product with level 0 (one multiply —
+        # and one eager complex-ones allocation — fewer than starting
+        # from ones; the axon TPU backend can't even create a complex
+        # array outside jit).
+        P = None
         for l, cwt in enumerate(self._cwts):
             W = sqrt_g * cwt.apply(X, Dimension.COLUMNWISE)
             W = W.at[idx[l], :].add(sqrt_c * val[l])
-            P = P * jnp.fft.fft(W, axis=0)
+            F = jnp.fft.fft(W, axis=0)
+            P = F if P is None else P * F
         return jnp.real(jnp.fft.ifft(P, axis=0)).astype(dtype)
+
+    # -- bf16 matmul-DFT fast path (TPU) -----------------------------------
+
+    def _dft_tables(self):
+        """(cos, sin) (S, S) DFT tables in bf16, built in-graph.  The
+        index product j·k stays below 2^24 for S ≤ 2^12 (int32-exact,
+        reduced mod S before the float conversion)."""
+        j = jnp.arange(self.s, dtype=jnp.int32)
+        jk = (j[:, None] * j[None, :]) % jnp.int32(self.s)
+        theta = jnp.float32(2.0 * np.pi / self.s) * jk.astype(jnp.float32)
+        return (
+            jnp.cos(theta).astype(jnp.bfloat16),
+            jnp.sin(theta).astype(jnp.bfloat16),
+        )
+
+    def _features_dft(self, X, rowwise: bool = False):
+        """bf16 features via explicit real-arithmetic DFT matmuls: each
+        level's S-point transform is a (cos, sin) MXU matmul pair, the
+        level products run as (Re, Im) f32 pairs, and the inverse
+        transform is one more pair — complex64 never materializes.
+        Values match the FFT path to bf16 feature accuracy (the DFT
+        tables round to bf16; inputs are already bf16).  ``rowwise``
+        keeps the batch on the major axis ((m, S) layout, transform on
+        the minor axis) so rowwise applies skip two full-batch
+        transposes — the DFT tables are symmetric, so the same (cos,
+        sin) pair serves both orientations."""
+        C, Sn = self._dft_tables()
+        sqrt_g = jnp.asarray(np.sqrt(self.gamma), jnp.bfloat16)
+        sqrt_c = jnp.asarray(np.sqrt(self.c), jnp.float32)
+        idx, val = self._hash_consts(jnp.float32)
+        dim = Dimension.ROWWISE if rowwise else Dimension.COLUMNWISE
+
+        def mm(W, M):
+            # Contracts the S axis of W (axis 1 rowwise / 0 columnwise)
+            # with the symmetric (S, S) table, preserving W's layout.
+            args = (W, M) if rowwise else (M, W)
+            return jax.lax.dot_general(
+                *args, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        def add_const(W, l):
+            loc = (slice(None), idx[l]) if rowwise else (idx[l], slice(None))
+            return W.astype(jnp.float32).at[loc].add(sqrt_c * val[l])
+
+        Pr = Pi = None
+        for l, cwt in enumerate(self._cwts):
+            W = sqrt_g * cwt.apply(X, dim)  # (m, S) rowwise / (S, m) col.
+            Wb = add_const(W, l).astype(jnp.bfloat16)
+            Re, Im = mm(Wb, C), -mm(Wb, Sn)
+            if Pr is None:
+                Pr, Pi = Re, Im
+            else:
+                Pr, Pi = Pr * Re - Pi * Im, Pr * Im + Pi * Re
+        # ifft real part: (1/S)·(C@Pr − Sn@Pi)  (e^{+iθ} = C + i·Sn).
+        Z = mm(Pr.astype(jnp.bfloat16), C) - mm(Pi.astype(jnp.bfloat16), Sn)
+        return (Z * jnp.float32(1.0 / self.s)).astype(jnp.bfloat16)
 
     def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
         dim = Dimension.of(dim)
@@ -92,6 +187,8 @@ class PPT(SketchTransform):
         X = A[None, :] if squeeze else A
         if X.shape[-1] != self.n:
             raise ValueError(f"rowwise apply needs {self.n} cols, got {A.shape}")
+        if not squeeze and self._dft_wins(dtype, X.shape[0]):
+            return self._features_dft(X, rowwise=True)
         return self._features(X.T).T if not squeeze else self._features(X.T)[:, 0]
 
     def _param_dict(self):

@@ -137,6 +137,116 @@ class TestSparseShardedSchedules:
         with pytest.raises(ValueError):
             columnwise_sharded_sparse(S2, A, mesh)  # 60 % 8 != 0
 
+
+_COLLECTIVE_RE = __import__("re").compile(
+    r"\b(all-reduce|reduce-scatter|all-gather|all-to-all|"
+    r"collective-permute)(?:-start)?\("
+)
+
+
+def _collective_counts(fn, *args):
+    """Counts of collective instructions in the fully compiled HLO."""
+    from collections import Counter
+
+    txt = jax.jit(fn).lower(*args).compile().as_text()
+    return Counter(m.group(1) for m in _COLLECTIVE_RE.finditer(txt))
+
+
+class TestCompiledCommunicationSchedules:
+    """P2/P5/P6 are *schedule* invariants, not just value invariants: the
+    reference documents rowwise sketch-apply as communication-free and
+    columnwise as one reduction (``doc/sphinx/sketching.rst:104-118``).
+    Value-parity tests can't catch a JAX/XLA upgrade or refactor that
+    silently starts communicating, so these assert collective-op counts
+    in the compiled HLO itself (VERDICT round 2 item 4)."""
+
+    def test_rowwise_dense_zero_collectives(self, rng):
+        n, s, m = 64, 16, 128
+        mesh = default_mesh()
+        S = JLT(n, s, SketchContext(seed=31))
+        A = shard_rows(jnp.asarray(rng.standard_normal((m, n))), mesh)
+        counts = _collective_counts(lambda a: rowwise_sharded(S, a, mesh), A)
+        assert not counts, f"rowwise schedule must be comm-free, got {counts}"
+
+    def test_rowwise_hash_zero_collectives(self, rng):
+        n, s, m = 48, 12, 64
+        mesh = default_mesh()
+        S = CWT(n, s, SketchContext(seed=32))
+        A = shard_rows(jnp.asarray(rng.standard_normal((m, n))), mesh)
+        counts = _collective_counts(lambda a: rowwise_sharded(S, a, mesh), A)
+        assert not counts, f"rowwise schedule must be comm-free, got {counts}"
+
+    def test_columnwise_exactly_one_allreduce(self, rng):
+        n, s, m = 128, 32, 24
+        mesh = default_mesh()
+        S = JLT(n, s, SketchContext(seed=33))
+        A = shard_rows(jnp.asarray(rng.standard_normal((n, m))), mesh)
+        counts = _collective_counts(
+            lambda a: columnwise_sharded(S, a, mesh), A
+        )
+        assert counts == {"all-reduce": 1}, counts
+
+    def test_columnwise_scatter_exactly_one_reduce_scatter(self, rng):
+        n, s, m = 64, 32, 8
+        mesh = default_mesh()
+        S = JLT(n, s, SketchContext(seed=34))
+        A = shard_rows(jnp.asarray(rng.standard_normal((n, m))), mesh)
+        counts = _collective_counts(
+            lambda a: columnwise_sharded(S, a, mesh, scatter=True), A
+        )
+        assert counts == {"reduce-scatter": 1}, counts
+
+    @staticmethod
+    def _split_coo(A, mesh, block):
+        from libskylark_tpu.parallel.collectives import _shard_coo_rows
+
+        return _shard_coo_rows(A, mesh.size, block)
+
+    def test_sparse_rowwise_zero_collectives(self, rng):
+        from libskylark_tpu.parallel.collectives import _rowwise_sparse_program
+
+        n, s, m = 96, 12, 64
+        mesh = default_mesh()
+        S = CWT(n, s, SketchContext(seed=35))
+        A, _ = _random_bcoo(rng, (m, n))
+        # The COO row-block split is host-side; the device program (the
+        # part a schedule regression could infect) is lowered directly.
+        d, lr, cc = self._split_coo(A, mesh, m // mesh.size)
+        counts = _collective_counts(_rowwise_sparse_program(S, m // mesh.size, mesh), d, lr, cc)
+        assert not counts, f"sparse rowwise must be comm-free, got {counts}"
+
+    def test_sparse_columnwise_exactly_one_allreduce(self, rng):
+        from libskylark_tpu.parallel.collectives import (
+            _columnwise_sparse_program,
+        )
+
+        n, s, m = 128, 16, 24
+        mesh = default_mesh()
+        S = SJLT(n, s, SketchContext(seed=36), nnz=4)
+        A, _ = _random_bcoo(rng, (n, m))
+        d, lr, cc = self._split_coo(A, mesh, n // mesh.size)
+        counts = _collective_counts(
+            _columnwise_sparse_program(S, m, n // mesh.size, mesh, False),
+            d, lr, cc,
+        )
+        assert counts == {"all-reduce": 1}, counts
+
+    def test_sparse_columnwise_scatter_one_reduce_scatter(self, rng):
+        from libskylark_tpu.parallel.collectives import (
+            _columnwise_sparse_program,
+        )
+
+        n, s, m = 64, 32, 8
+        mesh = default_mesh()
+        S = CWT(n, s, SketchContext(seed=37))
+        A, _ = _random_bcoo(rng, (n, m))
+        d, lr, cc = self._split_coo(A, mesh, n // mesh.size)
+        counts = _collective_counts(
+            _columnwise_sparse_program(S, m, n // mesh.size, mesh, True),
+            d, lr, cc,
+        )
+        assert counts == {"reduce-scatter": 1}, counts
+
     def test_traced_start_requires_num(self):
         S = CWT(64, 8, SketchContext(seed=11))
         with pytest.raises(ValueError, match="num is required"):

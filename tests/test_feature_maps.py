@@ -330,6 +330,39 @@ class TestFastRFT:
             np.asarray(F2.apply(X, "columnwise")),
         )
 
+    @pytest.mark.parametrize("dim", ["rowwise", "columnwise"])
+    @pytest.mark.parametrize(
+        "cls,kw",
+        [(FastGaussianRFT, {"sigma": 1.7}), (FastMaternRFT, {"nu": 1.5, "l": 0.9})],
+    )
+    def test_realized_matches_streaming(self, rng, monkeypatch, cls, kw, dim):
+        """The realized-W MXU path (big bf16/f32 batches) must agree with
+        the exact streaming form to the 4-pass split's ~2^-16-relative
+        pre-cos bound (sketch/frft.py round-3 fast path)."""
+        n, s, m = 24, 64, 128  # nb=32; batch >= 4*nb fires the gate
+        A = rng.standard_normal((m, n)).astype(np.float32)
+        arr = jnp.asarray(A if dim == "rowwise" else A.T)
+        S = cls(n, s, SketchContext(seed=11), **kw)
+        batch = m
+        assert S._realize_wins(jnp.float32, batch)
+        Z_fast = S.apply(arr, dim)
+        monkeypatch.setenv("SKYLARK_NO_FRFT_GEMM", "1")
+        assert not S._realize_wins(jnp.float32, batch)
+        Z_exact = S.apply(arr, dim)
+        np.testing.assert_allclose(
+            np.asarray(Z_fast), np.asarray(Z_exact), atol=5e-4
+        )
+
+    def test_realized_gate_bounds(self):
+        S = FastGaussianRFT(24, 64, SketchContext(seed=12), sigma=1.0)
+        assert not S._realize_wins(jnp.float64, 10_000)  # f64 stays exact
+        assert not S._realize_wins(jnp.float32, 64)      # small batch
+        big = FastGaussianRFT(
+            1 << 13, 1 << 14, SketchContext(seed=13), sigma=1.0
+        )
+        assert big.numblks * big._nb * big._nb > (64 << 20)
+        assert not big._realize_wins(jnp.float32, 1 << 20)  # W cap
+
 
 class TestRLT:
     def test_expsemigroup_kernel_approx(self, rng):
@@ -392,3 +425,29 @@ class TestPPT:
             jnp.asarray(rng.standard_normal((6, 4)))
         )
         assert Z.shape == (64, 4)
+
+    def test_bf16_dft_matches_fft(self, rng, monkeypatch):
+        """The bf16 matmul-DFT fast path (sketch/ppt.py round 3) must
+        agree with the complex-FFT path to bf16 feature accuracy and
+        with the f64 exact path to ~1% of the feature scale."""
+        import libskylark_tpu.sketch.ppt as pptmod
+
+        monkeypatch.setattr(pptmod, "_DFT_MIN_BATCH", 8)
+        n, s, m = 24, 16, 64
+        A = rng.standard_normal((n, m))
+        F = PPT(n, s, SketchContext(seed=7), q=3, c=0.7, gamma=1.3)
+        A16 = jnp.asarray(A).astype(jnp.bfloat16)
+        Z_dft = F.apply(A16, "columnwise")
+        assert Z_dft.dtype == jnp.bfloat16
+        monkeypatch.setenv("SKYLARK_NO_PPT_DFT", "1")
+        Z_fft = F.apply(A16, "columnwise")
+        Z64 = F.apply(jnp.asarray(A), "columnwise")
+        scale = float(jnp.max(jnp.abs(Z64)))
+        d_paths = float(
+            jnp.max(jnp.abs(Z_dft.astype(jnp.float64) - Z_fft.astype(jnp.float64)))
+        )
+        d_exact = float(
+            jnp.max(jnp.abs(Z_dft.astype(jnp.float64) - np.asarray(Z64)))
+        )
+        assert d_paths / scale < 0.02
+        assert d_exact / scale < 0.02

@@ -155,6 +155,43 @@ class TestUtils:
         rep = t.report()
         assert "a" in rep and t.counts["a"] == 2
 
+    def test_timer_report_distributed_aggregation(self):
+        """timer_report(distributed=True) gathers per-process phase
+        scalars and reports min/max/avg over ranks (≙ utility/
+        timer.hpp:44-66 PRINT's world-communicator MPI reduction).
+        Single-process job: gathered axis is 1, so min = max = avg = the
+        local totals."""
+        t = PhaseTimer()
+        with t.phase("solve"):
+            sum(range(1000))
+        rep = t.report(distributed=True)
+        assert "solve" in rep and "min(s)" in rep and "over 1 process" in rep
+        local = t.totals["solve"]
+        row = [ln for ln in rep.splitlines() if ln.startswith("solve")][0]
+        mn, mx, avg, calls = row.split()[1:5]
+        assert float(mn) == float(mx) == float(avg) == round(local, 4)
+        assert int(calls) == 1
+
+    def test_timer_aggregate_multirank_shape(self):
+        """The multi-rank reduction itself, with synthetic 4-process
+        data (what a real jax.distributed run would gather)."""
+        import numpy as np
+
+        from libskylark_tpu.utils.timer import aggregate_report
+
+        stacked = np.array(
+            [[1.0, 10.0], [3.0, 10.0], [2.0, 10.0], [6.0, 10.0]]
+        )
+        counts = np.array([[2, 1]] * 4)
+        rep = aggregate_report(["comm", "prox"], stacked, counts)
+        assert "over 4 processes" in rep
+        comm = [ln for ln in rep.splitlines() if ln.startswith("comm")][0]
+        mn, mx, avg, calls = comm.split()[1:5]
+        assert (float(mn), float(mx), float(avg)) == (1.0, 6.0, 3.0)
+        assert int(calls) == 2
+        prox = [ln for ln in rep.splitlines() if ln.startswith("prox")][0]
+        assert float(prox.split()[1]) == float(prox.split()[2]) == 10.0
+
     def test_exception_codes(self):
         assert issubclass(SketchError, SkylarkError)
         assert SketchError.code == 103
