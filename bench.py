@@ -51,40 +51,78 @@ def _timed(fn, *args) -> float:
     return time.perf_counter() - t0
 
 
-def _rep_diff(build, A, r1=4, r2=16, rounds=25) -> float:
+_LAST_CONTENTION: float | None = None
+
+
+def _rep_diff(build, A, r1=4, r2=16, rounds=25, max_bursts=4) -> float:
     """Seconds per single apply, by differencing two rep counts.
 
     ``build(k)`` must return a jitted callable running k independent
     applies of the op under test, reduced to a scalar.
+
+    Contention-adaptive pooling (round 3): minima are pooled per burst
+    with pauses in between; if the burst-to-burst spread of the derived
+    marginal stays ≤5% after two bursts the measurement is accepted,
+    otherwise pooling extends (up to ``max_bursts``) to give transient
+    host/tunnel contention more chances to clear — min-plus-noise
+    justifies the final min across all bursts.  The residual spread is
+    recorded in ``_LAST_CONTENTION`` and emitted with the metric, so a
+    low driver capture is self-explaining (VERDICT r2 item 5).
     """
+    global _LAST_CONTENTION
+    _LAST_CONTENTION = None  # a failed config must not inherit a stale value
+    args = A if isinstance(A, tuple) else (A,)
     f1, f2 = build(r1), build(r2)
-    _timed(f1, A), _timed(f2, A)  # compile both
-    # Two pooling passes separated by a pause: transient host/tunnel
-    # contention (shared machine) then has a second chance to clear —
-    # min-plus-noise justifies taking the minimum across both.
-    t1s, t2s = [], []
-    for burst in range(2):
+    _timed(f1, *args), _timed(f2, *args)  # compile both
+    t1s, t2s, per_burst = [], [], []
+    for burst in range(max_bursts):
         if burst:
             time.sleep(10)
+        b1, b2 = [], []
         for _ in range(rounds):
-            t1s.append(_timed(f1, A))
-            t2s.append(_timed(f2, A))
+            b1.append(_timed(f1, *args))
+            b2.append(_timed(f2, *args))
+        t1s += b1
+        t2s += b2
+        if min(b2) > min(b1):
+            per_burst.append((min(b2) - min(b1)) / (r2 - r1))
+        if burst >= 1 and len(per_burst) >= 2:
+            spread = (max(per_burst) - min(per_burst)) / min(per_burst)
+            if spread <= 0.05:
+                break
     t1, t2 = min(t1s), min(t2s)
     if t2 <= t1:
         raise RuntimeError(
             f"benchmark timing inconsistent (t1={t1:.4f}s >= t2={t2:.4f}s); "
             "rerun on a quieter machine"
         )
+    _LAST_CONTENTION = (
+        round((max(per_burst) - min(per_burst)) / min(per_burst), 4)
+        if len(per_burst) >= 2
+        # Fewer than two bursts yielded a usable marginal: contention so
+        # heavy the spread is unmeasurable — flag with -1 rather than
+        # omitting the field (absent = custom-timing config, never
+        # "noisy"; BASELINE.md round-3 integrity note).
+        else -1.0
+    )
     return (t2 - t1) / (r2 - r1)
 
 
-def _emit(metric, value, unit, vs_baseline, table):
+def _emit(metric, value, unit, vs_baseline, table, contention="auto"):
     row = {
         "metric": metric,
         "value": round(float(value), 4),
         "unit": unit,
         "vs_baseline": round(float(vs_baseline), 4),
     }
+    if contention == "auto":
+        contention = _LAST_CONTENTION
+    if contention is not None:
+        # burst-to-burst spread of the marginal: ≤0.05 = quiet machine;
+        # larger values flag host/tunnel contention the pooling could
+        # not fully clear (the value is then a lower-confidence upper
+        # bound on the true time).
+        row["contention"] = contention
     table.append(row)
     print(json.dumps(row), flush=True)
 
@@ -188,6 +226,148 @@ def bench_cwt(on_tpu, table):
     )
 
 
+def bench_frft(on_tpu, dtype, baseline_ms, table):
+    """Fastfood via the realized-W MXU path (sketch/frft.py round 3)."""
+    from libskylark_tpu.sketch.frft import FastGaussianRFT
+
+    if on_tpu:
+        m, n, s = 131_072, 4096, 2048
+    else:
+        m, n, s = 4096, 256, 512
+
+    def build(reps):
+        ctx = SketchContext(seed=37)
+        sketches = [FastGaussianRFT(n, s, ctx, sigma=2.0) for _ in range(reps)]
+
+        def run(A):
+            acc = jnp.zeros((), jnp.float32)
+            for S in sketches:
+                acc += jnp.sum(jnp.abs(S.apply(A, "rowwise").astype(jnp.float32)))
+            return acc
+
+        return jax.jit(run)
+
+    A = jax.random.normal(jax.random.PRNGKey(5), (m, n), dtype=dtype)
+    per = _rep_diff(build, A, r1=2, r2=8, rounds=15)
+    name = "bf16" if dtype == jnp.bfloat16 else "f32"
+    _emit(
+        f"FastGaussianRFT {m}x{n}->{s} {name} apply",
+        per * 1e3,
+        "ms",
+        baseline_ms / (per * 1e3) if on_tpu else 1.0,
+        table,
+    )
+
+
+def bench_ppt(on_tpu, dtype, baseline_ms, table):
+    """TensorSketch q=3 (bf16 = matmul-DFT path, f32 = complex FFT)."""
+    from libskylark_tpu.sketch.ppt import PPT
+
+    if on_tpu:
+        m, n, s = 131_072, 4096, 1024
+    else:
+        m, n, s = 4096, 256, 128
+
+    def build(reps):
+        ctx = SketchContext(seed=43)
+        sketches = [PPT(n, s, ctx, q=3) for _ in range(reps)]
+
+        def run(A):
+            acc = jnp.zeros((), jnp.float32)
+            for S in sketches:
+                acc += jnp.sum(jnp.abs(S.apply(A, "rowwise").astype(jnp.float32)))
+            return acc
+
+        return jax.jit(run)
+
+    A = jax.random.normal(jax.random.PRNGKey(6), (m, n), dtype=dtype)
+    # r2 capped at 2: three concurrent f32-FFT rep bodies overflow HBM
+    # (XLA schedules their ~0.5 GB FFT temps together).
+    per = _rep_diff(build, A, r1=1, r2=2, rounds=12)
+    name = "bf16" if dtype == jnp.bfloat16 else "f32"
+    _emit(
+        f"PPT {m}x{n}->{s} q=3 {name} apply",
+        per * 1e3,
+        "ms",
+        baseline_ms / (per * 1e3) if on_tpu else 1.0,
+        table,
+    )
+
+
+def bench_mmt(on_tpu, table):
+    """Non-sign hash sketch (Cauchy values) — the scaled-one-hot f32
+    path must stay at CWT speed (hash.py round 3)."""
+    from libskylark_tpu.sketch.hash import MMT
+
+    if on_tpu:
+        m, n, s = 131_072, 4096, 1024
+    else:
+        m, n, s = 8192, 512, 128
+
+    def build(reps):
+        ctx = SketchContext(seed=47)
+        sketches = [MMT(m, s, ctx) for _ in range(reps)]
+
+        def run(A):
+            acc = jnp.zeros((), jnp.float32)
+            for S in sketches:
+                acc += jnp.sum(jnp.abs(S.apply(A, "columnwise").astype(jnp.float32)))
+            return acc
+
+        return jax.jit(run)
+
+    A = jax.random.normal(jax.random.PRNGKey(7), (m, n), jnp.float32)
+    per = _rep_diff(build, A, r1=4, r2=12, rounds=15)
+    _emit(
+        f"MMT {m}x{n}->{s} dense f32 columnwise apply",
+        per * 1e3,
+        "ms",
+        18.1 / (per * 1e3) if on_tpu else 1.0,
+        table,
+    )
+
+
+def bench_sparse_cwt(on_tpu, table):
+    """Input-sparsity-time sketch: CWT on a 1e6x1e5 BCOO, 1e7 nnz,
+    dense_output (sort-free segment_sum — hash.py round 3)."""
+    from jax.experimental import sparse as jsparse
+
+    from libskylark_tpu.sketch.hash import CWT
+
+    if on_tpu:
+        n, m, s, nnz = 1_000_000, 100_000, 1024, 10_000_000
+    else:
+        n, m, s, nnz = 10_000, 1_000, 128, 100_000
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(8), 3)
+    rows = jax.random.randint(k1, (nnz,), 0, n, dtype=jnp.int32)
+    cols = jax.random.randint(k2, (nnz,), 0, m, dtype=jnp.int32)
+    data = jax.random.normal(k3, (nnz,), jnp.float32)
+    idx = jnp.stack([rows, cols], axis=1)
+
+    def build(reps):
+        ctx = SketchContext(seed=53)
+        sketches = [CWT(n, s, ctx) for _ in range(reps)]
+
+        def run(data, idx):
+            A = jsparse.BCOO((data, idx), shape=(n, m))
+            acc = jnp.zeros((), jnp.float32)
+            for S in sketches:
+                out = S.apply(A, "columnwise", dense_output=True)
+                acc += jnp.sum(jnp.abs(out))
+            return acc
+
+        return jax.jit(run)
+
+    per = _rep_diff(build, (data, idx), r1=1, r2=3, rounds=8)
+    _emit(
+        f"CWT BCOO {n}x{m} nnz={nnz:.0e} -> {s} dense_output",
+        per * 1e3,
+        "ms",
+        357.0 / (per * 1e3) if on_tpu else 1.0,
+        table,
+    )
+
+
 def bench_streaming_svd(on_tpu, table):
     """The BASELINE.json headline config: 1e7x1024, k=100 (bf16 panels)."""
     from libskylark_tpu.linalg import (
@@ -218,6 +398,7 @@ def bench_streaming_svd(on_tpu, table):
         "s",
         21.0 / dt if on_tpu else 1.0,
         table,
+        contention=None,  # single-shot timing — no burst spread measured
     )
 
 
@@ -264,6 +445,7 @@ def bench_ridge(on_tpu, table):
         "ms",
         31.0 / (per * 1e3) if on_tpu else 1.0,
         table,
+        contention=None,  # custom timing loop — no burst spread measured
     )
 
 
@@ -317,6 +499,7 @@ def bench_admm(on_tpu, table):
         "s/iter",
         0.92 / per if on_tpu else 1.0,
         table,
+        contention=None,  # custom timing loop — no burst spread measured
     )
 
 
@@ -332,6 +515,12 @@ def main() -> None:
         ("FJLT bf16", lambda: bench_fjlt(on_tpu, jnp.bfloat16, 5.9, table)),
         ("FJLT f32", lambda: bench_fjlt(on_tpu, jnp.float32, 44.8, table)),
         ("CWT", lambda: bench_cwt(on_tpu, table)),
+        ("MMT", lambda: bench_mmt(on_tpu, table)),
+        ("FastRFT bf16", lambda: bench_frft(on_tpu, jnp.bfloat16, 16.1, table)),
+        ("FastRFT f32", lambda: bench_frft(on_tpu, jnp.float32, 51.2, table)),
+        ("PPT bf16", lambda: bench_ppt(on_tpu, jnp.bfloat16, 70.7, table)),
+        ("PPT f32", lambda: bench_ppt(on_tpu, jnp.float32, 149.4, table)),
+        ("sparse CWT", lambda: bench_sparse_cwt(on_tpu, table)),
         ("ridge", lambda: bench_ridge(on_tpu, table)),
         ("ADMM", lambda: bench_admm(on_tpu, table)),
         ("streaming SVD", lambda: bench_streaming_svd(on_tpu, table)),
@@ -340,22 +529,25 @@ def main() -> None:
         try:
             fn()
         except Exception as e:  # noqa: BLE001 — report, don't abort
-            _emit(f"{name} (FAILED: {type(e).__name__})", -1, "error", 0, table)
+            _emit(
+                f"{name} (FAILED: {type(e).__name__})", -1, "error", 0, table,
+                contention=None,
+            )
 
     tflops, _ = bench_jlt(on_tpu, table)
     peak = _peak_tflops(dev)
-    print(
-        json.dumps(
-            {
-                "metric": "JLT dense sketch-apply throughput",
-                "value": round(tflops, 3),
-                "unit": "TFLOP/s/chip",
-                "vs_baseline": round(tflops / peak, 4),
-                "submetrics": table,
-            }
-        ),
-        flush=True,
-    )
+    headline = {
+        "metric": "JLT dense sketch-apply throughput",
+        "value": round(tflops, 3),
+        "unit": "TFLOP/s/chip",
+        "vs_baseline": round(tflops / peak, 4),
+        "submetrics": table,
+    }
+    if _LAST_CONTENTION is not None:
+        # burst-to-burst marginal spread of the headline measurement
+        # itself: ≤0.05 = quiet capture; larger explains a low MFU.
+        headline["contention"] = _LAST_CONTENTION
+    print(json.dumps(headline), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,111 @@
+"""North-star single-chip run: 10M x 4096 random-feature KRR, bf16,
+rows AND features streamed (ml/krr.py::streaming_kernel_ridge).
+
+Two variants (both honest, measuring different bounds):
+- "hot-panel": one resident 250k x 4096 bf16 panel reused for every
+  logical row panel — data content repeats, compute/memory contract is
+  exactly the 10M-row sweep.  Measures the COMPUTE path's s/sweep + MFU.
+- "generated": every panel counter-generated (Box-Muller) per visit —
+  true streamed synthetic data; generation-bound, like the streaming-SVD
+  benchmark (BASELINE.md round 1 notes), a real IO-streamed workload
+  would be storage-bound the same way.
+
+Run: python experiments/northstar_krr.py [hot|gen] [sweeps]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from libskylark_tpu import SketchContext
+from libskylark_tpu.core.random import sample_window
+from libskylark_tpu.ml import GaussianKernel, KrrParams, streaming_kernel_ridge
+
+N, D, S = 10_000_000, 4096, 2048
+BR = 125_000  # 80 panels
+LAM = 0.1
+
+
+def main():
+    variant = sys.argv[1] if len(sys.argv) > 1 else "hot"
+    sweeps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    max_split = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+
+    ctx_data = SketchContext(seed=71)
+    base = ctx_data.reserve(N * D)
+
+    block_args = ()
+    if variant == "hot":
+        # Generate the resident panel in slices: a single (BR, D)
+        # Box-Muller draw transiently allocates several x its output.
+        gen = jax.jit(
+            lambda s0: sample_window(
+                "normal", ctx_data.seed, base, (N, D),
+                offset=(s0, 0), shape=(BR // 10, D), dtype=jnp.bfloat16,
+            )
+        )
+        X0 = jax.block_until_ready(
+            jnp.concatenate([gen(jnp.int32(i * BR // 10)) for i in range(10)])
+        )
+
+        def block_fn(start, rows, X0):
+            # Panel content must VARY with the panel index: a loop-
+            # invariant return lets XLA hoist the whole feature
+            # computation out of the panel fori_loop (measured "167%
+            # MFU" — LICM, not compute).  A bf16-representable per-panel
+            # scale (1 + p/256) defeats hoisting for one extra HBM pass,
+            # the same traffic a real IO-streamed panel would cost.
+            scale = (jnp.float32(1.0)
+                     + (start // rows).astype(jnp.float32) / 256.0)
+            return X0 * scale.astype(jnp.bfloat16)
+
+        block_args = (X0,)
+    else:
+        def block_fn(start, rows):
+            return sample_window(
+                "normal", ctx_data.seed, base, (N, D),
+                offset=(start, 0), shape=(rows, D), dtype=jnp.bfloat16,
+            )
+
+    # Labels: cheap synthetic (sign of a fixed random projection of the
+    # first panel pattern) — content does not matter for the timing.
+    y = jax.block_until_ready(
+        jnp.asarray(
+            np.sign(np.random.default_rng(0).standard_normal(N)), jnp.float32
+        )
+    )
+
+    kernel = GaussianKernel(D, sigma=8.0)
+    params = KrrParams(max_split=max_split, iter_lim=sweeps, tolerance=0.0)
+
+    from libskylark_tpu.utils import PhaseTimer
+
+    timer = PhaseTimer()
+    t0 = time.perf_counter()
+    model = streaming_kernel_ridge(
+        kernel, block_fn, (N, D), y, LAM, S, SketchContext(seed=72),
+        params, block_rows=BR, feature_dtype=jnp.bfloat16,
+        block_args=block_args, timer=timer,
+    )
+    jax.block_until_ready(model.W)
+    total = time.perf_counter() - t0
+    per_sweep = timer.totals["sweep"] / timer.counts["sweep"]
+    print(timer.report())
+
+    # Dominant matmul flops per sweep: 2 panel passes per chunk, each
+    # applying the chunk's feature map (2*n*d*sz) + the small Z-R ops.
+    flops = 4 * N * D * S  # = 2 passes * 2*N*D*S total feature flops
+    mfu = flops / per_sweep / 197e12
+    print(f"variant={variant} sweeps={sweeps}")
+    print(f"total (incl compile + sweep0): {total:.1f} s")
+    print(f"steady: {per_sweep:.2f} s/sweep, "
+          f"feature-matmul MFU {mfu*100:.1f}% of v5e bf16 peak")
+
+
+if __name__ == "__main__":
+    main()

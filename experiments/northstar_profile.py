@@ -1,0 +1,98 @@
+"""Anatomy of one streaming-KRR panel pass on v5e: where the s/sweep
+beyond the 1.7 s matmul roofline goes."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from libskylark_tpu import SketchContext
+from libskylark_tpu.ml import GaussianKernel
+from libskylark_tpu.sketch.base import Dimension
+
+N, D, SZ, BR = 10_000_000, 4096, 2048, 125_000
+NB = N // BR
+
+
+def timed(f, *a):
+    t0 = time.perf_counter()
+    np.asarray(f(*a))
+    return time.perf_counter() - t0
+
+
+def bench(name, build, *args, reps=3):
+    f = jax.jit(build)
+    timed(f, *args)
+    t = min(timed(f, *args) for _ in range(reps))
+    print(f"{name}: {t:.3f} s", flush=True)
+    return t
+
+
+def main():
+    kernel = GaussianKernel(D, sigma=8.0)
+    fmap = kernel.create_rft(SZ, "regular", SketchContext(seed=72))
+    X0 = jax.block_until_ready(
+        jax.random.normal(jax.random.PRNGKey(0), (BR, D), jnp.bfloat16))
+    R = jax.block_until_ready(
+        jax.random.normal(jax.random.PRNGKey(1), (N, 1), jnp.float32))
+
+    # (a) pure panel matmuls, no feature map: X0 @ Wfixed
+    Wf = jax.block_until_ready(
+        jax.random.normal(jax.random.PRNGKey(2), (D, SZ), jnp.bfloat16))
+
+    def pure_mm(X0, Wf):
+        def body(p, acc):
+            scale = (jnp.float32(1.0) + p.astype(jnp.float32) / 256.0)
+            Xp = X0 * scale.astype(jnp.bfloat16)
+            Zp = jax.lax.dot_general(Xp, Wf, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            return acc + jnp.sum(jnp.abs(Zp[:, :8]))
+        return jax.lax.fori_loop(0, NB, body, jnp.zeros((), jnp.float32))
+
+    bench("a) 80 panels scale+matmul only", pure_mm, X0, Wf)
+
+    # (b) + cos epilogue in bf16 (the RFT output)
+    def mm_cos(X0, Wf):
+        def body(p, acc):
+            scale = (jnp.float32(1.0) + p.astype(jnp.float32) / 256.0)
+            Xp = X0 * scale.astype(jnp.bfloat16)
+            Zp = jax.lax.dot_general(Xp, Wf, (((1,), (0,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            Zp = jnp.cos(Zp).astype(jnp.bfloat16)
+            return acc + jnp.sum(jnp.abs(Zp[:, :8].astype(jnp.float32)))
+        return jax.lax.fori_loop(0, NB, body, jnp.zeros((), jnp.float32))
+
+    bench("b) + cos epilogue", mm_cos, X0, Wf)
+
+    # (c) the real feature map (counter-realized W per panel) + Zp @ Rp
+    def real_pass(X0, R):
+        def body(p, acc):
+            scale = (jnp.float32(1.0) + p.astype(jnp.float32) / 256.0)
+            Xp = X0 * scale.astype(jnp.bfloat16)
+            Zp = fmap.apply(Xp, Dimension.ROWWISE).T  # (SZ, BR)
+            Rp = jax.lax.dynamic_slice(R, (p * BR, 0), (BR, 1))
+            return acc + jnp.dot(Zp.astype(jnp.float32), Rp,
+                                 precision="highest")
+        return jax.lax.fori_loop(0, NB, body,
+                                 jnp.zeros((SZ, 1), jnp.float32))
+
+    bench("c) real feature map + Zp@Rp", real_pass, X0, R)
+
+    # (d) feature map WITHOUT the .T (layout probe)
+    def real_pass_noT(X0, R):
+        def body(p, acc):
+            scale = (jnp.float32(1.0) + p.astype(jnp.float32) / 256.0)
+            Xp = X0 * scale.astype(jnp.bfloat16)
+            Zp = fmap.apply(Xp, Dimension.ROWWISE)  # (BR, SZ)
+            Rp = jax.lax.dynamic_slice(R, (p * BR, 0), (BR, 1))
+            return acc + jnp.dot(Zp.T.astype(jnp.float32), Rp,
+                                 precision="highest")
+        return jax.lax.fori_loop(0, NB, body,
+                                 jnp.zeros((SZ, 1), jnp.float32))
+
+    bench("d) same, transpose at use site", real_pass_noT, X0, R)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,107 @@
+"""Round-3 probe: input-sparsity-time hash sketches at scale + MMT/WZT
+dense f32 (VERDICT r2 item 2).
+
+Sparse config: BCOO 1e6 x 1e5, 1e8 nnz, CWT/SJLT columnwise -> BCOO.
+Dense config: MMT/WZT f32 at the CWT bench shape 131072 x 4096 -> 1024.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from libskylark_tpu.core.context import SketchContext
+from libskylark_tpu.sketch.hash import CWT, MMT, SJLT, WZT
+
+
+def _timed(fn, *args):
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(*args))
+    return time.perf_counter() - t0
+
+
+def _timed_np(fn, *args):
+    t0 = time.perf_counter()
+    np.asarray(fn(*args))
+    return time.perf_counter() - t0
+
+
+def rep_diff(build, args, r1=1, r2=3, rounds=6):
+    f1, f2 = build(r1), build(r2)
+    _timed_np(f1, *args), _timed_np(f2, *args)
+    t1s, t2s = [], []
+    for _ in range(rounds):
+        t1s.append(_timed_np(f1, *args))
+        t2s.append(_timed_np(f2, *args))
+    t1, t2 = min(t1s), min(t2s)
+    return float("nan") if t2 <= t1 else (t2 - t1) / (r2 - r1)
+
+
+def random_bcoo(n, m, nnz, seed=0):
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    rows = jax.random.randint(k1, (nnz,), 0, n, dtype=jnp.int32)
+    cols = jax.random.randint(k2, (nnz,), 0, m, dtype=jnp.int32)
+    data = jax.random.normal(k3, (nnz,), jnp.float32)
+    idx = jnp.stack([rows, cols], axis=1)
+    return jsparse.BCOO((data, idx), shape=(n, m))
+
+
+def sparse_apply(cls, kw, n, m, s, nnz):
+    A = random_bcoo(n, m, nnz)
+    jax.block_until_ready((A.data, A.indices))
+
+    def build(reps):
+        ctx = SketchContext(seed=21)
+        sketches = [cls(n, s, ctx, **kw) for _ in range(reps)]
+
+        @jax.jit
+        def run(data, idx):
+            A = jsparse.BCOO((data, idx), shape=(n, m))
+            acc = jnp.zeros((), jnp.float32)
+            for S in sketches:
+                out = S.apply(A, "columnwise")
+                acc += jnp.sum(jnp.abs(out.data))
+            return acc
+
+        return run
+
+    return rep_diff(build, (A.data, A.indices))
+
+
+def dense_apply(cls, kw, n, m, s, dtype):
+    A = jax.random.normal(jax.random.PRNGKey(2), (n, m), dtype)
+
+    def build(reps):
+        ctx = SketchContext(seed=29)
+        sketches = [cls(n, s, ctx, **kw) for _ in range(reps)]
+
+        @jax.jit
+        def run(A):
+            acc = jnp.zeros((), jnp.float32)
+            for S in sketches:
+                acc += jnp.sum(jnp.abs(S.apply(A, "columnwise").astype(jnp.float32)))
+            return acc
+
+        return run
+
+    return rep_diff(build, (A,), r1=2, r2=6)
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("all", "dense"):
+        for cls, kw in ((MMT, {}), (WZT, {"p": 1.5})):
+            t = dense_apply(cls, kw, 131_072, 4096, 1024, jnp.float32)
+            print(f"{cls.__name__} dense f32 131072x4096->1024: {t*1e3:.2f} ms",
+                  flush=True)
+    if which in ("all", "sparse"):
+        for nnz in (10_000_000, 100_000_000):
+            for cls, kw in ((CWT, {}), (SJLT, {"nnz": 4})):
+                t = sparse_apply(cls, kw, 1_000_000, 100_000, 1024, nnz)
+                print(f"{cls.__name__} BCOO 1e6x1e5 nnz={nnz:.0e} -> 1024: "
+                      f"{t*1e3:.2f} ms", flush=True)

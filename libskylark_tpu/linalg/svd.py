@@ -65,12 +65,16 @@ def gram_orth(Y, passes: int = 2):
     dropped by the rank-k truncation downstream.
     """
     for _ in range(passes):
-        G = fully_replicated(Y.T @ Y)
+        # precision='highest' is load-bearing on BOTH products: the TPU
+        # MXU default truncates f32 operands to bf16 mantissas, which
+        # caps the achievable orthogonality at ~2e-3 no matter how many
+        # passes run (caught by tests/test_pallas_hw.py round 3).
+        G = fully_replicated(jnp.dot(Y.T, Y, precision="highest"))
         lam, V = jnp.linalg.eigh(G)
         eps = jnp.asarray(jnp.finfo(Y.dtype).eps, G.dtype)
         floor = jnp.maximum(lam[-1], 0) * eps * G.shape[0]
         scale = jnp.where(lam > floor, jax.lax.rsqrt(jnp.maximum(lam, floor)), 0.0)
-        Y = Y @ (V * scale[None, :])
+        Y = jnp.dot(Y, V * scale[None, :], precision="highest")
     return Y
 
 
@@ -134,10 +138,19 @@ def approximate_svd(
     Q = Y if (params.num_iterations > 0 and not params.skip_qr) else _orth(Y)
 
     # B = Aᵀ·Q (n, s); small SVD; rotate back (nla/svd.hpp:266-285).
-    B = fully_replicated(A.T @ Q)
+    # Both products pinned: the MXU default would put ~2e-3 (bf16) error
+    # into the singular values (via B) and U's orthogonality (via the
+    # rotation) on hardware.  The power-iteration sweep above keeps the
+    # fast default — it only steers the subspace.
+    # (BCOO has no precision knob and does not ride the MXU bf16 path —
+    # its matmul keeps the sparse dispatch.)
+    AtQ = A.T @ Q if hasattr(A, "todense") else jnp.dot(
+        A.T, Q, precision="highest"
+    )
+    B = fully_replicated(AtQ)
     W, sv, Zt = jnp.linalg.svd(B, full_matrices=False)  # B = W·sv·Zt
     # A ≈ Q·Bᵀ = (Q·Ztᵀ)·diag(sv)·Wᵀ
-    U = Q @ Zt.T
+    U = jnp.dot(Q, Zt.T, precision="highest")
     return U[:, :k], sv[:k], W[:, :k]
 
 
@@ -165,13 +178,17 @@ def approximate_symmetric_svd(
     Y = power_iteration(A, Y, params.num_iterations, not params.skip_qr)
     Q = Y if (params.num_iterations > 0 and not params.skip_qr) else _orth(Y)
 
-    # Rayleigh-Ritz on the subspace (≙ nla/svd.hpp:360-380).
-    T = fully_replicated(Q.T @ (A @ Q))
+    # Rayleigh-Ritz on the subspace (≙ nla/svd.hpp:360-380); pinned —
+    # T's error lands directly in the eigenvalues and V's orthogonality.
+    AQ = A @ Q if hasattr(A, "todense") else jnp.dot(
+        A, Q, precision="highest"
+    )
+    T = fully_replicated(jnp.dot(Q.T, AQ, precision="highest"))
     T = (T + T.T) / 2
     lam, W = jnp.linalg.eigh(T)
     order = jnp.argsort(-jnp.abs(lam))
     lam = lam[order][:k]
-    V = (Q @ W)[:, order[:k]]
+    V = jnp.dot(Q, W, precision="highest")[:, order[:k]]
     return V, lam
 
 
@@ -361,9 +378,14 @@ def streaming_approximate_svd(
         # magnitude; forming T1·T2 mixes those scales before the O(1)
         # whitening of Y·T1 happens, and the associativity error destroys
         # Q's orthonormality.  Apply left-to-right: ((Y·T1)·T2)·Ub.
-        B = T2.T @ (T1.T @ M)  # = Qᵀ·A  (s, n)
+        # precision='highest' on the small factor products too: a
+        # default-precision (bf16-mantissa) rot2 alone puts ~4e-3 of
+        # non-orthogonality into U on hardware (round-3 hw guard).
+        B = jnp.dot(
+            T2.T, jnp.dot(T1.T, M, precision="highest"), precision="highest"
+        )  # = Qᵀ·A  (s, n)
         Ub, sv, Vt = jnp.linalg.svd(B, full_matrices=False)
-        rot2 = T2 @ Ub[:, :k]  # Q·Ub = (Y·T1)·rot2 = U
+        rot2 = jnp.dot(T2, Ub[:, :k], precision="highest")  # (Y·T1)·rot2 = U
         return Omq, T1, rot2, sv[:k], Vt[:k].T
 
     Omq, T1, rot2, sv, V = _power_and_factor()

@@ -27,6 +27,7 @@ from .krr import (
     faster_kernel_ridge,
     kernel_ridge,
     large_scale_kernel_ridge,
+    streaming_kernel_ridge,
     sketched_approximate_kernel_ridge,
 )
 from .model import FeatureMapModel, KernelModel, load_model
@@ -52,6 +53,7 @@ __all__ = [
     "sketched_approximate_kernel_ridge",
     "faster_kernel_ridge",
     "large_scale_kernel_ridge",
+    "streaming_kernel_ridge",
     "kernel_rlsc",
     "approximate_kernel_rlsc",
     "sketched_approximate_kernel_rlsc",

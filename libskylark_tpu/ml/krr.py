@@ -46,6 +46,7 @@ __all__ = [
     "sketched_approximate_kernel_ridge",
     "faster_kernel_ridge",
     "large_scale_kernel_ridge",
+    "streaming_kernel_ridge",
 ]
 
 
@@ -64,12 +65,16 @@ class KrrParams(Params):
 
 
 def _psd_gram(A, B):
-    """Gram products feeding a Cholesky run at ``precision='highest'``:
-    TPU's default f32 matmul passes through bf16, whose error can push
-    ``ZᵀZ + λI`` indefinite for small λ (cho_factor then yields silent
-    NaNs).  bf16 inputs are unaffected (their f32 accumulation is exact,
-    so the computed Gram is exactly PSD) and keep full MXU rate."""
-    return jnp.dot(A, B, precision="highest")
+    """Gram products feeding a Cholesky run at ``precision='highest'``
+    with ≥f32 OUTPUT: TPU's default f32 matmul passes through bf16,
+    whose error can push ``ZᵀZ + λI`` indefinite for small λ (cho_factor
+    then yields silent NaNs).  bf16 inputs keep full MXU rate — their
+    products accumulate exactly in f32 — but the result must NOT round
+    back to bf16 (a bf16 Gram re-introduces the same ~2e-3 hazard at the
+    output; round-3 review finding), so the accumulator dtype is pinned.
+    """
+    acc = jnp.promote_types(A.dtype, jnp.float32)
+    return jnp.dot(A, B, precision="highest", preferred_element_type=acc)
 
 
 def _as2d(Y):
@@ -126,7 +131,10 @@ def approximate_kernel_ridge(
     if params.sketched_rr:
         return _solve_sketched_ridge(S, Z, Y2, lam, s, context, params)
     G = fully_replicated(_psd_gram(Z.T, Z) + lam * jnp.eye(s, dtype=Z.dtype))
-    W = cho_solve(cho_factor(G, lower=True), Z.T @ Y2)
+    # Factor/solve in _psd_gram's ≥f32 accumulator dtype; the model's
+    # coefficient dtype stays the feature dtype (API contract — bf16
+    # features must not silently return an f32 model).
+    W = cho_solve(cho_factor(G, lower=True), Z.T @ Y2).astype(Z.dtype)
     return FeatureMapModel([S], W)
 
 
@@ -139,7 +147,7 @@ def _solve_sketched_ridge(S, Z, Y2, lam, s, context, params):
     SZ = R.apply(Z, Dimension.COLUMNWISE)  # (t, s)
     SY = R.apply(Y2, Dimension.COLUMNWISE)  # (t, k)
     G = fully_replicated(_psd_gram(SZ.T, SZ) + lam * jnp.eye(s, dtype=Z.dtype))
-    W = cho_solve(cho_factor(G, lower=True), SZ.T @ SY)
+    W = cho_solve(cho_factor(G, lower=True), SZ.T @ SY).astype(Z.dtype)
     return FeatureMapModel([S], W)
 
 
@@ -166,7 +174,11 @@ class _FeatureMapPrecond:
             jnp.eye(s, dtype=U.dtype) + _psd_gram(U, U.T) / lam
         )
         L = jnp.linalg.cholesky(C)
-        self.U = solve_triangular(L, U, lower=True) / lam
+        # Solve in C's ≥f32 dtype, store Ũ back in the feature dtype —
+        # the (s, n) buffer is the precond's memory footprint.
+        self.U = (solve_triangular(L, U.astype(C.dtype), lower=True) / lam).astype(
+            U.dtype
+        )
         self.lam = lam
 
     def apply(self, B):
@@ -207,6 +219,21 @@ def faster_kernel_ridge(
     return model
 
 
+def _chunk_sizes(d: int, s: int, params: KrrParams) -> list[int]:
+    """Feature-chunk sizes (≙ krr.hpp:573-592) — ONE implementation
+    shared by the large-scale and streaming solvers: both build their
+    feature maps from the same context, so identical chunking is what
+    keeps their counter streams (and trained models) interchangeable."""
+    sinc = d if params.max_split == 0 else max(1, params.max_split // 2)
+    sizes = []
+    remains = s
+    while remains > 0:
+        this = remains if remains <= 2 * sinc else sinc
+        sizes.append(this)
+        remains -= this
+    return sizes
+
+
 def large_scale_kernel_ridge(
     kernel: Kernel,
     X,
@@ -229,14 +256,7 @@ def large_scale_kernel_ridge(
     Y2, _ = _as2d(Y)
     n, d = X.shape
 
-    # Chunk sizes (krr.hpp:573-592).
-    sinc = d if params.max_split == 0 else max(1, params.max_split // 2)
-    sizes = []
-    remains = s
-    while remains > 0:
-        this = remains if remains <= 2 * sinc else sinc
-        sizes.append(this)
-        remains -= this
+    sizes = _chunk_sizes(d, s, params)
     maps = [kernel.create_rft(sz, _tag(params), context) for sz in sizes]
 
     # Memory-bounded by construction: each chunk's Z is recomputed from
@@ -267,7 +287,9 @@ def large_scale_kernel_ridge(
         Lc = cho_factor(G, lower=True)
         factors.append(Lc)
         ZR = Z @ R - lam_ * Ws[c]
-        delta = cho_solve(Lc, ZR)
+        # cast back: the f32 factor solve must not promote the resident
+        # (n, t) R / Ws state out of the feature dtype (memory contract)
+        delta = cho_solve(Lc, ZR).astype(dtype)
         Ws[c] = Ws[c] + delta
         R = R - Z.T @ delta
         # Same one-chunk memory contract as the later sweeps: block until
@@ -286,7 +308,7 @@ def large_scale_kernel_ridge(
             Z = None  # release chunk c-1 before materializing chunk c
             Z = chunk_Z(c)
             ZR = Z @ R - lam_ * Ws[c]
-            delta = cho_solve(factors[c], ZR)
+            delta = cho_solve(factors[c], ZR).astype(dtype)
             Ws[c] = Ws[c] + delta
             R = R - Z.T @ delta
             delsize += float(jnp.sum(delta * delta))
@@ -296,6 +318,188 @@ def large_scale_kernel_ridge(
         reldel = (delsize**0.5) / max(wnorm, 1e-30)
         params.log(2, f"iteration {it}, relupdate = {reldel:.2e}")
         if reldel < params.tolerance:
+            break
+
+    W = jnp.concatenate(Ws, axis=0)
+    return FeatureMapModel(maps, W)
+
+
+def streaming_kernel_ridge(
+    kernel: Kernel,
+    block_fn,
+    shape: tuple[int, int],
+    Y,
+    lam: float,
+    s: int,
+    context: SketchContext,
+    params: KrrParams | None = None,
+    block_rows: int = 262_144,
+    feature_dtype=jnp.bfloat16,
+    block_args: tuple = (),
+    timer=None,
+):
+    """Row-streamed block coordinate descent: the single-chip face of the
+    10M×4K north-star shape.
+
+    ``block_args``: extra device arrays threaded into ``block_fn(start,
+    rows, *block_args)`` as REAL jit arguments.  A ``block_fn`` that
+    closes over a large device array instead would be embedded as a
+    compile-time constant (and round-tripped through the host — an OOM /
+    HTTP-413 on the axon tunnel); counter-generated sources need none.
+
+    ``timer``: optional ``utils.PhaseTimer`` — sweep 0 (which absorbs
+    the per-chunk program compiles and factorizations) lands in phase
+    ``"sweep0"``, steady sweeps in ``"sweep"`` (the ADMM solver's
+    phase-timer convention; lets benchmarks read the marginal s/sweep
+    without compile-cancellation tricks).
+
+    ``large_scale_kernel_ridge`` (≙ krr.hpp:546-727) bounds memory in the
+    FEATURE direction but keeps X — and each chunk's (n, sz) Z — resident;
+    at 10M×4096 neither fits one chip (X alone is 80 GB in bf16).  Here
+    the EXAMPLES direction streams too: ``block_fn(start_row, rows)``
+    yields X row panels (jit-traceable with a traced start, like the
+    streaming-SVD contract), each chunk's features are regenerated per
+    panel inside a ``fori_loop``, and only O(panel·max(d, sz)) feature
+    memory plus the (n, t) residual R is ever resident.  Per sweep each
+    chunk makes two panel passes (accumulate ZR = Z_c·R, then apply
+    R ← R − Z_cᵀ·δ) — the BCD update equations are exactly
+    ``large_scale_kernel_ridge``'s.
+
+    The reference reaches this scale by spreading X over MPI ranks
+    (krr.hpp:546's Elemental [MC,MR] X); one TPU chip instead re-reads
+    the counter stream / storage.  Multi-chip runs shard the panels with
+    ``mesh`` machinery upstream (see ``__graft_entry__.dryrun_multichip``).
+    """
+    params = params or KrrParams()
+    n, d = shape
+    if n % block_rows:
+        # Largest divisor of n not exceeding the request: callers get a
+        # working panel size instead of a divisibility error (the panel
+        # size only shapes memory, not results).  A degenerate divisor
+        # (n near-prime) would turn the panel loops into per-row
+        # iteration — error out with an actionable message instead.
+        best = max(b for b in range(1, block_rows + 1) if n % b == 0)
+        if best < max(256, block_rows // 16):
+            raise ValueError(
+                f"n={n} has no usable panel divisor <= {block_rows} "
+                f"(best is {best}); pad n to a composite size or pass a "
+                "block_rows that divides it"
+            )
+        block_rows = best
+    nb = n // block_rows
+    Y2, _ = _as2d(Y)
+    t = Y2.shape[1]
+
+    sizes = _chunk_sizes(d, s, params)
+    maps = [kernel.create_rft(sz, _tag(params), context) for sz in sizes]
+    lam_ = jnp.float32(lam)
+
+    def chunk_Zp(c, start, bargs):
+        """(block_rows, sz) feature panel of chunk c, built in-graph.
+        Natural rowwise layout: every consumer contracts it with
+        ``dot_general`` directly — materializing a transpose (or an
+        astype-to-f32 copy) of the panel costs ~3 extra HBM passes per
+        visit, measured ~2.3 s/sweep-pass at the 10M×4096 shape."""
+        Xp = block_fn(start, block_rows, *bargs).astype(feature_dtype)
+        return maps[c].apply(Xp, Dimension.ROWWISE)
+
+    # Per-chunk jitted programs (static chunk index → static sz).  The
+    # panel loops are fori_loops: one compile per chunk, not per panel.
+    def make_programs(c):
+        # All contractions consume the (block_rows, sz) panel in place
+        # via dot_general with an f32 preferred_element_type: bf16
+        # panels contract at MXU rate with exact-f32 accumulation and
+        # are never rounded back (the _psd_gram hazard) nor upcast into
+        # a materialized f32 copy.  precision='highest' pins the f32/f64
+        # feature case.
+
+        def _prec(dtype):
+            return None if dtype == jnp.bfloat16 else "highest"
+
+        @jax.jit
+        def gram(*bargs):
+            def body(p, G):
+                Zp = chunk_Zp(c, p * block_rows, bargs)
+                blk = jax.lax.dot_general(
+                    Zp, Zp, (((0,), (0,)), ((), ())),
+                    precision=_prec(Zp.dtype),
+                    preferred_element_type=jnp.float32,
+                )
+                return G + blk
+
+            G = jax.lax.fori_loop(
+                0, nb, body, jnp.zeros((sizes[c], sizes[c]), jnp.float32)
+            )
+            return G + lam_ * jnp.eye(sizes[c], dtype=jnp.float32)
+
+        @jax.jit
+        def zr(R, Wc, *bargs):
+            def body(p, acc):
+                Zp = chunk_Zp(c, p * block_rows, bargs)
+                Rp = jax.lax.dynamic_slice(
+                    R, (p * block_rows, 0), (block_rows, t)
+                )
+                return acc + jax.lax.dot_general(
+                    Zp, Rp, (((0,), (0,)), ((), ())),
+                    precision=_prec(Zp.dtype),
+                    preferred_element_type=jnp.float32,
+                )
+
+            acc0 = jnp.zeros((sizes[c], t), jnp.float32)
+            return jax.lax.fori_loop(0, nb, body, acc0) - lam_ * Wc
+
+        @jax.jit
+        def apply_delta(R, delta, *bargs):
+            def body(p, R):
+                Zp = chunk_Zp(c, p * block_rows, bargs)
+                upd = jax.lax.dot_general(
+                    Zp, delta.astype(Zp.dtype), (((1,), (0,)), ((), ())),
+                    precision=_prec(Zp.dtype),
+                    preferred_element_type=jnp.float32,
+                )
+                Rp = jax.lax.dynamic_slice(
+                    R, (p * block_rows, 0), (block_rows, t)
+                )
+                return jax.lax.dynamic_update_slice(
+                    R, Rp - upd, (p * block_rows, 0)
+                )
+
+            return jax.lax.fori_loop(0, nb, body, R)
+
+        return gram, zr, apply_delta
+
+    programs = [make_programs(c) for c in range(len(maps))]
+    factors = []
+    Ws = [jnp.zeros((sz, t), jnp.float32) for sz in sizes]
+    R = Y2.astype(jnp.float32)
+
+    import contextlib
+
+    # Sweep 0 is unconditional (factors must exist), matching
+    # large_scale_kernel_ridge's loop structure where the first sweep
+    # runs outside the iteration count — iter_lim=0 means "one pass".
+    for it in range(max(params.iter_lim, 1)):
+        phase = (
+            timer.phase("sweep0" if it == 0 else "sweep")
+            if timer is not None
+            else contextlib.nullcontext()
+        )
+        with phase as ph:
+            delsize = 0.0
+            for c, (gram, zr, apply_delta) in enumerate(programs):
+                if it == 0:
+                    factors.append(cho_factor(gram(*block_args), lower=True))
+                ZR = zr(R, Ws[c], *block_args)
+                delta = cho_solve(factors[c], ZR)
+                Ws[c] = Ws[c] + delta
+                R = apply_delta(R, delta, *block_args)
+                delsize += float(jnp.sum(delta * delta))
+            if ph is not None:
+                ph.result = R
+        wnorm = float(jnp.sqrt(sum(jnp.sum(W * W) for W in Ws)))
+        reldel = (delsize**0.5) / max(wnorm, 1e-30)
+        params.log(2, f"iteration {it}, relupdate = {reldel:.2e}")
+        if it > 0 and reldel < params.tolerance:
             break
 
     W = jnp.concatenate(Ws, axis=0)

@@ -258,8 +258,11 @@ static double sk_radical_inverse(long base, unsigned long long idx) {
 }
 
 // ---------------------------------------------------------------------------
-// Radix-2 complex FFT matching jnp.fft.fft's sign convention
-// (X_k = sum_n x_n e^{-2*pi*i*n*k/N}); PPT requires pow2 S.
+// Complex FFT matching jnp.fft.fft's sign convention
+// (X_k = sum_n x_n e^{-2*pi*i*n*k/N}).  sk_fft is the radix-2 kernel;
+// sk_fft_any extends it to ARBITRARY length via Bluestein's chirp-z
+// (round 3 — removes the former pow2-S restriction on native PPT, whose
+// FFTW-backed reference handles any S).
 // ---------------------------------------------------------------------------
 
 static void sk_fft(std::complex<double>* x, long nfft, bool inverse) {
@@ -286,6 +289,53 @@ static void sk_fft(std::complex<double>* x, long nfft, bool inverse) {
     }
     if (inverse)
         for (long i = 0; i < nfft; i++) x[i] /= (double)nfft;
+}
+
+static long sk_next_pow2(long n);  // defined below
+
+// Bluestein chirp-z: length-n DFT as a pow2 circular convolution.
+// X_k = w_k * IFFT(FFT(x.w padded) * FFT(chirp))_k with w_k =
+// e^{-pi i k^2/n}; k^2 is reduced mod 2n (the chirp's true period)
+// before the angle computation so large n keeps full double-precision
+// phase accuracy.  inverse rides the conj identity ifft(x) =
+// conj(fft(conj(x)))/n.
+static void sk_fft_any(std::complex<double>* x, long n, bool inverse) {
+    if (n <= 1) return;
+    if ((n & (n - 1)) == 0) {
+        sk_fft(x, n, inverse);
+        return;
+    }
+    if (inverse) {
+        for (long i = 0; i < n; i++) x[i] = std::conj(x[i]);
+        sk_fft_any(x, n, false);
+        for (long i = 0; i < n; i++) x[i] = std::conj(x[i]) / (double)n;
+        return;
+    }
+    const long m = sk_next_pow2(2 * n - 1);
+    // The chirp table and FFT(b) depend only on n: cache them
+    // per-thread (PPT applies call this q+1 times per column under the
+    // OpenMP loop — rebuilding them per call would double the FFT work).
+    thread_local long plan_n = -1;
+    thread_local std::vector<std::complex<double>> w, Bf;
+    if (plan_n != n) {
+        w.assign(n, {});
+        Bf.assign(m, {});
+        for (long k = 0; k < n; k++) {
+            long long k2 = ((long long)k * k) % (2LL * n);
+            double ang = -M_PI * (double)k2 / (double)n;
+            w[k] = std::complex<double>(std::cos(ang), std::sin(ang));
+        }
+        Bf[0] = std::conj(w[0]);
+        for (long k = 1; k < n; k++) Bf[k] = Bf[m - k] = std::conj(w[k]);
+        sk_fft(Bf.data(), m, false);
+        plan_n = n;
+    }
+    std::vector<std::complex<double>> a(m);
+    for (long k = 0; k < n; k++) a[k] = x[k] * w[k];
+    sk_fft(a.data(), m, false);
+    for (long i = 0; i < m; i++) a[i] *= Bf[i];
+    sk_fft(a.data(), m, true);
+    for (long k = 0; k < n; k++) x[k] = a[k] * w[k];
 }
 
 struct sl_sketch_t {
@@ -445,7 +495,7 @@ int sl_create_sketch_transform_ex(void* ctx_, const char* type, long n,
         // zero-means-default coercion here (unlike sigma/beta, where 0 is
         // invalid).  q=0 is invalid, so 0 selects the reference default.
         long q = (long)(param3 != 0.0 ? param3 : 3.0);
-        if (q < 1 || s != sk_next_pow2(s)) { delete t; return 104; }
+        if (q < 1 || s < 1) { delete t; return 104; }
         t->nb = q;  // PPT stashes q here
     }
     t->seed = ctx->seed;
@@ -737,7 +787,7 @@ static void sk_apply_qmc_cw(const sl_sketch_t* t, const double* A, long m,
 }
 
 // PPT / TensorSketch columnwise (≙ sketch/ppt.py): q CountSketches
-// composed in the FFT domain; requires pow2 S (radix-2 FFT).
+// composed in the FFT domain; any S (Bluestein for non-pow2).
 static void sk_apply_ppt_cw(const sl_sketch_t* t, const double* A, long m,
                             double* out) {
     const long n = t->n, s = t->s, q = (long)t->nb;
@@ -777,10 +827,10 @@ static void sk_apply_ppt_cw(const sl_sketch_t* t, const double* A, long m,
                     W[buckets[l * n + i]] +=
                         sqrt_g * values[l * n + i] * A[i * m + c];
                 W[hidx[l]] += sqrt_c * hval[l];
-                sk_fft(W.data(), s, false);
+                sk_fft_any(W.data(), s, false);
                 for (long k = 0; k < s; k++) P[k] *= W[k];
             }
-            sk_fft(P.data(), s, true);
+            sk_fft_any(P.data(), s, true);
             for (long k = 0; k < s; k++) out[k * m + c] = P[k].real();
         }
     }

@@ -14,6 +14,7 @@ invariant must be enforced by hand.
 from .collectives import (
     columnwise_sharded,
     columnwise_sharded_sparse,
+    columnwise_sharded_sparse_2d,
     rowwise_sharded,
     rowwise_sharded_sparse,
 )
@@ -51,4 +52,5 @@ __all__ = [
     "columnwise_sharded",
     "rowwise_sharded_sparse",
     "columnwise_sharded_sparse",
+    "columnwise_sharded_sparse_2d",
 ]

@@ -131,8 +131,17 @@ class FastRFT(SketchTransform):
 
     def _realize_wins(self, dtype, batch: int) -> bool:
         """Gate for realizing Sm·H·G·Π·H·B as a dense (S, n) matrix and
-        applying it as one MXU matmul (see module docstring)."""
+        applying it as one MXU matmul (see module docstring).  TPU-only
+        by default (the crossover constants are v5e-measured, and on CPU
+        the f32 4-pass split is both slower and less accurate than the
+        exact streaming form); ``SKYLARK_FRFT_GEMM=1`` forces it on for
+        cross-backend tests, ``SKYLARK_NO_FRFT_GEMM=1`` forces it off."""
         if os.environ.get("SKYLARK_NO_FRFT_GEMM", "0") == "1":
+            return False
+        if (
+            jax.default_backend() != "tpu"
+            and os.environ.get("SKYLARK_FRFT_GEMM", "0") != "1"
+        ):
             return False
         key = jnp.dtype(dtype).type
         if key not in _REALIZE_MAX_RATIO:

@@ -87,12 +87,21 @@ class PPT(SketchTransform):
 
     def _dft_wins(self, dtype, batch: int) -> bool:
         """Gate for the bf16 matmul-DFT path (one predicate for both
-        orientations — mirrors FastRFT._realize_wins)."""
+        orientations — mirrors FastRFT._realize_wins).  TPU-only by
+        default (v5e-measured crossover; CPU FFTs beat emulated bf16
+        matmuls); ``SKYLARK_PPT_DFT=1`` forces it on for cross-backend
+        tests, ``SKYLARK_NO_PPT_DFT=1`` forces it off."""
+        if os.environ.get("SKYLARK_NO_PPT_DFT", "0") == "1":
+            return False
+        if (
+            jax.default_backend() != "tpu"
+            and os.environ.get("SKYLARK_PPT_DFT", "0") != "1"
+        ):
+            return False
         return (
             dtype == jnp.bfloat16
             and 2 <= self.s <= _DFT_MAX_S
             and batch >= _DFT_MIN_BATCH
-            and os.environ.get("SKYLARK_NO_PPT_DFT", "0") != "1"
         )
 
     def _features(self, X):

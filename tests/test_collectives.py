@@ -138,6 +138,77 @@ class TestSparseShardedSchedules:
             columnwise_sharded_sparse(S2, A, mesh)  # 60 % 8 != 0
 
 
+class TestSparse2DGrid:
+    """P6 2-D option (≙ hash_transform_CombBLAS's √p×√p grid): nonzeros
+    owned by (row-block, col-block); per-shard local (S, m/pc)
+    accumulators, one psum over the mesh ROW axis, output col-sharded."""
+
+    @pytest.mark.parametrize(
+        "sketch_cls,kw", [(CWT, {}), (SJLT, {"nnz": 3}), (WZT, {"p": 1.5})]
+    )
+    def test_matches_local(self, rng, sketch_cls, kw):
+        from libskylark_tpu.parallel import columnwise_sharded_sparse_2d
+
+        n, m, s = 128, 32, 16
+        A, _ = _random_bcoo(rng, (n, m), density=0.15)
+        mesh = default_mesh()  # ('rows', 'cols') = (2, 4)
+        S = sketch_cls(n, s, SketchContext(seed=21), **kw)
+        ref = S.apply(A, "columnwise").todense()
+        out = columnwise_sharded_sparse_2d(S, A, mesh)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-8, atol=1e-10
+        )
+
+    def test_skewed_cells(self, rng):
+        # All nonzeros in one grid cell: padding stays harmless.
+        from jax.experimental import sparse as jsparse
+
+        from libskylark_tpu.parallel import columnwise_sharded_sparse_2d
+
+        n, m, s = 64, 16, 8
+        M = np.zeros((n, m))
+        M[:8, :2] = rng.standard_normal((8, 2))
+        A = jsparse.BCOO.fromdense(jnp.asarray(M))
+        mesh = default_mesh()
+        S = CWT(n, s, SketchContext(seed=22))
+        ref = S.apply(A, "columnwise").todense()
+        out = columnwise_sharded_sparse_2d(S, A, mesh)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-8, atol=1e-10
+        )
+
+    def test_needs_2d_mesh(self, rng):
+        from libskylark_tpu.parallel import (
+            columnwise_sharded_sparse_2d,
+            make_mesh,
+        )
+
+        A, _ = _random_bcoo(rng, (64, 16))
+        S = CWT(64, 8, SketchContext(seed=23))
+        with pytest.raises(ValueError, match="2-axis"):
+            columnwise_sharded_sparse_2d(S, A, make_mesh((8,), ("rows",)))
+
+    def test_exactly_one_allreduce_over_rows(self, rng):
+        """Schedule lock: the merge is ONE all-reduce (over the mesh row
+        axis only); nothing else communicates."""
+        from libskylark_tpu.parallel.collectives import (
+            _columnwise_sparse_2d_program,
+            _shard_coo_grid,
+        )
+
+        n, m, s = 128, 32, 16
+        A, _ = _random_bcoo(rng, (n, m), density=0.15)
+        mesh = default_mesh()
+        pr, pc = mesh.shape["rows"], mesh.shape["cols"]
+        S = CWT(n, s, SketchContext(seed=24))
+        d, lr, lc = _shard_coo_grid(A, pr, pc, n // pr, m // pc)
+        counts = _collective_counts(
+            _columnwise_sparse_2d_program(S, n // pr, m // pc, mesh),
+            d, lr, lc,
+        )
+        assert counts == {"all-reduce": 1}, counts
+
+
 _COLLECTIVE_RE = __import__("re").compile(
     r"\b(all-reduce|reduce-scatter|all-gather|all-to-all|"
     r"collective-permute)(?:-start)?\("

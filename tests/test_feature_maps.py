@@ -344,6 +344,7 @@ class TestFastRFT:
         arr = jnp.asarray(A if dim == "rowwise" else A.T)
         S = cls(n, s, SketchContext(seed=11), **kw)
         batch = m
+        monkeypatch.setenv("SKYLARK_FRFT_GEMM", "1")  # CPU: force TPU path
         assert S._realize_wins(jnp.float32, batch)
         Z_fast = S.apply(arr, dim)
         monkeypatch.setenv("SKYLARK_NO_FRFT_GEMM", "1")
@@ -353,8 +354,10 @@ class TestFastRFT:
             np.asarray(Z_fast), np.asarray(Z_exact), atol=5e-4
         )
 
-    def test_realized_gate_bounds(self):
+    def test_realized_gate_bounds(self, monkeypatch):
         S = FastGaussianRFT(24, 64, SketchContext(seed=12), sigma=1.0)
+        assert not S._realize_wins(jnp.float32, 10_000)  # CPU backend: off
+        monkeypatch.setenv("SKYLARK_FRFT_GEMM", "1")
         assert not S._realize_wins(jnp.float64, 10_000)  # f64 stays exact
         assert not S._realize_wins(jnp.float32, 64)      # small batch
         big = FastGaussianRFT(
@@ -433,6 +436,7 @@ class TestPPT:
         import libskylark_tpu.sketch.ppt as pptmod
 
         monkeypatch.setattr(pptmod, "_DFT_MIN_BATCH", 8)
+        monkeypatch.setenv("SKYLARK_PPT_DFT", "1")  # CPU: force TPU path
         n, s, m = 24, 16, 64
         A = rng.standard_normal((n, m))
         F = PPT(n, s, SketchContext(seed=7), q=3, c=0.7, gamma=1.3)

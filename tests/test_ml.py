@@ -159,6 +159,65 @@ class TestKRR:
         m = kernel_ridge(GaussianKernel(4, 1.0), X, Y, 0.5)
         assert m.predict(X).shape == (60, 3)
 
+    def test_bf16_features_keep_dtype_contract(self, rng):
+        """bf16 features: _psd_gram's pinned ≥f32 accumulator must not
+        leak into the returned model dtype (round-3 review), and the
+        f32-factored solve must track an f32-feature run to bf16
+        accuracy."""
+        import jax.numpy as jnp
+
+        from libskylark_tpu.ml import approximate_kernel_ridge
+
+        n, d, s = 256, 8, 64
+        X = rng.standard_normal((n, d)).astype(np.float32)
+        y = np.tanh(X @ rng.standard_normal(d)).astype(np.float32)
+        k = GaussianKernel(d, sigma=2.0)
+        m16 = approximate_kernel_ridge(
+            k, jnp.asarray(X).astype(jnp.bfloat16), jnp.asarray(y),
+            0.1, s, SketchContext(seed=9),
+        )
+        assert m16.W.dtype == jnp.bfloat16
+        m32 = approximate_kernel_ridge(
+            k, jnp.asarray(X), jnp.asarray(y), 0.1, s, SketchContext(seed=9)
+        )
+        p16 = np.asarray(m16.predict(jnp.asarray(X)), np.float64)
+        p32 = np.asarray(m32.predict(jnp.asarray(X)), np.float64)
+        scale = np.abs(p32).max() + 1e-30
+        assert np.abs(p16 - p32).max() / scale < 0.05  # bf16-level
+
+    def test_streaming_matches_large_scale(self, rng):
+        """streaming_kernel_ridge (rows AND features streamed — the
+        single-chip 10M×4K north-star machinery) runs the same BCD
+        updates as large_scale_kernel_ridge: same context → same maps →
+        near-identical W."""
+        import jax
+
+        from libskylark_tpu.ml import streaming_kernel_ridge
+
+        n, d, s = 512, 16, 64
+        X = jnp.asarray(rng.standard_normal((n, d)))
+        y = jnp.asarray(np.tanh(np.asarray(X) @ rng.standard_normal(d)))
+        k = GaussianKernel(d, sigma=2.0)
+        params = KrrParams(max_split=32, iter_lim=20, tolerance=1e-6)
+        m1 = large_scale_kernel_ridge(
+            k, X, y, 0.1, s, SketchContext(seed=11), params
+        )
+        m2 = streaming_kernel_ridge(
+            k,
+            lambda start, rows: jax.lax.dynamic_slice(X, (start, 0), (rows, d)),
+            (n, d), y, 0.1, s, SketchContext(seed=11), params,
+            block_rows=128, feature_dtype=X.dtype,
+        )
+        assert len(m2.maps) == len(m1.maps) > 1
+        np.testing.assert_allclose(
+            np.asarray(m2.W), np.asarray(m1.W), rtol=1e-4, atol=1e-7
+        )
+        # model predicts like any FeatureMapModel, identically to m1
+        np.testing.assert_allclose(
+            np.asarray(m2.predict(X)), np.asarray(m1.predict(X)),
+            rtol=1e-4, atol=1e-6,
+        )
+
 
 class TestRLSC:
     def test_kernel_rlsc_separable(self, rng):

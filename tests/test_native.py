@@ -561,10 +561,14 @@ class TestQMCAndPPTNative:
             rtol=1e-8, atol=1e-10,
         )
 
-    def test_ppt_matches_python(self, rng):
+    @pytest.mark.parametrize("s", [16, 12, 17, 100])
+    def test_ppt_matches_python(self, rng, s):
+        """Any S: pow2 rides the radix-2 kernel, non-pow2 the Bluestein
+        chirp-z (round 3 — the former pow2-only restriction is gone,
+        restoring parity with the reference's FFTW-backed PPT)."""
         from libskylark_tpu.sketch import PPT
 
-        n, s, m = 10, 16, 5  # s must be pow2 for the native FFT
+        n, m = 10, 5
         A = rng.standard_normal((n, m))
         nctx = native.NativeContext(43)
         ns = native.NativeSketch.create(nctx, "PPT", n, s, 0.5, 2.0, 3.0)
@@ -577,12 +581,12 @@ class TestQMCAndPPTNative:
         PPT(n, s, pctx, q=3, c=0.5, gamma=2.0)
         assert nctx.counter == pctx.counter
 
-    def test_ppt_non_pow2_unsupported(self):
+    def test_ppt_invalid_q_rejected(self):
         from libskylark_tpu.utils.exceptions import SkylarkError
 
         nctx = native.NativeContext(44)
         with pytest.raises(SkylarkError):
-            native.NativeSketch.create(nctx, "PPT", 10, 12, 1.0, 1.0, 2.0)
+            native.NativeSketch.create(nctx, "PPT", 10, 12, 1.0, 1.0, -1.0)
 
     def test_all_16_serialization_roundtrips(self, rng):
         from libskylark_tpu.sketch import from_json

@@ -129,6 +129,117 @@ print("WHT-F32-OK")
     assert "WHT-F32-OK" in out
 
 
+def test_psd_gram_precision_on_tpu():
+    """`ml/krr.py::_psd_gram` pins precision='highest' because the MXU
+    default truncates f32 operands to bf16 mantissas — enough to push a
+    barely-regularized Gram off its f64 value by ~1e-2 relative and
+    destabilize the Cholesky solves built on it.  Guards the pin: if it
+    is removed, the relative check fails on hardware."""
+    out = _run_on_default_backend(
+        _PRELUDE
+        + """
+from libskylark_tpu.ml.krr import _psd_gram
+rng = np.random.default_rng(3)
+m, s = 4096, 256
+Z = jnp.asarray(rng.standard_normal((m, s)), jnp.float32)
+lam = jnp.float32(1e-4)
+G = np.asarray(jax.jit(lambda Z: _psd_gram(Z.T, Z) + lam * jnp.eye(s))(Z),
+               np.float64)
+ref = np.asarray(Z, np.float64).T @ np.asarray(Z, np.float64) + 1e-4 * np.eye(s)
+rel = np.abs(G - ref).max() / np.abs(ref).max()
+assert rel < 2e-5, f"_psd_gram degraded on hardware: {rel}"
+L = np.linalg.cholesky(G)  # PSD property survives
+assert np.isfinite(L).all()
+print("PSD-GRAM-OK")
+"""
+    )
+    if "SKIP-NOT-TPU" in out:
+        pytest.skip(f"default backend is not TPU: {out.strip()}")
+    assert "PSD-GRAM-OK" in out
+
+
+def test_streaming_svd_orthogonality_on_tpu():
+    """Streaming SVD's CholeskyQR2 whitening repair relies on the pinned
+    Gram products (linalg/svd.py); on hardware the f32 U must stay
+    orthonormal to ~1e-3 (measured ~4e-4 round 1).  An un-pinned Gram
+    sends this to ~1e-2."""
+    out = _run_on_default_backend(
+        _PRELUDE
+        + """
+from libskylark_tpu import SketchContext
+from libskylark_tpu.linalg import (SVDParams, streaming_approximate_svd,
+                                   synthetic_lowrank_blocks)
+m, n, k, br = 100_000, 256, 20, 25_000
+blocks = synthetic_lowrank_blocks(SketchContext(seed=5), m, n, k,
+                                  noise=0.01, dtype=jnp.float32)
+U, s, V = streaming_approximate_svd(blocks, (m, n), k, SketchContext(seed=6),
+                                    SVDParams(num_iterations=1),
+                                    block_rows=br, materialize_u=True)
+G = np.asarray(jnp.dot(U.T, U, precision="highest"), np.float64)
+err = np.abs(G - np.eye(k)).max()
+assert err < 1.5e-3, f"streaming-SVD U lost orthogonality on hardware: {err}"
+print("SVD-ORTHO-OK", err)
+"""
+    )
+    if "SKIP-NOT-TPU" in out:
+        pytest.skip(f"default backend is not TPU: {out.strip()}")
+    assert "SVD-ORTHO-OK" in out
+
+
+def test_frft_realized_split_on_tpu():
+    """Fastfood's realized-W f32 path (4-pass bf16 split, round 3) vs
+    the precision-pinned streaming form on hardware: ~2^-16-relative
+    pre-cos ⇒ ≤5e-4 on the cos features.  A degraded split (astype
+    elision) or a dropped WHT pin pushes this to ~1e-1/1e-2."""
+    out = _run_on_default_backend(
+        _PRELUDE
+        + """
+import os
+from libskylark_tpu import SketchContext
+from libskylark_tpu.sketch import FastGaussianRFT
+rng = np.random.default_rng(4)
+n, s, m = 512, 1024, 4096
+A = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+S = FastGaussianRFT(n, s, SketchContext(seed=7), sigma=2.0)
+assert S._realize_wins(jnp.float32, m)
+fast = np.asarray(S.apply(A, "rowwise"))
+os.environ["SKYLARK_NO_FRFT_GEMM"] = "1"
+ref = np.asarray(S.apply(A, "rowwise"))
+err = np.abs(fast - ref).max()
+assert err < 5e-4, f"FRFT realized split degraded on hardware: {err}"
+print("FRFT-SPLIT-OK", err)
+"""
+    )
+    if "SKIP-NOT-TPU" in out:
+        pytest.skip(f"default backend is not TPU: {out.strip()}")
+    assert "FRFT-SPLIT-OK" in out
+
+
+def test_mmt_scaled_onehot_split_on_tpu():
+    """MMT/WZT's scaled-one-hot f32 path (v folded into A, 0/1 matrix,
+    3-pass split — round 3) vs the f64 host oracle on hardware."""
+    out = _run_on_default_backend(
+        _PRELUDE
+        + """
+from libskylark_tpu import SketchContext
+from libskylark_tpu.sketch import MMT
+rng = np.random.default_rng(5)
+n, s, m = 1024, 128, 512
+A = jnp.asarray(rng.standard_normal((n, m)), jnp.float32)
+S = MMT(n, s, SketchContext(seed=9))
+out_d = np.asarray(jax.jit(lambda A: S.apply(A, "columnwise"))(A), np.float64)
+M = np.asarray(S._hash_matrix(jnp.float32), np.float64)
+ref = M.T @ np.asarray(A, np.float64)
+rel = np.abs(out_d - ref).max() / np.abs(ref).max()
+assert rel < 5e-5, f"MMT scaled split degraded on hardware: {rel}"
+print("MMT-SPLIT-OK", rel)
+"""
+    )
+    if "SKIP-NOT-TPU" in out:
+        pytest.skip(f"default backend is not TPU: {out.strip()}")
+    assert "MMT-SPLIT-OK" in out
+
+
 def test_fjlt_pallas_branch_compiled_on_tpu():
     out = _run_on_default_backend(
         _PRELUDE

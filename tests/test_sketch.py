@@ -291,6 +291,52 @@ class TestHashScatterFallback:
             )
 
 
+class TestSparseDenseOutput:
+    """``dense_output=True`` (≙ hash_transform_Mixed.hpp sparse→dense):
+    sort-free per-hash segment_sum must equal the BCOO relabel path."""
+
+    @pytest.mark.parametrize(
+        "cls,kw",
+        [("CWT", {}), ("SJLT", {"nnz": 3}), ("WZT", {"p": 1.5})],
+    )
+    def test_matches_bcoo_path(self, rng, cls, kw):
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+
+        import libskylark_tpu.sketch as sk
+        from libskylark_tpu import SketchContext
+
+        n, m, s = 96, 24, 16
+        M = rng.standard_normal((n, m)) * (rng.random((n, m)) < 0.2)
+        A = jsparse.BCOO.fromdense(jnp.asarray(M))
+        S = getattr(sk, cls)(n, s, SketchContext(seed=4), **kw)
+        ref = S.apply(A, "columnwise").todense()
+        out = S.apply(A, "columnwise", dense_output=True)
+        assert not isinstance(out, jsparse.BCOO)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-10, atol=1e-12
+        )
+        At = jsparse.BCOO.fromdense(jnp.asarray(M.T))
+        np.testing.assert_allclose(
+            np.asarray(S.apply(At, "rowwise", dense_output=True)),
+            np.asarray(S.apply(At, "rowwise").todense()),
+            rtol=1e-10, atol=1e-12,
+        )
+
+    def test_dense_out_limit(self, rng):
+        import jax.numpy as jnp
+        from jax.experimental import sparse as jsparse
+
+        from libskylark_tpu import SketchContext
+        from libskylark_tpu.sketch import CWT
+
+        A = jsparse.BCOO.fromdense(jnp.asarray(rng.standard_normal((8, 4))))
+        S = CWT(8, 4, SketchContext(seed=5))
+        S._DENSE_OUT_LIMIT = 8  # S*batch = 16 > 8
+        with pytest.raises(ValueError, match="dense_output"):
+            S.apply(A, "columnwise", dense_output=True)
+
+
 class TestHashBf16Split:
     """Sign-valued hash sketches ride the bf16 MXU (hash matrix =
     c * small-integer matrix, exact in bf16); the f32 3-pass split must
@@ -314,19 +360,34 @@ class TestHashBf16Split:
                 rtol=5e-6, atol=5e-6 * scale,
             )
 
-    def test_nonsign_values_keep_full_precision_path(self, rng):
+    def test_nonsign_values_scaled_onehot_path(self, rng):
+        """MMT/WZT (non-sign values) fold v into A so the 0/1 bucket
+        matrix is bf16-exact; the f32 3-pass split of (v ⊙ A) must match
+        the f64 hash-matrix oracle to f32-product accuracy (round-3
+        re-design of the f32 one-hot path; ≙ MMT_data.hpp:21-44,
+        WZT_data.hpp:45-127)."""
         import jax.numpy as jnp
         from libskylark_tpu import SketchContext
-        from libskylark_tpu.sketch import MMT
+        from libskylark_tpu.sketch import MMT, WZT
 
-        S = MMT(30, 8, SketchContext(seed=6))
-        assert S._sign_scale() is None
-        A32 = jnp.asarray(rng.standard_normal((30, 20)), jnp.float32)
-        out = S.apply(A32, "columnwise")  # exact f32 one-hot matmul
-        M = np.asarray(S._hash_matrix(jnp.float32))
-        np.testing.assert_allclose(
-            np.asarray(out), M.T @ np.asarray(A32), rtol=2e-5, atol=1e-5
-        )
+        for cls, kw in ((MMT, {}), (WZT, {"p": 1.5})):
+            S = cls(30, 8, SketchContext(seed=6), **kw)
+            assert S._sign_scale() is None
+            A32 = jnp.asarray(rng.standard_normal((30, 20)), jnp.float32)
+            out = S.apply(A32, "columnwise")
+            assert out.dtype == jnp.float32
+            M = np.asarray(S._hash_matrix(jnp.float64))
+            ref = M.T @ np.asarray(A32, np.float64)
+            scale = np.abs(ref).max() + 1e-30
+            np.testing.assert_allclose(
+                np.asarray(out, np.float64), ref,
+                rtol=5e-5, atol=5e-5 * scale,
+            )
+            out_r = S.apply(A32.T, "rowwise")  # same path, rowwise
+            np.testing.assert_allclose(
+                np.asarray(out_r, np.float64), ref.T,
+                rtol=5e-5, atol=5e-5 * scale,
+            )
 
     def test_integer_input_onehot_path(self, rng):
         """Int inputs are value-converted before the bitcast split (a raw
