@@ -394,14 +394,17 @@ def streaming_kernel_ridge(
     maps = [kernel.create_rft(sz, _tag(params), context) for sz in sizes]
     lam_ = jnp.float32(lam)
 
-    def chunk_Zp(c, start, bargs):
+    def chunk_Zp(c, start, bargs, ops):
         """(block_rows, sz) feature panel of chunk c, built in-graph.
         Natural rowwise layout: every consumer contracts it with
         ``dot_general`` directly — materializing a transpose (or an
         astype-to-f32 copy) of the panel costs ~3 extra HBM passes per
-        visit, measured ~2.3 s/sweep-pass at the 10M×4096 shape."""
+        visit, measured ~2.3 s/sweep-pass at the 10M×4096 shape.  The
+        map's counter-realized operands are hoisted to ``ops`` (once per
+        program, outside the panel loop): XLA does not LICM the ~11 ms
+        per-visit W realization out of the fori_loop by itself."""
         Xp = block_fn(start, block_rows, *bargs).astype(feature_dtype)
-        return maps[c].apply(Xp, Dimension.ROWWISE)
+        return maps[c].apply_with_operands(ops, Xp, Dimension.ROWWISE)
 
     # Per-chunk jitted programs (static chunk index → static sz).  The
     # panel loops are fori_loops: one compile per chunk, not per panel.
@@ -418,8 +421,10 @@ def streaming_kernel_ridge(
 
         @jax.jit
         def gram(*bargs):
+            ops = maps[c].hoistable_operands(feature_dtype)
+
             def body(p, G):
-                Zp = chunk_Zp(c, p * block_rows, bargs)
+                Zp = chunk_Zp(c, p * block_rows, bargs, ops)
                 blk = jax.lax.dot_general(
                     Zp, Zp, (((0,), (0,)), ((), ())),
                     precision=_prec(Zp.dtype),
@@ -434,8 +439,10 @@ def streaming_kernel_ridge(
 
         @jax.jit
         def zr(R, Wc, *bargs):
+            ops = maps[c].hoistable_operands(feature_dtype)
+
             def body(p, acc):
-                Zp = chunk_Zp(c, p * block_rows, bargs)
+                Zp = chunk_Zp(c, p * block_rows, bargs, ops)
                 Rp = jax.lax.dynamic_slice(
                     R, (p * block_rows, 0), (block_rows, t)
                 )
@@ -450,8 +457,10 @@ def streaming_kernel_ridge(
 
         @jax.jit
         def apply_delta(R, delta, *bargs):
+            ops = maps[c].hoistable_operands(feature_dtype)
+
             def body(p, R):
-                Zp = chunk_Zp(c, p * block_rows, bargs)
+                Zp = chunk_Zp(c, p * block_rows, bargs, ops)
                 upd = jax.lax.dot_general(
                     Zp, delta.astype(Zp.dtype), (((1,), (0,)), ((), ())),
                     precision=_prec(Zp.dtype),

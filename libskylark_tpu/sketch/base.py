@@ -116,6 +116,28 @@ class SketchTransform(abc.ABC):
     def __call__(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
         return self.apply(A, dim)
 
+    # -- loop-invariant operand hoisting ------------------------------------
+
+    def hoistable_operands(self, dtype):
+        """Counter-derived arrays the apply realizes that do NOT depend
+        on the input (the sketch operand, shifts, ...), or None.
+
+        XLA does not hoist this realization out of a ``lax.fori_loop``
+        body even though it is loop-invariant — measured ~11 ms per
+        8M-draw W per panel visit in the streaming-KRR sweep (round 3).
+        Streaming consumers call this ONCE per jitted program (outside
+        their panel loop) and pass the result to
+        :meth:`apply_with_operands`.  Default: nothing to hoist.
+        """
+        return None
+
+    def apply_with_operands(
+        self, ops, A, dim: Dimension | str = Dimension.COLUMNWISE
+    ):
+        """Apply using pre-realized :meth:`hoistable_operands` (``ops``
+        may be None → plain apply).  Default ignores ``ops``."""
+        return self.apply(A, dim)
+
     # Convenience mirroring the python-skylark operator sugar
     # (python-skylark/skylark/sketch.py: __mul__ = columnwise, __div__ = rowwise).
     def __mul__(self, A):

@@ -92,7 +92,29 @@ class DenseSketch(SketchTransform):
     # -- apply --------------------------------------------------------------
 
     def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
-        dim = Dimension.of(dim)
+        return self._apply_impl(A, Dimension.of(dim), omega=None)
+
+    def hoistable_operands(self, dtype):
+        """The realized (S, N) Omega, for streaming consumers to hoist
+        out of panel loops (see SketchTransform.hoistable_operands);
+        None on the panel-blocked path (no single realized Omega)."""
+        if self.n * self.s > MAX_REALIZE_ELEMENTS:
+            return None
+        dtype = jnp.dtype(dtype)
+        if not jnp.issubdtype(dtype, jnp.floating):
+            dtype = jnp.dtype(jnp.float32)
+        return self.realize(dtype)
+
+    def apply_with_operands(
+        self, ops, A, dim: Dimension | str = Dimension.COLUMNWISE
+    ):
+        return self._apply_impl(A, Dimension.of(dim), omega=ops)
+
+    def _apply_impl(self, A, dim: Dimension, omega):
+        """One implementation behind apply / apply_with_operands: same
+        coercion, validation, and matmul dispatch, with ``omega``
+        optionally pre-realized (bit-identical either way — realize is a
+        pure function of the counter stream)."""
         A = jnp.asarray(A) if not hasattr(A, "todense") else A
         dtype = A.dtype
         if not jnp.issubdtype(dtype, jnp.floating):
@@ -107,16 +129,19 @@ class DenseSketch(SketchTransform):
             raise ValueError(
                 f"rowwise apply needs A with {self.n} columns, got {A.shape}"
             )
-        if self.n * self.s > MAX_REALIZE_ELEMENTS:
-            if hasattr(A, "todense"):
-                raise UnsupportedError(
-                    f"dense sketch of a sparse input needs the full "
-                    f"({self.s}, {self.n}) Omega materialized "
-                    f"(> MAX_REALIZE_ELEMENTS); use an input-sparsity "
-                    f"sketch (CWT/SJLT) at this scale"
-                )
-            return self._apply_blocked(A, dim, dtype)
-        omega = self.realize(dtype)
+        if omega is None:
+            if self.n * self.s > MAX_REALIZE_ELEMENTS:
+                if hasattr(A, "todense"):
+                    raise UnsupportedError(
+                        f"dense sketch of a sparse input needs the full "
+                        f"({self.s}, {self.n}) Omega materialized "
+                        f"(> MAX_REALIZE_ELEMENTS); use an input-sparsity "
+                        f"sketch (CWT/SJLT) at this scale"
+                    )
+                return self._apply_blocked(A, dim, dtype)
+            omega = self.realize(dtype)
+        elif omega.dtype != dtype:
+            omega = omega.astype(dtype)
         if dim is Dimension.COLUMNWISE:
             return _matmul(omega, A)
         return _matmul(A, omega.T)

@@ -161,13 +161,46 @@ class FastRFT(SketchTransform):
             jnp.float32  # belt-and-braces: subclass _sm dtype leaks
         )
 
-    def _apply_realized(self, A, rowwise: bool, dtype):
+    def hoistable_operands(self, dtype):
+        """(realized W, shifts) for streaming consumers.  No backend or
+        batch gate: a hoisting consumer amortizes the in-graph W build
+        over its whole panel loop, which dominates both crossovers (the
+        per-call ``_realize_wins`` gates exist because plain ``apply``
+        rebuilds W every call)."""
+        key = jnp.dtype(dtype).type
+        if key not in (jnp.bfloat16, jnp.float32):
+            return None  # f64 keeps the exact streaming form
+        if self.numblks * self._nb * self._nb > _REALIZE_MAX_ELEMENTS:
+            return None
+        return (self._realized_w(), self._shifts(jnp.float32))
+
+    def apply_with_operands(
+        self, ops, A, dim: Dimension | str = Dimension.COLUMNWISE
+    ):
+        dim = Dimension.of(dim)
+        A = jnp.asarray(A) if not hasattr(A, "todense") else A
+        if (
+            ops is None
+            or hasattr(A, "todense")
+            or A.ndim != 2
+            or A.dtype not in (jnp.bfloat16, jnp.float32)
+        ):
+            return self.apply(A, dim)
+        rowwise = dim is Dimension.ROWWISE
+        if A.shape[1 if rowwise else 0] != self.n:
+            raise ValueError(
+                f"{dim.value} apply needs {self.n} on the sketched axis, "
+                f"got {A.shape}"
+            )
+        return self._apply_realized(A, rowwise=rowwise, dtype=A.dtype, ops=ops)
+
+    def _apply_realized(self, A, rowwise: bool, dtype, ops=None):
         """V = W·X (or X·Wᵀ rowwise) on the MXU; bf16 inputs take one
         bf16 matmul, f32 a 4-pass bf16 split (A_hi/lo/lo2 × W_hi plus
         A_hi × W_lo — the W_lo·A_lo tail is ~2^-16-relative, dropped)."""
         from ..core.precision import bf16_split3
 
-        W = self._realized_w()
+        W, sh = ops if ops is not None else (self._realized_w(), None)
         # rowwise: X (m, n)·Wᵀ → contract X₁ with W₁; columnwise:
         # W (S, n)·X (n, m) → contract W₁ with X₀.
         contract = (((1,), (1,)), ((), ())) if rowwise else (((1,), (0,)), ((), ()))
@@ -184,7 +217,8 @@ class FastRFT(SketchTransform):
             w_hi, w_lo, _ = bf16_split3(W)
             a_hi, a_lo, a_lo2 = bf16_split3(A)
             V = mm(a_hi, w_hi) + mm(a_lo, w_hi) + mm(a_lo2, w_hi) + mm(a_hi, w_lo)
-        sh = self._shifts(jnp.float32)
+        if sh is None:
+            sh = self._shifts(jnp.float32)
         Z = self.outscale * jnp.cos(V + (sh[None, :] if rowwise else sh[:, None]))
         return Z.astype(dtype)
 

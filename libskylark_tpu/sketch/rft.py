@@ -84,6 +84,10 @@ class RFT(SketchTransform):
     def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
         dim = Dimension.of(dim)
         WX = self._underlying.apply(A, dim)
+        return self._epilogue(WX, dim)
+
+    def _epilogue(self, WX, dim: Dimension):
+        """outscale · cos(scales ⊙ WX + shifts)."""
         dtype = WX.dtype
         shifts = self.shifts(dtype)
         scales = self.scales(dtype)
@@ -96,6 +100,20 @@ class RFT(SketchTransform):
                 WX = WX * scales
             WX = WX + shifts
         return jnp.asarray(self.outscale, dtype) * jnp.cos(WX)
+
+    def hoistable_operands(self, dtype):
+        """The realized (S, N) W — loop-invariant, and the expensive
+        part of the apply to re-derive (Box-Muller per visit).
+        Delegates to the underlying dense engine (one gate, one realize
+        — and JLT/CT streaming consumers get the same seam)."""
+        return self._underlying.hoistable_operands(dtype)
+
+    def apply_with_operands(
+        self, ops, A, dim: Dimension | str = Dimension.COLUMNWISE
+    ):
+        dim = Dimension.of(dim)
+        WX = self._underlying.apply_with_operands(ops, A, dim)
+        return self._epilogue(WX, dim)
 
 
 class _Underlying(DenseSketch):

@@ -354,6 +354,67 @@ class TestFastRFT:
             np.asarray(Z_fast), np.asarray(Z_exact), atol=5e-4
         )
 
+    def test_hoistable_operands_parity(self, rng):
+        """apply_with_operands(hoistable_operands(dtype), A) must equal
+        apply(A) bit-for-bit — streaming consumers hoist the W
+        realization out of their panel loops (XLA does not LICM it)."""
+        from libskylark_tpu.sketch.rft import GaussianRFT, MaternRFT
+
+        for cls, kw in (
+            (GaussianRFT, {"sigma": 1.7}),
+            (MaternRFT, {"nu": 1.5, "l": 0.9}),
+        ):
+            n, s, m = 24, 32, 8
+            F = cls(n, s, SketchContext(seed=17), **kw)
+            A = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+            ops = F.hoistable_operands(jnp.float32)
+            assert ops is not None
+            np.testing.assert_array_equal(
+                np.asarray(F.apply_with_operands(ops, A, "rowwise")),
+                np.asarray(F.apply(A, "rowwise")),
+            )
+            np.testing.assert_array_equal(
+                np.asarray(F.apply_with_operands(ops, A.T, "columnwise")),
+                np.asarray(F.apply(A.T, "columnwise")),
+            )
+            # None ops / default transforms fall back to plain apply
+            np.testing.assert_array_equal(
+                np.asarray(F.apply_with_operands(None, A, "rowwise")),
+                np.asarray(F.apply(A, "rowwise")),
+            )
+            # apply's input coercion carries over (review regression:
+            # int inputs must not truncate W / run an int epilogue)
+            Ai = np.arange(m * n).reshape(m, n) % 5
+            np.testing.assert_array_equal(
+                np.asarray(F.apply_with_operands(ops, Ai, "rowwise")),
+                np.asarray(F.apply(Ai, "rowwise")),
+            )
+
+    def test_hoistable_operands_fastrft(self, rng):
+        """FastRFT hoisting: (realized W, shifts) — matches the forced
+        realized apply exactly, and the streaming-KRR 'fast' tag path
+        gets the same loop-hoisting as plain RFT."""
+        import os
+
+        from libskylark_tpu.sketch import FastGaussianRFT
+
+        n, s, m = 24, 64, 160
+        F = FastGaussianRFT(n, s, SketchContext(seed=19), sigma=2.0)
+        A = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
+        ops = F.hoistable_operands(jnp.float32)
+        assert ops is not None and len(ops) == 2
+        os.environ["SKYLARK_FRFT_GEMM"] = "1"
+        try:
+            assert F._realize_wins(jnp.float32, m)
+            ref = F.apply(A, "rowwise")  # realized path
+        finally:
+            del os.environ["SKYLARK_FRFT_GEMM"]
+        np.testing.assert_array_equal(
+            np.asarray(F.apply_with_operands(ops, A, "rowwise")),
+            np.asarray(ref),
+        )
+        assert F.hoistable_operands(jnp.float64) is None
+
     def test_realized_gate_bounds(self, monkeypatch):
         S = FastGaussianRFT(24, 64, SketchContext(seed=12), sigma=1.0)
         assert not S._realize_wins(jnp.float32, 10_000)  # CPU backend: off
