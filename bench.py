@@ -368,6 +368,55 @@ def bench_sparse_cwt(on_tpu, table):
     )
 
 
+def bench_streaming_krr(on_tpu, table):
+    """North-star single-chip config: 10M×4096 → 2048-feature KRR, rows
+    AND features streamed, bf16 (BASELINE.md North-star section).
+    Steady s/sweep via the solver's PhaseTimer (sweep0 absorbs compiles;
+    a content-varying resident panel stands in for IO — a loop-invariant
+    panel would be LICM'd into a fictitious >100% MFU reading)."""
+    from libskylark_tpu.ml import (
+        GaussianKernel,
+        KrrParams,
+        streaming_kernel_ridge,
+    )
+    from libskylark_tpu.utils import PhaseTimer
+
+    if on_tpu:
+        N, D, S, BR, sweeps = 10_000_000, 4096, 2048, 125_000, 3
+    else:
+        N, D, S, BR, sweeps = 4096, 64, 128, 512, 2
+
+    X0 = jax.random.normal(jax.random.PRNGKey(9), (BR, D), jnp.bfloat16)
+
+    def block_fn(start, rows, X0):
+        # Per-panel row ROTATION: not algebraically reducible, so no XLA
+        # simplifier can commute it out of the dot and hoist the matmul
+        # (a scalar multiple could be rewritten s*dot(X0, W); an additive
+        # shift folds into colsum(W) — both re-open the LICM trap).
+        return jnp.roll(X0, start // rows, axis=0)
+
+    y = jnp.asarray(
+        np.sign(np.random.default_rng(0).standard_normal(N)), jnp.float32
+    )
+    timer = PhaseTimer()
+    streaming_kernel_ridge(
+        GaussianKernel(D, sigma=8.0), block_fn, (N, D), y, 0.1, S,
+        SketchContext(seed=72),
+        KrrParams(max_split=0, iter_lim=sweeps, tolerance=0.0),
+        block_rows=BR, feature_dtype=jnp.bfloat16, block_args=(X0,),
+        timer=timer,
+    )
+    per = timer.totals["sweep"] / timer.counts["sweep"]
+    _emit(
+        f"streaming KRR {N}x{D}->{S} bf16 (north-star, hot panels)",
+        per,
+        "s/sweep",
+        2.69 / per if on_tpu else 1.0,
+        table,
+        contention=None,  # PhaseTimer steady sweeps — no burst spread
+    )
+
+
 def bench_streaming_svd(on_tpu, table):
     """The BASELINE.json headline config: 1e7x1024, k=100 (bf16 panels)."""
     from libskylark_tpu.linalg import (
@@ -524,6 +573,7 @@ def main() -> None:
         ("ridge", lambda: bench_ridge(on_tpu, table)),
         ("ADMM", lambda: bench_admm(on_tpu, table)),
         ("streaming SVD", lambda: bench_streaming_svd(on_tpu, table)),
+        ("streaming KRR", lambda: bench_streaming_krr(on_tpu, table)),
     ]
     for name, fn in secondaries:
         try:

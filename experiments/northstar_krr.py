@@ -57,12 +57,12 @@ def main():
             # Panel content must VARY with the panel index: a loop-
             # invariant return lets XLA hoist the whole feature
             # computation out of the panel fori_loop (measured "167%
-            # MFU" — LICM, not compute).  A bf16-representable per-panel
-            # scale (1 + p/256) defeats hoisting for one extra HBM pass,
-            # the same traffic a real IO-streamed panel would cost.
-            scale = (jnp.float32(1.0)
-                     + (start // rows).astype(jnp.float32) / 256.0)
-            return X0 * scale.astype(jnp.bfloat16)
+            # MFU" — LICM, not compute).  A row ROTATION is not
+            # algebraically reducible (a scalar multiple or additive
+            # shift could be commuted through the dot and re-hoisted);
+            # cost is one extra HBM pass, the same traffic a real
+            # IO-streamed panel would cost.
+            return jnp.roll(X0, start // rows, axis=0)
 
         block_args = (X0,)
     else:

@@ -141,7 +141,10 @@ class DenseSketch(SketchTransform):
                 return self._apply_blocked(A, dim, dtype)
             omega = self.realize(dtype)
         elif omega.dtype != dtype:
-            omega = omega.astype(dtype)
+            # Dtype-mismatched hoist: re-realize rather than astype — a
+            # value-converted Omega (e.g. bf16-rounded then upcast) would
+            # silently break the bit-identical-to-apply contract.
+            omega = self.realize(dtype)
         if dim is Dimension.COLUMNWISE:
             return _matmul(omega, A)
         return _matmul(A, omega.T)

@@ -390,12 +390,10 @@ class TestFastRFT:
                 np.asarray(F.apply(Ai, "rowwise")),
             )
 
-    def test_hoistable_operands_fastrft(self, rng):
+    def test_hoistable_operands_fastrft(self, rng, monkeypatch):
         """FastRFT hoisting: (realized W, shifts) — matches the forced
         realized apply exactly, and the streaming-KRR 'fast' tag path
         gets the same loop-hoisting as plain RFT."""
-        import os
-
         from libskylark_tpu.sketch import FastGaussianRFT
 
         n, s, m = 24, 64, 160
@@ -403,12 +401,9 @@ class TestFastRFT:
         A = jnp.asarray(rng.standard_normal((m, n)), jnp.float32)
         ops = F.hoistable_operands(jnp.float32)
         assert ops is not None and len(ops) == 2
-        os.environ["SKYLARK_FRFT_GEMM"] = "1"
-        try:
-            assert F._realize_wins(jnp.float32, m)
-            ref = F.apply(A, "rowwise")  # realized path
-        finally:
-            del os.environ["SKYLARK_FRFT_GEMM"]
+        monkeypatch.setenv("SKYLARK_FRFT_GEMM", "1")
+        assert F._realize_wins(jnp.float32, m)
+        ref = F.apply(A, "rowwise")  # realized path
         np.testing.assert_array_equal(
             np.asarray(F.apply_with_operands(ops, A, "rowwise")),
             np.asarray(ref),
