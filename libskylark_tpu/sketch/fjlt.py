@@ -149,7 +149,7 @@ class FJLT(SketchTransform):
         signs = (1 - 2 * (bits & 1)).astype(dtype)
         return self._rfut.diagonal(dtype)[:, None] * signs
 
-    def _apply_srht_gemm(self, A2, rowwise: bool):
+    def _apply_srht_gemm(self, A2, rowwise: bool, G16=None):
         """out = scale · (sampled WHT columns of A ⊙ D) as dense matmul —
         same values as the WHT+gather path (same samples, same diagonal),
         chosen by :meth:`_gemm_wins` when S is small enough that the
@@ -173,11 +173,12 @@ class FJLT(SketchTransform):
             )
 
         if dtype == jnp.bfloat16:
-            out = mm(A2, self._srht_matrix(dtype))
+            out = mm(A2, G16 if G16 is not None else self._srht_matrix(dtype))
         elif dtype == jnp.float32:
             from ..core.precision import bf16_split3
 
-            G16 = self._srht_matrix(jnp.bfloat16)  # ±1: exact in bf16
+            if G16 is None:
+                G16 = self._srht_matrix(jnp.bfloat16)  # ±1: exact in bf16
             # Bit-mask split (NOT astype round-trips — XLA's excess-
             # precision rules elide f32→bf16→f32 convert pairs, which
             # zeroed lo/lo2 on hardware; see core/precision.py).
@@ -193,6 +194,42 @@ class FJLT(SketchTransform):
             )
         # orthonormal WHT (1/√NB) × sample rescale √(NB/S) = 1/√S.
         return (out * acc.type(1.0 / np.sqrt(self.s))).astype(dtype)
+
+    def hoistable_operands(self, dtype):
+        """The (n, S) ±1 subsampled-Hadamard matrix (bf16 — exact), the
+        expensive-to-rebuild operand of the SRHT-gemm path.  One matrix
+        serves both bf16 and f32 inputs (f32 rides the 3-pass split
+        against it)."""
+        dt = jnp.dtype(dtype)
+        if dt.type not in (jnp.bfloat16, jnp.float32):
+            return None  # f64 keeps the exact paths
+        if self._fut_name != "wht" or not self._gemm_wins(dt.type):
+            # apply_with_operands would fall back to the streamed path —
+            # don't realize a dead (n, S) matrix (it can reach 128 MB+).
+            return None
+        return self._srht_matrix(jnp.bfloat16)
+
+    def apply_with_operands(
+        self, ops, A, dim: Dimension | str = Dimension.COLUMNWISE
+    ):
+        dim = Dimension.of(dim)
+        if ops is None or hasattr(A, "todense"):
+            return self.apply(A, dim)
+        A = jnp.asarray(A)
+        if A.ndim != 2 or A.dtype not in (jnp.bfloat16, jnp.float32):
+            return self.apply(A, dim)
+        if not self._gemm_wins(A.dtype):
+            # Per-apply flops still favor the streamed WHT (the hoist
+            # only amortizes the matrix BUILD, which the gate never
+            # priced) — keep the gate's verdict.
+            return self.apply(A, dim)
+        rowwise = dim is Dimension.ROWWISE
+        if A.shape[1 if rowwise else 0] != self.n:
+            raise ValueError(
+                f"{dim.value} apply needs {self.n} on the sketched axis, "
+                f"got {A.shape}"
+            )
+        return self._apply_srht_gemm(A, rowwise, G16=ops)
 
     def _apply_pallas(self, A, interpret: bool = False):
         """Fused one-pass D·x → WHT kernel (natural order, matching the

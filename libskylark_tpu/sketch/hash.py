@@ -240,16 +240,11 @@ class HashSketch(SketchTransform):
             out = mm(hi) + mm(lo) + mm(lo2)
         return out.T if dim is Dimension.COLUMNWISE else out
 
-    def _apply_onehot_bf16(self, A, dim: Dimension, dtype, c):
-        """Sign-valued hash sketches on the bf16 MXU at full precision:
-        the hash matrix is c·M_int with small-integer entries (exact in
-        bf16); bf16 inputs take one matmul, f32 inputs the 3-pass split,
-        ~3x the f32 matmul rate on v5e.  Same trick as FJLT's
-        subsampled-Hadamard gemm (``fjlt.py``)."""
-        # Build the integer sign matrix directly in bf16 (entries are
-        # signed collision counts — exact): one (N, S) bf16 pass instead
-        # of an f32 build + rescale + round + cast chain (halves the
-        # build's HBM traffic at CWT's 128K x 1024 bench shape).
+    def _sign_matrix_bf16(self, c):
+        """The (N, S) integer sign matrix ·(1/c), built directly in bf16
+        (entries are signed collision counts — exact): one bf16 pass
+        instead of an f32 build + rescale + round + cast chain (halves
+        the build's HBM traffic at CWT's 128K x 1024 bench shape)."""
         b = self.buckets().reshape(self.nnz, self.n)
         v = self.values(jnp.float32).reshape(self.nnz, self.n)
         iota = jnp.arange(self.s, dtype=b.dtype)
@@ -261,7 +256,91 @@ class HashSketch(SketchTransform):
                 vi[:, None],
                 jnp.zeros((), jnp.bfloat16),
             )
-        out = self._bf16_onehot_contract(A, Mi, dim, dtype)
+        return Mi
+
+    def hoistable_operands(self, dtype):
+        """The bf16-exact one-hot matrices (sign matrix for CWT/SJLT,
+        per-hash (P01, v) pairs for MMT/WZT) — the O(N·S) build a
+        streaming consumer should not repeat per panel visit."""
+        dt = jnp.dtype(dtype)
+        if dt.type not in (jnp.bfloat16, jnp.float32):
+            return None
+        if self.n * self.s > self._ONEHOT_LIMIT:
+            return None
+        c = self._sign_scale()
+        if c is not None:
+            return ("sign", c, self._sign_matrix_bf16(c))
+        return ("scaled", self._scaled_pairs())
+
+    def _scaled_pairs(self):
+        """Per-hash (0/1 bucket matrix in bf16, value row) pairs — the
+        operands of the scaled-one-hot path (MMT/WZT)."""
+        b = self.buckets().reshape(self.nnz, self.n)
+        v = self.values(jnp.float32).reshape(self.nnz, self.n)
+        iota = jnp.arange(self.s, dtype=b.dtype)
+        return tuple(
+            (
+                jnp.where(
+                    b[h][:, None] == iota[None, :],
+                    jnp.ones((), jnp.bfloat16),
+                    jnp.zeros((), jnp.bfloat16),
+                ),
+                v[h],
+            )
+            for h in range(self.nnz)
+        )
+
+    def _scaled_contract(self, pairs, A, dim: Dimension, dtype):
+        """out = Σ_h contract(v_h ⊙ A, P01_h) — the one scaled-one-hot
+        loop behind both the per-call path and the hoisted path."""
+        A32 = A.astype(jnp.float32)
+        out = None
+        for P01, vh in pairs:
+            scaled = A32 * (
+                vh[:, None] if dim is Dimension.COLUMNWISE else vh[None, :]
+            )
+            part = self._bf16_onehot_contract(scaled, P01, dim, dtype)
+            out = part if out is None else out + part
+        return out.astype(dtype)
+
+    def apply_with_operands(
+        self, ops, A, dim: Dimension | str = Dimension.COLUMNWISE
+    ):
+        dim = Dimension.of(dim)
+        if ops is None or isinstance(A, jsparse.BCOO):
+            return self.apply(A, dim)
+        A = jnp.asarray(A)
+        if A.ndim != 2:
+            return self.apply(A, dim)
+        dtype = A.dtype if jnp.issubdtype(A.dtype, jnp.floating) else jnp.float32
+        if dtype not in (jnp.bfloat16, jnp.float32):
+            # f64/f16 take apply's full-precision matmul — the hoisted
+            # bf16 operands would silently downgrade them.
+            return self.apply(A, dim)
+        axis = 0 if dim is Dimension.COLUMNWISE else 1
+        if A.shape[axis] != self.n:
+            raise ValueError(
+                f"{dim.value} apply needs A with {self.n} on axis {axis}, "
+                f"got {A.shape}"
+            )
+        if A.shape[1 - axis] < 16:
+            # Thin batches take apply's scatter path — same gate, so the
+            # bit-identical-to-apply contract holds everywhere.
+            return self.apply(A, dim)
+        if ops[0] == "sign":
+            _, c, Mi = ops
+            out = self._bf16_onehot_contract(A, Mi, dim, dtype)
+            return (out * jnp.float32(c)).astype(dtype)
+        _, pairs = ops
+        return self._scaled_contract(pairs, A, dim, dtype)
+
+    def _apply_onehot_bf16(self, A, dim: Dimension, dtype, c):
+        """Sign-valued hash sketches on the bf16 MXU at full precision:
+        the hash matrix is c·M_int with small-integer entries (exact in
+        bf16); bf16 inputs take one matmul, f32 inputs the 3-pass split,
+        ~3x the f32 matmul rate on v5e.  Same trick as FJLT's
+        subsampled-Hadamard gemm (``fjlt.py``)."""
+        out = self._bf16_onehot_contract(A, self._sign_matrix_bf16(c), dim, dtype)
         return (out * jnp.float32(c)).astype(dtype)
 
     def _apply_onehot_scaled(self, A, dim: Dimension, dtype):
@@ -274,23 +353,7 @@ class HashSketch(SketchTransform):
         silently truncated operands to bf16 mantissas) and ~3× faster.
         Replaces the round-2 ``_hash_matrix`` f32 path (VERDICT item 2).
         """
-        b = self.buckets().reshape(self.nnz, self.n)
-        v = self.values(jnp.float32).reshape(self.nnz, self.n)
-        iota = jnp.arange(self.s, dtype=b.dtype)
-        A32 = A.astype(jnp.float32)
-        out = None
-        for h in range(self.nnz):
-            P01 = jnp.where(
-                b[h][:, None] == iota[None, :],
-                jnp.ones((), jnp.bfloat16),
-                jnp.zeros((), jnp.bfloat16),
-            )
-            scaled = A32 * (
-                v[h][:, None] if dim is Dimension.COLUMNWISE else v[h][None, :]
-            )
-            part = self._bf16_onehot_contract(scaled, P01, dim, dtype)
-            out = part if out is None else out + part
-        return out.astype(dtype)
+        return self._scaled_contract(self._scaled_pairs(), A, dim, dtype)
 
     # Dense outputs above this many elements would not fit comfortably
     # next to the input triplets on a 16 GB chip; callers beyond it keep

@@ -337,6 +337,72 @@ class TestSparseDenseOutput:
             S.apply(A, "columnwise", dense_output=True)
 
 
+class TestHoistableOperands:
+    """hoistable_operands / apply_with_operands across the hash family
+    and FJLT: bit-identical to plain apply (the streaming-consumer
+    seam; dense/RFT/FastRFT variants live in test_feature_maps.py)."""
+
+    @pytest.mark.parametrize(
+        "cls,kw",
+        [("CWT", {}), ("SJLT", {"nnz": 3}), ("MMT", {}), ("WZT", {"p": 1.5})],
+    )
+    @pytest.mark.parametrize("dim", ["rowwise", "columnwise"])
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_hash_family(self, rng, cls, kw, dim, dtype):
+        import jax.numpy as jnp
+
+        import libskylark_tpu.sketch as sk
+        from libskylark_tpu import SketchContext
+
+        dt = jnp.dtype(dtype)
+        n, s, m = 64, 16, 40
+        S = getattr(sk, cls)(n, s, SketchContext(seed=3), **kw)
+        A = jnp.asarray(rng.standard_normal((m, n)), jnp.float32).astype(dt)
+        arr = A if dim == "rowwise" else A.T
+        ops = S.hoistable_operands(dt)
+        assert ops is not None
+        np.testing.assert_array_equal(
+            np.asarray(S.apply_with_operands(ops, arr, dim)),
+            np.asarray(S.apply(arr, dim)),
+        )
+        assert S.hoistable_operands(jnp.float64) is None
+        # None ops falls back; f64 inputs keep apply's exact matmul
+        np.testing.assert_array_equal(
+            np.asarray(S.apply_with_operands(None, arr, dim)),
+            np.asarray(S.apply(arr, dim)),
+        )
+        A64 = jnp.asarray(rng.standard_normal((m, n)))
+        arr64 = A64 if dim == "rowwise" else A64.T
+        np.testing.assert_array_equal(
+            np.asarray(S.apply_with_operands(ops, arr64, dim)),
+            np.asarray(S.apply(arr64, dim)),
+        )
+
+    @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+    def test_fjlt(self, rng, dtype):
+        import jax.numpy as jnp
+
+        from libskylark_tpu import SketchContext
+        from libskylark_tpu.sketch import FJLT
+
+        dt = jnp.dtype(dtype)
+        n, s, m = 64, 16, 40
+        S = FJLT(n, s, SketchContext(seed=5))
+        A = jnp.asarray(rng.standard_normal((m, n)), jnp.float32).astype(dt)
+        ops = S.hoistable_operands(dt)
+        assert ops is not None
+        assert S._gemm_wins(dt)  # gemm path active at this shape
+        np.testing.assert_array_equal(
+            np.asarray(S.apply_with_operands(ops, A, "rowwise")),
+            np.asarray(S.apply(A, "rowwise")),
+        )
+        np.testing.assert_array_equal(
+            np.asarray(S.apply_with_operands(ops, A.T, "columnwise")),
+            np.asarray(S.apply(A.T, "columnwise")),
+        )
+        assert S.hoistable_operands(jnp.float64) is None
+
+
 class TestHashBf16Split:
     """Sign-valued hash sketches ride the bf16 MXU (hash matrix =
     c * small-integer matrix, exact in bf16); the f32 3-pass split must
