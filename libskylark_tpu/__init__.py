@@ -8,7 +8,7 @@ Elemental distribution templates, `lax.while_loop` solvers, and ICI
 collectives instead of MPI.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 from . import core, graph, io, linalg, ml, parallel, sketch, solvers, utils
 from .core import SketchContext
