@@ -12,6 +12,16 @@ parses only the final line still records everything.
 - every other line reports ``recorded / measured`` for times (≥ 1 means
   this round matched or beat the round-1 recorded value in BASELINE.md).
 
+Budget discipline (round 4 — the round-3 driver capture died rc=124 with
+the headline scheduled last, losing the most important rows): the two
+flagship configs (JLT headline, north-star streaming KRR) run FIRST,
+secondaries follow in descending importance, and a global wall-clock
+budget (``SKYLARK_BENCH_BUDGET_S``, default 1500 s — deliberately under
+any plausible driver timeout) governs the rest: pooling stops extending
+when the deadline nears, configs that cannot fit emit an explicit
+``"skipped: budget"`` row instead of dying mid-list, and a SIGTERM from
+an outer timeout still flushes the final headline+submetrics line.
+
 Timing notes: the axon TPU tunnel does not block in ``block_until_ready``,
 so all timings force a scalar readback; R independent applies (each with a
 distinct counter block, so XLA cannot CSE them) run inside ONE jitted
@@ -23,6 +33,9 @@ unbiased move is one difference of pooled minima).
 from __future__ import annotations
 
 import json
+import os
+import signal
+import sys
 import time
 
 import jax
@@ -30,6 +43,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from libskylark_tpu.core.context import SketchContext
+
+_T0 = time.monotonic()
+_BUDGET_S = float(os.environ.get("SKYLARK_BENCH_BUDGET_S", "1500"))
+
+
+def _remaining() -> float:
+    """Seconds left in the global bench budget."""
+    return _BUDGET_S - (time.monotonic() - _T0)
 
 
 def _peak_tflops(device) -> float:
@@ -77,11 +98,20 @@ def _rep_diff(build, A, r1=4, r2=16, rounds=25, max_bursts=4) -> float:
     t1s, t2s, per_burst = [], [], []
     for burst in range(max_bursts):
         if burst:
+            # Budget-aware pooling (round 4): extending into another
+            # burst is insurance against transient contention — worth
+            # nothing if it pushes later configs past the deadline.
+            if _remaining() < 60:
+                break
             time.sleep(10)
         b1, b2 = [], []
-        for _ in range(rounds):
+        for i in range(rounds):
             b1.append(_timed(f1, *args))
             b2.append(_timed(f2, *args))
+            # Keep pairs balanced: break between rounds only, and only
+            # after enough rounds that a min is meaningful.
+            if i >= 3 and _remaining() < 30:
+                break
         t1s += b1
         t2s += b2
         if min(b2) > min(b1):
@@ -90,6 +120,8 @@ def _rep_diff(build, A, r1=4, r2=16, rounds=25, max_bursts=4) -> float:
             spread = (max(per_burst) - min(per_burst)) / min(per_burst)
             if spread <= 0.05:
                 break
+        if _remaining() < 60:
+            break
     t1, t2 = min(t1s), min(t2s)
     if t2 <= t1:
         raise RuntimeError(
@@ -327,6 +359,79 @@ def bench_mmt(on_tpu, table):
     )
 
 
+def bench_qrft(on_tpu, table):
+    """QMC random features (Halton + inverse-CDF epilogue on the dense
+    engine) — closes the transform-family perf matrix (VERDICT r3 #9).
+    First capture: no recorded baseline yet, vs_baseline fixed at 1.0
+    (BASELINE.md records the value this emits)."""
+    from libskylark_tpu.sketch.rft import GaussianQRFT
+
+    if on_tpu:
+        m, n, s = 131_072, 4096, 2048
+    else:
+        m, n, s = 4096, 256, 128
+
+    def build(reps):
+        ctx = SketchContext(seed=59)
+        # QRFT consumes no counters — distinct skips keep reps CSE-proof.
+        sketches = [
+            GaussianQRFT(n, s, ctx, sigma=4.0, skip=1 + r * s)
+            for r in range(reps)
+        ]
+
+        def run(A):
+            acc = jnp.zeros((), jnp.float32)
+            for S in sketches:
+                acc += jnp.sum(jnp.abs(S.apply(A, "rowwise").astype(jnp.float32)))
+            return acc
+
+        return jax.jit(run)
+
+    A = jax.random.normal(jax.random.PRNGKey(10), (m, n), jnp.float32)
+    per = _rep_diff(build, A, r1=2, r2=6, rounds=12)
+    _emit(
+        f"GaussianQRFT {m}x{n}->{s} f32 apply",
+        per * 1e3,
+        "ms",
+        1.0,
+        table,
+    )
+
+
+def bench_rlt(on_tpu, table):
+    """Random Laplace transform (Lévy dense engine + exp epilogue).
+    First capture: vs_baseline fixed at 1.0 (see bench_qrft)."""
+    from libskylark_tpu.sketch.rlt import ExpSemigroupRLT
+
+    if on_tpu:
+        m, n, s = 131_072, 4096, 1024
+    else:
+        m, n, s = 4096, 256, 128
+
+    def build(reps):
+        ctx = SketchContext(seed=61)
+        sketches = [ExpSemigroupRLT(n, s, ctx, beta=1.0) for _ in range(reps)]
+
+        def run(A):
+            acc = jnp.zeros((), jnp.float32)
+            for S in sketches:
+                acc += jnp.sum(jnp.abs(S.apply(A, "rowwise").astype(jnp.float32)))
+            return acc
+
+        return jax.jit(run)
+
+    # Semigroup-kernel features need non-negative inputs (histograms).
+    A = jnp.abs(jax.random.normal(jax.random.PRNGKey(11), (m, n), jnp.float32))
+    per = _rep_diff(build, A, r1=2, r2=6, rounds=12)
+    _emit(
+        f"ExpSemigroupRLT {m}x{n}->{s} f32 apply",
+        per * 1e3,
+        "ms",
+        1.0,
+        table,
+    )
+
+
 def bench_sparse_cwt(on_tpu, table):
     """Input-sparsity-time sketch: CWT on a 1e6x1e5 BCOO, 1e7 nnz,
     dense_output (sort-free segment_sum — hash.py round 3)."""
@@ -552,30 +657,107 @@ def bench_admm(on_tpu, table):
     )
 
 
+_FINAL: dict | None = None
+_FINAL_PRINTED = False
+
+
+def _print_final() -> None:
+    """Print the LAST line (headline + full submetrics table) exactly once.
+
+    Also wired to SIGTERM: if an outer ``timeout`` fires anyway, the
+    driver still records a complete final line with everything measured
+    so far (the round-3 rc=124 artifact lost the headline entirely)."""
+    global _FINAL_PRINTED
+    if _FINAL is None or _FINAL_PRINTED:
+        return
+    _FINAL_PRINTED = True
+    print(json.dumps(_FINAL), flush=True)
+
+
 def main() -> None:
+    global _FINAL
+    # The axon sitecustomize force-sets jax_platforms to "axon,cpu",
+    # overriding the JAX_PLATFORMS env var; restore env semantics so a
+    # CPU smoke run (JAX_PLATFORMS=cpu python bench.py) cannot hang on
+    # a congested tunnel it never wanted.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     dev = jax.devices()[0]
     on_tpu = dev.platform in ("tpu", "axon")
+    peak = _peak_tflops(dev)
     table: list[dict] = []
 
-    # Secondary configs are individually fire-walled: one noisy
-    # sub-benchmark must not suppress the headline line the driver
-    # records (a failed config emits value -1 instead).
+    def _flush_on_term(signum, frame):
+        _print_final()
+        sys.exit(0)
+
+    signal.signal(signal.SIGTERM, _flush_on_term)
+
+    # -- flagships FIRST (round 4): a budget/timeout can no longer eat
+    # the rows the driver exists to record.  The headline is firewalled
+    # like every other config — a congested-tunnel RuntimeError from
+    # _rep_diff must degrade to a FAILED row, not abort the whole bench
+    # before anything printed.
+    try:
+        tflops, _ = bench_jlt(on_tpu, table)
+        headline_row = {
+            "metric": "JLT dense sketch-apply throughput",
+            "value": round(float(tflops), 3),
+            "unit": "TFLOP/s/chip",
+            "vs_baseline": round(float(tflops) / peak, 4),
+        }
+        if _LAST_CONTENTION is not None:
+            headline_row["contention"] = _LAST_CONTENTION
+    except Exception as e:  # noqa: BLE001 — report, don't abort
+        headline_row = {
+            "metric": (
+                f"JLT dense sketch-apply throughput (FAILED: {type(e).__name__})"
+            ),
+            "value": -1,
+            "unit": "error",
+            "vs_baseline": 0,
+        }
+    table.append(dict(headline_row))
+    print(json.dumps(headline_row), flush=True)
+    # submetrics aliases the LIVE table: rows appended below are included
+    # when the final line prints (or the SIGTERM flush fires).
+    _FINAL = dict(headline_row, submetrics=table)
+
+    try:
+        bench_streaming_krr(on_tpu, table)
+    except Exception as e:  # noqa: BLE001 — report, don't abort
+        _emit(
+            f"streaming KRR (FAILED: {type(e).__name__})", -1, "error", 0,
+            table, contention=None,
+        )
+
+    # -- secondaries, descending importance.  Each carries a rough cost
+    # estimate (compile + pooled measurement, seconds on the tunnel);
+    # when the remaining budget cannot plausibly fit a config it emits
+    # an explicit skip row instead of dying mid-list (VERDICT r3 #1).
     secondaries = [
-        ("FJLT bf16", lambda: bench_fjlt(on_tpu, jnp.bfloat16, 5.9, table)),
-        ("FJLT f32", lambda: bench_fjlt(on_tpu, jnp.float32, 44.8, table)),
-        ("CWT", lambda: bench_cwt(on_tpu, table)),
-        ("MMT", lambda: bench_mmt(on_tpu, table)),
-        ("FastRFT bf16", lambda: bench_frft(on_tpu, jnp.bfloat16, 16.1, table)),
-        ("FastRFT f32", lambda: bench_frft(on_tpu, jnp.float32, 51.2, table)),
-        ("PPT bf16", lambda: bench_ppt(on_tpu, jnp.bfloat16, 70.7, table)),
-        ("PPT f32", lambda: bench_ppt(on_tpu, jnp.float32, 149.4, table)),
-        ("sparse CWT", lambda: bench_sparse_cwt(on_tpu, table)),
-        ("ridge", lambda: bench_ridge(on_tpu, table)),
-        ("ADMM", lambda: bench_admm(on_tpu, table)),
-        ("streaming SVD", lambda: bench_streaming_svd(on_tpu, table)),
-        ("streaming KRR", lambda: bench_streaming_krr(on_tpu, table)),
+        ("streaming SVD", 150, lambda: bench_streaming_svd(on_tpu, table)),
+        ("FJLT bf16", 80, lambda: bench_fjlt(on_tpu, jnp.bfloat16, 5.9, table)),
+        ("CWT", 80, lambda: bench_cwt(on_tpu, table)),
+        ("MMT", 80, lambda: bench_mmt(on_tpu, table)),
+        ("sparse CWT", 150, lambda: bench_sparse_cwt(on_tpu, table)),
+        ("FastRFT bf16", 100, lambda: bench_frft(on_tpu, jnp.bfloat16, 16.1, table)),
+        ("PPT bf16", 120, lambda: bench_ppt(on_tpu, jnp.bfloat16, 70.7, table)),
+        ("FJLT f32", 90, lambda: bench_fjlt(on_tpu, jnp.float32, 44.8, table)),
+        ("FastRFT f32", 120, lambda: bench_frft(on_tpu, jnp.float32, 51.2, table)),
+        ("PPT f32", 150, lambda: bench_ppt(on_tpu, jnp.float32, 149.4, table)),
+        ("ridge", 80, lambda: bench_ridge(on_tpu, table)),
+        ("ADMM", 160, lambda: bench_admm(on_tpu, table)),
+        ("QRFT", 90, lambda: bench_qrft(on_tpu, table)),
+        ("RLT", 80, lambda: bench_rlt(on_tpu, table, baseline_ms=None)),
     ]
-    for name, fn in secondaries:
+    for name, est_s, fn in secondaries:
+        if on_tpu and _remaining() < 0.6 * est_s:
+            _emit(
+                f"{name} (skipped: budget)", -1, "skipped", 0, table,
+                contention=None,
+            )
+            continue
         try:
             fn()
         except Exception as e:  # noqa: BLE001 — report, don't abort
@@ -584,20 +766,7 @@ def main() -> None:
                 contention=None,
             )
 
-    tflops, _ = bench_jlt(on_tpu, table)
-    peak = _peak_tflops(dev)
-    headline = {
-        "metric": "JLT dense sketch-apply throughput",
-        "value": round(tflops, 3),
-        "unit": "TFLOP/s/chip",
-        "vs_baseline": round(tflops / peak, 4),
-        "submetrics": table,
-    }
-    if _LAST_CONTENTION is not None:
-        # burst-to-burst marginal spread of the headline measurement
-        # itself: ≤0.05 = quiet capture; larger explains a low MFU.
-        headline["contention"] = _LAST_CONTENTION
-    print(json.dumps(headline), flush=True)
+    _print_final()
 
 
 if __name__ == "__main__":

@@ -4,7 +4,67 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["load_classes", "print_test_metrics"]
+__all__ = [
+    "FILE_FORMATS",
+    "load_classes",
+    "load_dataset",
+    "print_test_metrics",
+    "stream_dataset",
+]
+
+# ≙ the reference's --fileformat choices (ml/options.hpp:46-47,173-174):
+# libsvm covers LIBSVM_DENSE/LIBSVM_SPARSE (the --sparse flag picks the
+# container), hdf5_dense/hdf5_sparse name the layout in the file itself
+# (ml/io.hpp:869-889).
+FILE_FORMATS = ("libsvm", "hdf5_dense", "hdf5_sparse")
+
+
+def _widen(X, y, n_features):
+    """Pad X's feature axis up to ``n_features`` (a test file converted
+    from a sparse split can have a smaller max feature index than the
+    train file — the libsvm reader pads the same way)."""
+    if n_features is None or X.shape[1] >= n_features:
+        return X, y
+    if hasattr(X, "todense"):  # BCOO: same triplets, wider logical shape
+        from jax.experimental import sparse as jsparse
+
+        X = jsparse.BCOO(
+            (X.data, X.indices), shape=(X.shape[0], int(n_features))
+        )
+    else:
+        X = np.pad(
+            np.asarray(X), ((0, 0), (0, int(n_features) - X.shape[1]))
+        )
+    return X, y
+
+
+def load_dataset(path, fileformat: str, sparse: bool, n_features=None):
+    """(X, y) under any supported --fileformat.  For hdf5_dense,
+    ``sparse`` converts to BCOO after the read (matching the libsvm
+    --sparse semantics); hdf5_sparse is sparse by construction."""
+    from ..io import read_hdf5, read_libsvm
+
+    if fileformat == "libsvm":
+        return read_libsvm(path, n_features=n_features, sparse=sparse)
+    if fileformat == "hdf5_dense":
+        return _widen(*read_hdf5(path, sparse=sparse), n_features)
+    if fileformat == "hdf5_sparse":
+        return _widen(*read_hdf5(path, sparse=True), n_features)
+    raise ValueError(f"unknown fileformat {fileformat!r}; use {FILE_FORMATS}")
+
+
+def stream_dataset(path, fileformat: str, d: int, batch: int, sparse: bool):
+    """Bounded-memory (X_batch, y_batch) iterator under any
+    --fileformat (the streaming-predict IO seam)."""
+    from ..io import stream_hdf5, stream_libsvm
+
+    if fileformat == "libsvm":
+        return stream_libsvm(path, d, batch, sparse=sparse)
+    if fileformat == "hdf5_dense":
+        return stream_hdf5(path, batch, sparse=sparse)
+    if fileformat == "hdf5_sparse":
+        return stream_hdf5(path, batch, sparse=True)
+    raise ValueError(f"unknown fileformat {fileformat!r}; use {FILE_FORMATS}")
 
 
 def load_classes(modelfile):

@@ -13,6 +13,8 @@ import time
 
 import numpy as np
 
+from .common import FILE_FORMATS
+
 _ALGS = {0: "exact", 1: "faster", 2: "approximate", 3: "sketched", 4: "largescale"}
 
 
@@ -40,6 +42,9 @@ def main(argv=None) -> int:
     p.add_argument("--tolerance", type=float, default=1e-3)
     p.add_argument("--max-split", type=int, default=0)
     p.add_argument("--sparse", action="store_true")
+    p.add_argument("--fileformat", default="libsvm", choices=FILE_FORMATS,
+                   help="train/test container (hdf5 via "
+                        "skylark-convert2hdf5 or the reference layout)")
     p.add_argument("--x64", action="store_true")
     args = p.parse_args(argv)
 
@@ -50,12 +55,13 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
 
     from ..core.context import SketchContext
-    from ..io import read_libsvm
     from ..ml import KrrParams, kernel_by_name
     from ..ml import krr as krr_mod
     from ..ml import rlsc as rlsc_mod
+    from .common import load_dataset
 
-    X, y = read_libsvm(args.trainfile, sparse=args.sparse)
+    is_sparse = args.sparse or args.fileformat == "hdf5_sparse"
+    X, y = load_dataset(args.trainfile, args.fileformat, args.sparse)
     n, d = X.shape
     kparams = {
         "linear": {},
@@ -75,7 +81,7 @@ def main(argv=None) -> int:
         max_split=args.max_split,
     )
 
-    Xj = X if args.sparse else jnp.asarray(X)
+    Xj = X if is_sparse else jnp.asarray(X)
     t0 = time.perf_counter()
     alg = _ALGS[args.algorithm]
     yj = jnp.asarray(y) if args.regression else y
@@ -117,8 +123,10 @@ def main(argv=None) -> int:
     print(f"Model saved to {args.modelfile}")
 
     if args.testfile:
-        Xt, yt = read_libsvm(args.testfile, n_features=d, sparse=args.sparse)
-        Xtj = Xt if args.sparse else jnp.asarray(Xt)
+        Xt, yt = load_dataset(
+            args.testfile, args.fileformat, args.sparse, n_features=d
+        )
+        Xtj = Xt if is_sparse else jnp.asarray(Xt)
         print_test_metrics(model, Xtj, yt, args.regression)
     return 0
 

@@ -13,6 +13,8 @@ import time
 
 import numpy as np
 
+from .common import FILE_FORMATS
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="skylark-ml")
@@ -41,6 +43,9 @@ def main(argv=None) -> int:
     p.add_argument("--usefast", action="store_true")
     p.add_argument("--seed", "-s", type=int, default=12345)
     p.add_argument("--sparse", action="store_true")
+    p.add_argument("--fileformat", default="libsvm", choices=FILE_FORMATS,
+                   help="train/val/test container (hdf5 via "
+                        "skylark-convert2hdf5 or the reference layout)")
     p.add_argument("--x64", action="store_true")
     p.add_argument("--outputfile", "-o", default=None,
                    help="stream test predictions to this file (bounded "
@@ -56,14 +61,17 @@ def main(argv=None) -> int:
     import jax.numpy as jnp
 
     from ..core.context import SketchContext
-    from ..io import read_libsvm
     from ..ml import ADMMParams, BlockADMMSolver, FeatureMapModel, kernel_by_name
+    from .common import load_dataset, stream_dataset
 
     if args.trainfile is None and args.testfile is None:
         p.error("need --trainfile (train) or --testfile + --modelfile (predict)")
 
+    # hdf5_sparse yields BCOO regardless of --sparse; unify downstream.
+    is_sparse = args.sparse or args.fileformat == "hdf5_sparse"
+
     if args.trainfile:
-        X, y = read_libsvm(args.trainfile, sparse=args.sparse)
+        X, y = load_dataset(args.trainfile, args.fileformat, args.sparse)
         n, d = X.shape
         kparams = {
             "linear": {},
@@ -99,10 +107,12 @@ def main(argv=None) -> int:
         )
         Xv = Yv = None
         if args.valfile:
-            Xv, Yv = read_libsvm(args.valfile, n_features=d, sparse=args.sparse)
+            Xv, Yv = load_dataset(
+                args.valfile, args.fileformat, args.sparse, n_features=d
+            )
         t0 = time.perf_counter()
         model = solver.train(
-            np.asarray(X) if not args.sparse else X,
+            np.asarray(X) if not is_sparse else X,
             y,
             regression=args.regression,
             Xv=Xv,
@@ -126,15 +136,13 @@ def main(argv=None) -> int:
         d = model.input_dim
         if args.outputfile:
             # Streaming predict (≙ the reference's line-by-line predict IO).
-            from ..io import stream_libsvm
-
             n_done = correct = 0
             sq_err = sq_nrm = 0.0
             with open(args.outputfile, "w") as out:
-                for Xb, yb in stream_libsvm(
-                    args.testfile, d, args.batch, sparse=args.sparse
+                for Xb, yb in stream_dataset(
+                    args.testfile, args.fileformat, d, args.batch, args.sparse
                 ):
-                    if not args.sparse:
+                    if not is_sparse:
                         Xb = jnp.asarray(Xb)
                     if args.regression or getattr(model, "classes", None) is None:
                         pred = np.asarray(model.predict(Xb))
@@ -159,8 +167,10 @@ def main(argv=None) -> int:
         else:
             from .common import print_test_metrics
 
-            Xt, yt = read_libsvm(args.testfile, n_features=d, sparse=args.sparse)
-            Xtj = Xt if args.sparse else jnp.asarray(Xt)
+            Xt, yt = load_dataset(
+                args.testfile, args.fileformat, args.sparse, n_features=d
+            )
+            Xtj = Xt if is_sparse else jnp.asarray(Xt)
             print_test_metrics(model, Xtj, yt, args.regression)
     return 0
 

@@ -1,7 +1,7 @@
 """Dataset IO (≙ reference ``ml/io.hpp``, ``utility/io/libsvm_io.hpp``;
 byte-source seam ≙ the HDFS reader variants at ``libsvm_io.hpp:1495-1638``)."""
 
-from .hdf5 import read_hdf5, write_hdf5
+from .hdf5 import read_hdf5, stream_hdf5, write_hdf5
 from .libsvm import read_libsvm, stream_libsvm, write_libsvm
 from .source import (
     ByteSource,
@@ -18,6 +18,7 @@ __all__ = [
     "stream_libsvm",
     "read_hdf5",
     "write_hdf5",
+    "stream_hdf5",
     "ByteSource",
     "LocalSource",
     "MemorySource",

@@ -379,7 +379,10 @@ def streaming_kernel_ridge(
         # (n near-prime) would turn the panel loops into per-row
         # iteration — error out with an actionable message instead.
         best = max(b for b in range(1, block_rows + 1) if n % b == 0)
-        if best < max(256, block_rows // 16):
+        # best == n is always usable (the whole problem fits in ONE
+        # panel — nb=1 — the degenerate-divisor concern is moot); only
+        # error when a large n truly fractures into tiny panels.
+        if best < n and best < max(256, block_rows // 16):
             raise ValueError(
                 f"n={n} has no usable panel divisor <= {block_rows} "
                 f"(best is {best}); pad n to a composite size or pass a "

@@ -22,6 +22,7 @@ from typing import Sequence
 
 import jax.numpy as jnp
 import numpy as np
+from jax.experimental import sparse as jsparse
 
 from ..sketch.base import Dimension, from_dict as sketch_from_dict
 
@@ -51,10 +52,12 @@ class FeatureMapModel:
         )
 
     def features(self, X):
-        """Concatenated (n, D) feature matrix for X (n, d)."""
-        X = jnp.asarray(X)
+        """Concatenated (n, D) feature matrix for X (n, d); BCOO inputs
+        pass through to the maps' input-sparsity apply paths."""
+        if not isinstance(X, jsparse.BCOO):
+            X = jnp.asarray(X)
         if not self.maps:
-            return X
+            return X if not isinstance(X, jsparse.BCOO) else X.todense()
         blocks = []
         for S in self.maps:
             Z = S.apply(X, Dimension.ROWWISE)

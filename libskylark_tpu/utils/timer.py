@@ -91,6 +91,24 @@ def timer_report(totals, counts=None, distributed: bool = False) -> str:
     from jax.experimental import multihost_utils
 
     names = sorted(totals)
+    # The gather below aligns columns positionally, so every process must
+    # bring the SAME phase-name list; a rank that recorded a different set
+    # would silently misalign (or crash on a shape mismatch deep inside
+    # the gather).  Validate first: gather a stable hash of the name list
+    # and fail loudly on disagreement.
+    import zlib
+
+    sig = np.asarray(
+        [zlib.crc32("\x00".join(names).encode()), len(names)], np.int64
+    )
+    sigs = np.atleast_2d(np.asarray(multihost_utils.process_allgather(sig)))
+    if not (sigs == sigs[0]).all():
+        raise RuntimeError(
+            "timer_report(distributed=True): processes recorded different "
+            f"phase-name sets (this rank has {names}); every rank must time "
+            "the same phases — the reference's SKYLARK_TIMER_PRINT has the "
+            "same world-collective contract (utility/timer.hpp:44-66)"
+        )
     vec = np.asarray([totals[n] for n in names], np.float64)
     cnt = np.asarray([(counts or {}).get(n, 1) or 1 for n in names], np.int64)
     stacked = np.atleast_2d(np.asarray(multihost_utils.process_allgather(vec)))

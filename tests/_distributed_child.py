@@ -1,0 +1,125 @@
+"""Child process for the two-process ``jax.distributed`` test.
+
+Usage: ``python tests/_distributed_child.py <proc_id> <num_procs> <port>``.
+
+Each process initializes the distributed runtime against a localhost
+coordinator (≙ one rank of the reference's ``mpirun -np 2`` unit tests,
+``tests/unit/CMakeLists.txt:11-38``), then runs the cross-process
+checks and prints one ``CHECK <name> OK`` line per check plus a final
+``DIST-OK``.  The parent treats missing lines as failures.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    proc_id, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    # 2 virtual CPU devices per process → a 4-device global mesh spanning
+    # both processes (collectives must cross the process boundary).
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    import jax
+
+    # The axon sitecustomize force-sets jax_platforms to "axon,cpu";
+    # this test is a CPU multi-process test by construction.
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs,
+        process_id=proc_id,
+        initialization_timeout=60,
+    )
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    assert jax.process_count() == nprocs, jax.process_count()
+    assert len(jax.devices()) == 2 * nprocs, jax.devices()
+    print("CHECK world OK", flush=True)
+
+    mesh = Mesh(np.asarray(jax.devices()), ("p",))
+    nglobal = len(jax.devices())
+
+    # -- 1. cross-process psum -------------------------------------------
+    # Global arange sharded one element per device; psum must see every
+    # process's contribution (gloo collectives over the loopback).
+    sh = NamedSharding(mesh, P("p"))
+    x = jax.make_array_from_callback(
+        (nglobal,), sh, lambda idx: np.arange(nglobal, dtype=np.float32)[idx]
+    )
+    summed = jax.jit(
+        jax.shard_map(
+            lambda a: jax.lax.psum(a, "p"), mesh=mesh,
+            in_specs=P("p"), out_specs=P(),
+        )
+    )(x)
+    got = float(np.asarray(summed.addressable_data(0))[0])
+    want = float(np.arange(nglobal).sum())
+    assert got == want, (got, want)
+    print("CHECK psum OK", flush=True)
+
+    # -- 2. sharded sketch parity across the process boundary ------------
+    # Counter-based RNG: both processes realize the SAME JLT from
+    # (seed, counter) alone, so each local shard of the P2 rowwise apply
+    # must equal the matching rows of an unsharded local apply.
+    from libskylark_tpu import SketchContext
+    from libskylark_tpu.parallel import rowwise_sharded
+    from libskylark_tpu.sketch.dense import JLT
+
+    m, n, s = 64, 32, 16
+    X_full = np.random.default_rng(7).standard_normal((m, n)).astype(
+        np.float32
+    )
+    S = JLT(n, s, SketchContext(seed=21))
+    ref = np.asarray(S.apply(jnp.asarray(X_full), "rowwise"))
+    Xg = jax.make_array_from_callback(
+        (m, n), NamedSharding(mesh, P("p", None)), lambda idx: X_full[idx]
+    )
+    out = rowwise_sharded(S, Xg, mesh)
+    for shard in out.addressable_shards:
+        np.testing.assert_allclose(
+            np.asarray(shard.data), ref[shard.index], rtol=1e-5, atol=1e-6
+        )
+    print("CHECK sketch-parity OK", flush=True)
+
+    # -- 3. timer_report(distributed=True) at world size 2 ---------------
+    import time
+
+    from libskylark_tpu.utils import PhaseTimer
+    from libskylark_tpu.utils.timer import timer_report
+
+    t = PhaseTimer()
+    with t.phase("work"):
+        time.sleep(0.2 * (proc_id + 1))  # rank-skewed totals
+    report = t.report(distributed=True)
+    assert f"over {nprocs} processes" in report, report
+    row = next(line for line in report.splitlines() if "work" in line)
+    cols = row.split()
+    tmin, tmax = float(cols[1]), float(cols[2])
+    assert tmax > tmin, report  # the skew must be visible in min/max
+    print("CHECK timer-report OK", flush=True)
+
+    # -- 4. mismatched phase sets must raise, not misalign ----------------
+    bad = {"only_on_rank_1": 1.0} if proc_id else {"only_on_rank_0": 1.0}
+    try:
+        timer_report(bad, distributed=True)
+    except RuntimeError as e:
+        assert "different" in str(e)
+        print("CHECK timer-mismatch OK", flush=True)
+    else:
+        raise AssertionError("mismatched phase names did not raise")
+
+    print("DIST-OK", flush=True)
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

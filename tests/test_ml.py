@@ -218,6 +218,27 @@ class TestKRR:
             rtol=1e-4, atol=1e-6,
         )
 
+    def test_streaming_small_n_default_block_rows(self, rng):
+        """Small n with the DEFAULT block_rows must fall back to one
+        whole-problem panel (nb=1), not raise (round-3 advisor finding:
+        the degenerate-divisor guard spuriously rejected every
+        n < block_rows//16 because best==n was not exempted)."""
+        import jax
+
+        from libskylark_tpu.ml import streaming_kernel_ridge
+
+        n, d, s = 500, 8, 32  # 500 < 262144//16; divisors of 500 top out at n
+        X = jnp.asarray(rng.standard_normal((n, d)))
+        y = jnp.asarray(np.tanh(np.asarray(X) @ rng.standard_normal(d)))
+        m = streaming_kernel_ridge(
+            GaussianKernel(d, sigma=2.0),
+            lambda start, rows: jax.lax.dynamic_slice(X, (start, 0), (rows, d)),
+            (n, d), y, 0.1, s, SketchContext(seed=11),
+            KrrParams(max_split=0, iter_lim=3, tolerance=0.0),
+            feature_dtype=X.dtype,  # default block_rows=262144 on purpose
+        )
+        assert np.asarray(m.W).shape[0] == s
+
 
 class TestRLSC:
     def test_kernel_rlsc_separable(self, rng):
