@@ -395,92 +395,14 @@ def streaming_kernel_ridge(
 
     sizes = _chunk_sizes(d, s, params)
     maps = [kernel.create_rft(sz, _tag(params), context) for sz in sizes]
-    lam_ = jnp.float32(lam)
 
-    def chunk_Zp(c, start, bargs, ops):
-        """(block_rows, sz) feature panel of chunk c, built in-graph.
-        Natural rowwise layout: every consumer contracts it with
-        ``dot_general`` directly — materializing a transpose (or an
-        astype-to-f32 copy) of the panel costs ~3 extra HBM passes per
-        visit, measured ~2.3 s/sweep-pass at the 10M×4096 shape.  The
-        map's counter-realized operands are hoisted to ``ops`` (once per
-        program, outside the panel loop): XLA does not LICM the ~11 ms
-        per-visit W realization out of the fori_loop by itself."""
-        Xp = block_fn(start, block_rows, *bargs).astype(feature_dtype)
-        return maps[c].apply_with_operands(ops, Xp, Dimension.ROWWISE)
-
-    # Per-chunk jitted programs (static chunk index → static sz).  The
-    # panel loops are fori_loops: one compile per chunk, not per panel.
-    def make_programs(c):
-        # All contractions consume the (block_rows, sz) panel in place
-        # via dot_general with an f32 preferred_element_type: bf16
-        # panels contract at MXU rate with exact-f32 accumulation and
-        # are never rounded back (the _psd_gram hazard) nor upcast into
-        # a materialized f32 copy.  precision='highest' pins the f32/f64
-        # feature case.
-
-        def _prec(dtype):
-            return None if dtype == jnp.bfloat16 else "highest"
-
-        @jax.jit
-        def gram(*bargs):
-            ops = maps[c].hoistable_operands(feature_dtype)
-
-            def body(p, G):
-                Zp = chunk_Zp(c, p * block_rows, bargs, ops)
-                blk = jax.lax.dot_general(
-                    Zp, Zp, (((0,), (0,)), ((), ())),
-                    precision=_prec(Zp.dtype),
-                    preferred_element_type=jnp.float32,
-                )
-                return G + blk
-
-            G = jax.lax.fori_loop(
-                0, nb, body, jnp.zeros((sizes[c], sizes[c]), jnp.float32)
-            )
-            return G + lam_ * jnp.eye(sizes[c], dtype=jnp.float32)
-
-        @jax.jit
-        def zr(R, Wc, *bargs):
-            ops = maps[c].hoistable_operands(feature_dtype)
-
-            def body(p, acc):
-                Zp = chunk_Zp(c, p * block_rows, bargs, ops)
-                Rp = jax.lax.dynamic_slice(
-                    R, (p * block_rows, 0), (block_rows, t)
-                )
-                return acc + jax.lax.dot_general(
-                    Zp, Rp, (((0,), (0,)), ((), ())),
-                    precision=_prec(Zp.dtype),
-                    preferred_element_type=jnp.float32,
-                )
-
-            acc0 = jnp.zeros((sizes[c], t), jnp.float32)
-            return jax.lax.fori_loop(0, nb, body, acc0) - lam_ * Wc
-
-        @jax.jit
-        def apply_delta(R, delta, *bargs):
-            ops = maps[c].hoistable_operands(feature_dtype)
-
-            def body(p, R):
-                Zp = chunk_Zp(c, p * block_rows, bargs, ops)
-                upd = jax.lax.dot_general(
-                    Zp, delta.astype(Zp.dtype), (((1,), (0,)), ((), ())),
-                    precision=_prec(Zp.dtype),
-                    preferred_element_type=jnp.float32,
-                )
-                Rp = jax.lax.dynamic_slice(
-                    R, (p * block_rows, 0), (block_rows, t)
-                )
-                return jax.lax.dynamic_update_slice(
-                    R, Rp - upd, (p * block_rows, 0)
-                )
-
-            return jax.lax.fori_loop(0, nb, body, R)
-
-        return gram, zr, apply_delta
-
-    programs = [make_programs(c) for c in range(len(maps))]
+    programs = [
+        streaming_krr_chunk_programs(
+            maps, c, sizes[c], nb, block_rows, t, lam, block_fn,
+            feature_dtype,
+        )
+        for c in range(len(maps))
+    ]
     factors = []
     Ws = [jnp.zeros((sz, t), jnp.float32) for sz in sizes]
     R = Y2.astype(jnp.float32)
@@ -516,3 +438,96 @@ def streaming_kernel_ridge(
 
     W = jnp.concatenate(Ws, axis=0)
     return FeatureMapModel(maps, W)
+
+
+def streaming_krr_chunk_programs(
+    maps, c, sz, nb, block_rows, t, lam, block_fn, feature_dtype
+):
+    """The three jitted per-chunk programs of the streaming-KRR sweep:
+    ``(gram(*bargs), zr(R, Wc, *bargs), apply_delta(R, delta, *bargs))``.
+
+    Module-level (not a closure of :func:`streaming_kernel_ridge`) so
+    the communication-cost model (``experiments/comm_model.py``, VERDICT
+    r3 item 5) can AOT-lower the SAME programs on a virtual mesh and
+    read the collectives out of the compiled HLO.
+
+    All contractions consume the (block_rows, sz) panel in place via
+    dot_general with an f32 preferred_element_type: bf16 panels contract
+    at MXU rate with exact-f32 accumulation and are never rounded back
+    (the _psd_gram hazard) nor upcast into a materialized f32 copy.
+    precision='highest' pins the f32/f64 feature case.
+    """
+    lam_ = jnp.float32(lam)
+
+    def chunk_Zp(start, bargs, ops):
+        """(block_rows, sz) feature panel of chunk c, built in-graph.
+        Natural rowwise layout: every consumer contracts it with
+        ``dot_general`` directly — materializing a transpose (or an
+        astype-to-f32 copy) of the panel costs ~3 extra HBM passes per
+        visit, measured ~2.3 s/sweep-pass at the 10M×4096 shape.  The
+        map's counter-realized operands are hoisted to ``ops`` (once per
+        program, outside the panel loop): XLA does not LICM the ~11 ms
+        per-visit W realization out of the fori_loop by itself."""
+        Xp = block_fn(start, block_rows, *bargs).astype(feature_dtype)
+        return maps[c].apply_with_operands(ops, Xp, Dimension.ROWWISE)
+
+    def _prec(dtype):
+        return None if dtype == jnp.bfloat16 else "highest"
+
+    @jax.jit
+    def gram(*bargs):
+        ops = maps[c].hoistable_operands(feature_dtype)
+
+        def body(p, G):
+            Zp = chunk_Zp(p * block_rows, bargs, ops)
+            blk = jax.lax.dot_general(
+                Zp, Zp, (((0,), (0,)), ((), ())),
+                precision=_prec(Zp.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            return G + blk
+
+        G = jax.lax.fori_loop(
+            0, nb, body, jnp.zeros((sz, sz), jnp.float32)
+        )
+        return G + lam_ * jnp.eye(sz, dtype=jnp.float32)
+
+    @jax.jit
+    def zr(R, Wc, *bargs):
+        ops = maps[c].hoistable_operands(feature_dtype)
+
+        def body(p, acc):
+            Zp = chunk_Zp(p * block_rows, bargs, ops)
+            Rp = jax.lax.dynamic_slice(
+                R, (p * block_rows, 0), (block_rows, t)
+            )
+            return acc + jax.lax.dot_general(
+                Zp, Rp, (((0,), (0,)), ((), ())),
+                precision=_prec(Zp.dtype),
+                preferred_element_type=jnp.float32,
+            )
+
+        acc0 = jnp.zeros((sz, t), jnp.float32)
+        return jax.lax.fori_loop(0, nb, body, acc0) - lam_ * Wc
+
+    @jax.jit
+    def apply_delta(R, delta, *bargs):
+        ops = maps[c].hoistable_operands(feature_dtype)
+
+        def body(p, R):
+            Zp = chunk_Zp(p * block_rows, bargs, ops)
+            upd = jax.lax.dot_general(
+                Zp, delta.astype(Zp.dtype), (((1,), (0,)), ((), ())),
+                precision=_prec(Zp.dtype),
+                preferred_element_type=jnp.float32,
+            )
+            Rp = jax.lax.dynamic_slice(
+                R, (p * block_rows, 0), (block_rows, t)
+            )
+            return jax.lax.dynamic_update_slice(
+                R, Rp - upd, (p * block_rows, 0)
+            )
+
+        return jax.lax.fori_loop(0, nb, body, R)
+
+    return gram, zr, apply_delta

@@ -387,3 +387,70 @@ class TestLinearCLI:
         assert rc == 0
         x = np.load(tmp_path / "x.npy")
         np.testing.assert_allclose(x, x_true, rtol=1e-4, atol=1e-6)
+
+
+class TestStreamingKrrCommSchedule:
+    """HLO lock for the sharded streaming-KRR chunk programs — the comm
+    structure the v5p-32 bound in BASELINE.md is computed from
+    (``experiments/comm_model.py``).  Two load-bearing properties:
+    (1) XLA hoists the per-panel partial-contraction psums OUT of the
+    panel while-loop (one all-reduce per program, not nb); (2) the
+    traced-offset dynamic_slice of the row-sharded residual costs
+    all-gathers of R — known, bounded, and counted in the model.  A JAX
+    upgrade that regresses either changes these counts."""
+
+    def _programs(self):
+        from libskylark_tpu.ml import GaussianKernel, KrrParams
+        from libskylark_tpu.ml.krr import (
+            _chunk_sizes,
+            _tag,
+            streaming_krr_chunk_programs,
+        )
+        from libskylark_tpu.parallel import constrain_rows
+
+        mesh = default_mesh()
+        N, D, S, BR, T = 64 * mesh.size, 16, 8, 16 * mesh.size, 1
+        kernel = GaussianKernel(D, sigma=2.0)
+        params = KrrParams(max_split=0)
+        sizes = _chunk_sizes(D, S, params)
+        maps = [
+            kernel.create_rft(sz, _tag(params), SketchContext(seed=72))
+            for sz in sizes
+        ]
+
+        def block_fn(start, rows):
+            base = jax.lax.broadcasted_iota(jnp.float32, (rows, D), 0)
+            return constrain_rows(base * 1e-3, mesh)
+
+        progs = streaming_krr_chunk_programs(
+            maps, 0, sizes[0], N // BR, BR, T, 0.1, block_fn, jnp.float32
+        )
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        row_sh = NamedSharding(mesh, P(mesh.axis_names[0], None))
+        rep_sh = NamedSharding(mesh, P())
+        R = jax.ShapeDtypeStruct((N, T), jnp.float32, sharding=row_sh)
+        W = jax.ShapeDtypeStruct((sizes[0], T), jnp.float32, sharding=rep_sh)
+        return progs, R, W
+
+    @staticmethod
+    def _counts(jitted, *specs):
+        from collections import Counter
+
+        txt = jitted.lower(*specs).compile().as_text()
+        return Counter(m.group(1) for m in _COLLECTIVE_RE.finditer(txt))
+
+    def test_gram_one_allreduce_hoisted(self):
+        (gram, _, _), R, W = self._programs()
+        counts = self._counts(gram)
+        assert counts == {"all-reduce": 1}, counts
+
+    def test_zr_schedule(self):
+        (_, zr, _), R, W = self._programs()
+        counts = self._counts(zr, R, W)
+        assert counts == {"all-reduce": 1, "all-gather": 1}, counts
+
+    def test_apply_delta_schedule(self):
+        (_, _, apply_delta), R, W = self._programs()
+        counts = self._counts(apply_delta, R, W)
+        assert counts == {"all-gather": 2}, counts

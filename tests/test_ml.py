@@ -218,6 +218,39 @@ class TestKRR:
             rtol=1e-4, atol=1e-6,
         )
 
+    def test_host_streamed_matches_large_scale(self, rng):
+        """The host-RAM-pool sweep loop (experiments/northstar_krr.py,
+        VERDICT r3 item 6 — real device_put per panel) runs the same BCD
+        math as large_scale_kernel_ridge: same context → same map →
+        near-identical W on the logical vstack of the pool."""
+        import sys
+
+        sys.path.insert(0, "/root/repo")
+        import experiments.northstar_krr as ns
+
+        n_panels, br, d, s = 4, 64, 16, 32
+        pool = [
+            rng.standard_normal((br, d)).astype(np.float32)
+            for _ in range(2)
+        ]
+        X = np.vstack([pool[p % 2] for p in range(n_panels)])
+        y = np.tanh(X @ rng.standard_normal(d)).astype(np.float32)
+        old = ns.N, ns.D, ns.S, ns.BR, ns.LAM
+        try:
+            ns.N, ns.D, ns.S, ns.BR, ns.LAM = n_panels * br, d, s, br, 0.1
+            W_host = np.asarray(ns.run_host_streamed(3, pool=pool, y=y,
+                                                     sigma=2.0))
+        finally:
+            ns.N, ns.D, ns.S, ns.BR, ns.LAM = old
+        m_ref = large_scale_kernel_ridge(
+            GaussianKernel(d, sigma=2.0), jnp.asarray(X), jnp.asarray(y),
+            0.1, s, SketchContext(seed=72),
+            KrrParams(max_split=0, iter_lim=3, tolerance=0.0),
+        )
+        np.testing.assert_allclose(
+            W_host, np.asarray(m_ref.W), rtol=1e-3, atol=1e-5
+        )
+
     def test_streaming_small_n_default_block_rows(self, rng):
         """Small n with the DEFAULT block_rows must fall back to one
         whole-problem panel (nb=1), not raise (round-3 advisor finding:
