@@ -1,0 +1,120 @@
+"""On-chip probe for the Pallas two-pass segment-sum (VERDICT r3 #3).
+
+Measures, at the BASELINE.md bench shape (1e7 entries → 1.024e8 slots):
+  1. XLA ``jax.ops.segment_sum`` (the 28 M nnz/s reference point);
+  2. the Pallas kernel end-to-end (``pallas_scatter.segment_sum_flat``);
+  3. pass 1 (chunk partition-sort) alone;
+  4. ``jax.lax.sort`` of the keys (is a full sort ever competitive?);
+  5. parity of 1-vs-2 on the live chip.
+
+Run on the bench chip: ``python experiments/scatter_probe.py [nnz] [T]``.
+The results pick C/P and decide whether pass 2's scalar loop needs the
+deeper (3-level, one-hot matmul finish) design.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from libskylark_tpu.sketch import pallas_scatter as ps
+
+
+def timed(tag, fn, *args, reps=3):
+    out = jax.block_until_ready(fn(*args))  # compile
+    best = min(
+        (lambda t0: (jax.block_until_ready(fn(*args)), time.perf_counter() - t0))(
+            time.perf_counter()
+        )[1]
+        for _ in range(reps)
+    )
+    print(f"{tag:<40} {best * 1e3:9.2f} ms")
+    return out, best
+
+
+def main():
+    nnz = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
+    T = int(sys.argv[2]) if len(sys.argv) > 2 else 1024 * 100_000
+    print(f"device={jax.devices()[0]} nnz={nnz:.1e} T={T:.1e} "
+          f"plan(K,P,V)={ps._plan(nnz, T)}")
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    keys = jax.random.randint(k1, (nnz,), 0, T, dtype=jnp.int32)
+    vals = jax.random.normal(k2, (nnz,), jnp.float32)
+    jax.block_until_ready((keys, vals))
+
+    xla_fn = jax.jit(
+        lambda v, k: jnp.sum(
+            jnp.abs(jax.ops.segment_sum(v, k, num_segments=T))
+        )
+    )
+    out_x, t_x = timed("XLA segment_sum", xla_fn, vals, keys)
+
+    pl_fn = jax.jit(
+        lambda v, k: jnp.sum(jnp.abs(ps.segment_sum_flat(v, k, T)))
+    )
+    out_p, t_p = timed("Pallas two-pass", pl_fn, vals, keys)
+    print(f"{'speedup':<40} {t_x / t_p:9.2f} x")
+    rel = abs(float(out_x) - float(out_p)) / max(abs(float(out_x)), 1e-30)
+    print(f"{'|sum| parity (rel)':<40} {rel:9.2e}")
+
+    # pass 1 alone (partition-sort) — reuse internals
+    from functools import partial
+
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    K, P, V = ps._plan(nnz, T)
+    PP = P + 1
+    pad = K * ps._C - nnz
+    keys_p = jnp.pad(keys, (0, pad), constant_values=PP * V - 1).reshape(
+        K, ps._C
+    )
+    vals_p = jnp.pad(vals, (0, pad)).reshape(K, ps._C)
+
+    def pass1(kp, vp):
+        sk, sv, cnt = pl.pallas_call(
+            partial(ps._partition_kernel, V, PP),
+            grid=(K,),
+            in_specs=[
+                pl.BlockSpec((1, ps._C), lambda k: (k, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, ps._C), lambda k: (k, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, ps._C), lambda k: (k, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, ps._C), lambda k: (k, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((1, PP), lambda k: (k, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((K, ps._C), jnp.int32),
+                jax.ShapeDtypeStruct((K, ps._C), jnp.float32),
+                jax.ShapeDtypeStruct((K, PP), jnp.int32),
+            ],
+            scratch_shapes=[pltpu.VMEM((1, ps._C), jnp.int32)],
+        )(kp, vp)
+        return jnp.sum(cnt) + jnp.sum(sk[0]) + jnp.sum(sv[0])
+
+    timed("pass 1 only (partition-sort)", jax.jit(pass1), keys_p, vals_p)
+
+    sort_fn = jax.jit(lambda k, v: jax.lax.sort((k, v), num_keys=1)[0][-1])
+    timed("jax.lax.sort keys+vals (calibration)", sort_fn, keys, vals)
+
+    print(f"\nnnz/s: XLA {nnz / t_x / 1e6:.0f} M, Pallas {nnz / t_p / 1e6:.0f} M"
+          f"  (target >= {5 * nnz / t_x / 1e6:.0f} M for 5x)")
+
+
+if __name__ == "__main__":
+    main()

@@ -29,6 +29,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..sketch.base import Dimension
 from ..sketch.dense import DenseSketch
+from ..sketch.hash import _segment_sum as _hash_segment_sum
 
 __all__ = [
     "rowwise_sharded",
@@ -198,9 +199,9 @@ def _columnwise_sparse_program(S, m: int, block: int, mesh: Mesh,
             start = (h * S.n, off)
             b = S.buckets(start=start, num=block)  # (block,) in-shard
             v = S.values(dtype, start=start, num=block)
-            acc = acc + jax.ops.segment_sum(
-                d * v[lr], b[lr] * m + cc, num_segments=S.s * m
-            )
+            acc = acc + _hash_segment_sum(
+                d * v[lr], b[lr] * m + cc, S.s * m
+            ).astype(dtype)
         out = acc.reshape(S.s, m)
         if scatter:
             return jax.lax.psum_scatter(
@@ -297,9 +298,9 @@ def _columnwise_sparse_2d_program(S, rblock: int, cblock: int, mesh: Mesh):
             start = (h * S.n, off)
             b = S.buckets(start=start, num=rblock)  # in-shard row window
             v = S.values(dtype, start=start, num=rblock)
-            acc = acc + jax.ops.segment_sum(
-                d * v[lr], b[lr] * cblock + lc, num_segments=S.s * cblock
-            )
+            acc = acc + _hash_segment_sum(
+                d * v[lr], b[lr] * cblock + lc, S.s * cblock
+            ).astype(dtype)
         out = acc.reshape(S.s, cblock)
         return jax.lax.psum(out, ax_r)
 
@@ -346,9 +347,9 @@ def _rowwise_sparse_program(S, block: int, mesh: Mesh):
             start = h * S.n
             b = S.buckets(start=start, num=S.n)
             v = S.values(dtype, start=start, num=S.n)
-            acc = acc + jax.ops.segment_sum(
-                d * v[cc], lr * S.s + b[cc], num_segments=block * S.s
-            )
+            acc = acc + _hash_segment_sum(
+                d * v[cc], lr * S.s + b[cc], block * S.s
+            ).astype(dtype)
         return acc.reshape(block, S.s)
 
     return jax.shard_map(

@@ -23,6 +23,8 @@ queue-then-finalize CSC build of ``hash_transform_local_sparse.hpp:88-152``.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -30,7 +32,28 @@ from jax.experimental import sparse as jsparse
 
 from ..core.context import SketchContext
 from ..core.random import sample
+from . import pallas_scatter
 from .base import Dimension, SketchTransform, register_sketch
+
+
+def _segment_sum(addends, key, num_segments: int):
+    """Flat scatter-add: the Pallas two-pass kernel on TPU (an order of
+    magnitude past XLA's scatter lowering at 1e7+ nnz — see
+    ``pallas_scatter``), ``jax.ops.segment_sum`` everywhere else.
+    ``SKYLARK_PALLAS_SCATTER=1`` forces the kernel, ``=interpret`` runs
+    it in interpret mode (CPU tests), ``SKYLARK_NO_PALLAS=1`` forces the
+    XLA path."""
+    ok = addends.dtype == jnp.float32 and pallas_scatter.supported(
+        addends.shape[0], num_segments
+    )  # f64 (x64 parity runs) must keep XLA's full-precision path
+    mode = os.environ.get("SKYLARK_PALLAS_SCATTER", "")
+    if ok and mode in ("1", "interpret"):
+        return pallas_scatter.segment_sum_flat(
+            addends, key, num_segments, interpret=(mode == "interpret")
+        )
+    if ok and mode != "0" and jax.default_backend() == "tpu":
+        return pallas_scatter.segment_sum_flat(addends, key, num_segments)
+    return jax.ops.segment_sum(addends, key, num_segments=num_segments)
 
 __all__ = ["HashSketch", "CWT", "MMT", "WZT", "SJLT"]
 
@@ -394,9 +417,9 @@ class HashSketch(SketchTransform):
                 key = b[h][hashed] * jnp.int32(batch) + cols
             else:
                 key = rows * jnp.int32(self.s) + b[h][hashed]
-            out = out + jax.ops.segment_sum(
-                data * v[h][hashed], key, num_segments=self.s * batch
-            )
+            out = out + _segment_sum(
+                data * v[h][hashed], key, self.s * batch
+            ).astype(dtype)
         shape = (self.s, batch) if axis == 0 else (batch, self.s)
         return out.reshape(shape)
 

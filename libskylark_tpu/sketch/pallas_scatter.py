@@ -1,0 +1,206 @@
+"""Pallas TPU segment-sum (scatter-add) for the sparse hash sketches.
+
+XLA's TPU scatter lowering runs ~28 M nnz/s (BASELINE.md round 3) — an
+order of magnitude off the HBM roofline for the CWT/SJLT BCOO
+``dense_output`` path (``hash.py::_apply_sparse_dense_out``), whose work
+is one flat ``out[key[i]] += val[i]`` over 1e7-1e8 entries into up to
+1e8 slots (≙ the queue-then-finalize CSC build of
+``hash_transform_local_sparse.hpp:88-152`` / the mixed sparse→dense
+apply of ``hash_transform_Mixed.hpp``).
+
+TPU has no vector scatter, so the kernel restructures the problem around
+what the hardware does have:
+
+1. **partition pass** (grid over entry chunks): each chunk of C entries
+   is sorted by destination PARTITION (``key // V``, V = slot span per
+   partition).  The rank/offset arithmetic is pure VPU work (one-hot +
+   cumsum); the final in-chunk permutation is a C-trip scalar loop in
+   VMEM.  The sorted chunk and its per-partition histogram row go back
+   to HBM.  Padding entries get the tail partition and are never read
+   again.
+2. **accumulate pass** (grid (P, K), K fastest): partition p owns slot
+   range [p·V, (p+1)·V) as an f32 VMEM scratch accumulator shaped
+   (V/128, 128) — lane-tiled, so no 8× sublane padding.  For each chunk
+   it walks the chunk's p-span (contiguous after pass 1; bounds come in
+   as (1, 1) blocks of the span table) with a scalar accumulate loop —
+   every entry is touched exactly ONCE across the whole grid — and at
+   the last chunk writes the accumulator to its output block.
+
+Total scalar work is 2 touches/entry (pass-1 permutation + pass-2
+accumulate); everything else is vector/DMA.  Fallback: anything
+unsupported (gate below) takes ``jax.ops.segment_sum``;
+``SKYLARK_NO_PALLAS=1`` forces the fallback.  C and P are module
+constants; ``experiments/scatter_probe.py`` measures the pieces on
+hardware.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["segment_sum_flat", "supported"]
+
+_C = 2048  # entries per chunk (pass-1 grid step)
+_P = 64  # target partition count; V = ceil(T / P) rounded to 1024
+_VMEM_SLOTS = 2_097_152  # max V: an 8 MB f32 accumulator
+
+
+def _plan(nnz: int, num_segments: int):
+    V = -(-num_segments // _P)
+    V = max(-(-V // 1024) * 1024, 1024)  # (V/128, 128) stays sublane-tiled
+    P = -(-num_segments // V)
+    K = -(-nnz // _C)
+    return K, P, V
+
+
+def supported(nnz: int, num_segments: int) -> bool:
+    if os.environ.get("SKYLARK_NO_PALLAS", "0") == "1":
+        return False
+    if nnz < 4 * _C or num_segments < 1024:
+        return False  # too small to amortize two passes
+    _, P, V = _plan(nnz, num_segments)
+    return V <= _VMEM_SLOTS and (P + 1) * V < (1 << 31)
+
+
+# ---------------------------------------------------------------------------
+# pass 1: chunk-sort by partition
+# ---------------------------------------------------------------------------
+
+
+def _partition_kernel(
+    V, PP, keys_ref, vals_ref, sk_ref, sv_ref, cnt_ref, dest_ref
+):
+    """Sort one (1, C) chunk by partition id; emit its histogram row."""
+    C = keys_ref.shape[1]
+    keys = keys_ref[0, :]
+    pid = jnp.minimum(keys // V, PP - 1)  # padding keys -> tail partition
+    iota_p = jax.lax.broadcasted_iota(jnp.int32, (C, PP), 1)
+    onehot = (pid[:, None] == iota_p).astype(jnp.int32)
+    counts = jnp.sum(onehot, axis=0)  # (PP,)
+    cnt_ref[0, :] = counts
+    # exclusive start of each partition's span within the sorted chunk,
+    # plus each entry's rank among same-pid entries before it
+    pstart = jnp.cumsum(counts) - counts  # (PP,)
+    inc = jnp.cumsum(onehot, axis=0)  # (C, PP)
+    rank = jnp.sum(onehot * inc, axis=1) - 1  # (C,)
+    dest_ref[0, :] = jnp.sum(onehot * pstart[None, :], axis=1) + rank
+
+    def body(i, c):
+        d = dest_ref[0, i]
+        sk_ref[0, d] = keys_ref[0, i]
+        sv_ref[0, d] = vals_ref[0, i]
+        return c
+
+    jax.lax.fori_loop(0, C, body, 0)
+
+
+# ---------------------------------------------------------------------------
+# pass 2: per-partition scalar accumulate
+# ---------------------------------------------------------------------------
+
+
+def _accumulate_kernel(
+    V, base_ref, sk_ref, sv_ref, start_ref, stop_ref, out_ref, acc_ref
+):
+    from jax.experimental import pallas as pl
+
+    k = pl.program_id(1)
+    K = pl.num_programs(1)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[:, :] = jnp.zeros_like(acc_ref)
+
+    base = base_ref[0, 0]
+    s = start_ref[0, 0]
+    e = stop_ref[0, 0]
+
+    def entry(i, c):
+        local = sk_ref[0, i] - base
+        row, lane = local // 128, local % 128
+        acc_ref[row, lane] = acc_ref[row, lane] + sv_ref[0, i]
+        return c
+
+    jax.lax.fori_loop(s, e, entry, 0)
+
+    @pl.when(k == K - 1)
+    def _emit():
+        out_ref[:, :] = acc_ref[:, :]
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("num_segments", "interpret"))
+def segment_sum_flat(vals, keys, num_segments: int, interpret: bool = False):
+    """``out[t] = sum(vals[keys == t])`` for flat int32 keys in
+    [0, num_segments).  Caller gates with :func:`supported`; ``vals``
+    and ``keys`` are 1-D and equal length."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nnz = vals.shape[0]
+    K, P, V = _plan(nnz, num_segments)
+    PP = P + 1  # + tail partition for padding entries
+    pad = K * _C - nnz
+    keys_p = jnp.pad(
+        keys.astype(jnp.int32), (0, pad), constant_values=PP * V - 1
+    ).reshape(K, _C)
+    vals_p = jnp.pad(vals.astype(jnp.float32), (0, pad)).reshape(K, _C)
+
+    sk, sv, counts = pl.pallas_call(
+        partial(_partition_kernel, V, PP),
+        grid=(K,),
+        in_specs=[
+            pl.BlockSpec((1, _C), lambda k: (k, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _C), lambda k: (k, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, _C), lambda k: (k, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _C), lambda k: (k, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, PP), lambda k: (k, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((K, _C), jnp.int32),
+            jax.ShapeDtypeStruct((K, _C), jnp.float32),
+            jax.ShapeDtypeStruct((K, PP), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((1, _C), jnp.int32)],
+        interpret=interpret,
+    )(keys_p, vals_p)
+
+    # span bounds per (chunk, partition): prefix sums along PP (XLA side)
+    stops = jnp.cumsum(counts, axis=1)
+    starts = stops - counts
+    bases = (jnp.arange(P, dtype=jnp.int32) * V).reshape(P, 1)
+
+    out = pl.pallas_call(
+        partial(_accumulate_kernel, V),
+        grid=(P, K),  # K fastest: accumulator persists across chunks
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda p, k: (p, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _C), lambda p, k: (k, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _C), lambda p, k: (k, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda p, k: (k, p),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda p, k: (k, p),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (V // 128, 128), lambda p, k: (p, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((P * V // 128, 128), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((V // 128, 128), jnp.float32)],
+        interpret=interpret,
+    )(bases, sk, sv, starts, stops)
+
+    return out.reshape(-1)[:num_segments]

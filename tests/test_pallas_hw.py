@@ -24,6 +24,7 @@ _TIMEOUT_S = 510
 
 _GUARD_NAMES = [
     "rfut_rowwise_compiled",
+    "pallas_scatter_compiled",
     "bf16_split_accuracy",
     "wht_f32_accuracy",
     "psd_gram_precision",
@@ -40,7 +41,7 @@ def guard_results():
 
     Returns ``{name: (status, detail)}`` with status in
     {"ok", "fail", "skip"}; the whole dict is built from one subprocess
-    so the tunnel backend init is paid once for all eight guards.
+    so the tunnel backend init is paid once for all guards.
     """
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")

@@ -1,0 +1,105 @@
+"""Pallas two-pass segment-sum: interpret-mode parity + gating.
+
+The compiled kernel is hardware-gated (``tests/_hw_guards.py`` +
+``experiments/scatter_probe.py``); here the algorithm itself is verified
+against ``jax.ops.segment_sum`` in interpret mode on CPU, including the
+partition-boundary and max-collision edge cases the two-pass structure
+could get wrong.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import sparse as jsparse
+
+from libskylark_tpu import SketchContext
+from libskylark_tpu.sketch import CWT, SJLT
+from libskylark_tpu.sketch.pallas_scatter import (
+    _plan,
+    segment_sum_flat,
+    supported,
+)
+
+
+def _ref(vals, keys, T):
+    out = np.zeros(T, np.float64)
+    np.add.at(out, keys, vals.astype(np.float64))
+    return out.astype(np.float32)
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize(
+        "nnz,T",
+        [
+            (10_000, 5_000),
+            (20_000, 200_000),
+            (8_193, 1024),  # one entry past the pad boundary
+            (9_000, 1 << 17),
+        ],
+    )
+    def test_random_keys(self, rng, nnz, T):
+        keys = rng.integers(0, T, nnz).astype(np.int32)
+        vals = rng.standard_normal(nnz).astype(np.float32)
+        out = np.asarray(
+            segment_sum_flat(
+                jnp.asarray(vals), jnp.asarray(keys), T, interpret=True
+            )
+        )
+        np.testing.assert_allclose(out, _ref(vals, keys, T), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_partition_boundaries_and_collisions(self, rng):
+        nnz, T = 16_384, 300_000
+        K, P, V = _plan(nnz, T)
+        # Adversarial keys: partition edges (0, V-1, V, 2V-1, T-1) and a
+        # hot segment taking ~half the entries (worst-case collisions).
+        edges = np.array([0, V - 1, V, 2 * V - 1, T - 1], np.int32)
+        keys = np.concatenate([
+            np.repeat(edges, 100),
+            np.full(nnz // 2, V + 7, np.int32),  # hot segment
+            rng.integers(0, T, nnz - 500 - nnz // 2).astype(np.int32),
+        ])
+        vals = rng.standard_normal(nnz).astype(np.float32)
+        out = np.asarray(
+            segment_sum_flat(
+                jnp.asarray(vals), jnp.asarray(keys), T, interpret=True
+            )
+        )
+        np.testing.assert_allclose(out, _ref(vals, keys, T), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_gate(self):
+        assert not supported(100, 5000)  # too small to amortize
+        assert not supported(100_000, 500)  # degenerate segment count
+        assert supported(100_000, 1 << 20)
+        os.environ["SKYLARK_NO_PALLAS"] = "1"
+        try:
+            assert not supported(100_000, 1 << 20)
+        finally:
+            del os.environ["SKYLARK_NO_PALLAS"]
+
+
+class TestHashIntegration:
+    def test_dense_output_matches_xla_path(self, rng):
+        """CWT/SJLT dense_output through the kernel (interpret) must be
+        bit-compatible with the XLA segment_sum path."""
+        n, m, s, nnz = 30_000, 64, 64, 9_000
+        rows = rng.integers(0, n, nnz).astype(np.int32)
+        cols = rng.integers(0, m, nnz).astype(np.int32)
+        data = rng.standard_normal(nnz).astype(np.float32)
+        A = jsparse.BCOO(
+            (jnp.asarray(data), jnp.asarray(np.stack([rows, cols], 1))),
+            shape=(n, m),
+        )
+        for cls, kw in [(CWT, {}), (SJLT, {"nnz": 2})]:
+            S = cls(n, s, SketchContext(seed=5), **kw)
+            os.environ["SKYLARK_PALLAS_SCATTER"] = "interpret"
+            try:
+                out_p = np.asarray(S.apply(A, "columnwise", dense_output=True))
+            finally:
+                os.environ["SKYLARK_PALLAS_SCATTER"] = "0"
+            out_x = np.asarray(S.apply(A, "columnwise", dense_output=True))
+            del os.environ["SKYLARK_PALLAS_SCATTER"]
+            np.testing.assert_allclose(out_p, out_x, rtol=1e-5, atol=1e-5)
