@@ -114,9 +114,11 @@ def main() -> None:
         maps, 0, sizes[0], nb, BR, T, 0.1, block_fn, jnp.bfloat16
     )
 
-    row_sh = NamedSharding(mesh, P(ROWS, None))
+    # Panel-major residual (nb, BR, T): panel axis unsharded, panel rows
+    # data-parallel over the mesh — matching the Z panel constraint.
+    row_sh = NamedSharding(mesh, P(None, ROWS, None))
     rep_sh = NamedSharding(mesh, P())
-    R_spec = jax.ShapeDtypeStruct((N, T), jnp.float32, sharding=row_sh)
+    R_spec = jax.ShapeDtypeStruct((nb, BR, T), jnp.float32, sharding=row_sh)
     W_spec = jax.ShapeDtypeStruct((sizes[0], T), jnp.float32, sharding=rep_sh)
     d_spec = jax.ShapeDtypeStruct((sizes[0], T), jnp.float32, sharding=rep_sh)
 

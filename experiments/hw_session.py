@@ -1,0 +1,62 @@
+"""One-shot hardware measurement battery for a live tunnel window.
+
+The shared axon tunnel comes and goes; when a quiet window opens, this
+driver runs the round's full measurement backlog in priority order, each
+stage in its own subprocess with its own timeout and log file, so a
+mid-battery hang costs one stage, not the session.
+
+Run: ``python experiments/hw_session.py [logdir]``  (defaults to
+``experiments/logs/``; prints a one-line verdict per stage).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STAGES = [
+    # (name, argv, timeout_s)
+    ("hw_guards", [sys.executable, "tests/_hw_guards.py"], 600),
+    ("scatter_probe", [sys.executable, "experiments/scatter_probe.py"], 900),
+    ("bench_full", [sys.executable, "bench.py"], 1800),
+    (
+        "northstar_host",
+        [sys.executable, "experiments/northstar_krr.py", "host", "3"],
+        1500,
+    ),
+]
+
+
+def main() -> int:
+    logdir = sys.argv[1] if len(sys.argv) > 1 else os.path.join(
+        REPO, "experiments", "logs"
+    )
+    os.makedirs(logdir, exist_ok=True)
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    failures = 0
+    for name, argv, tmo in STAGES:
+        log = os.path.join(logdir, f"{name}.log")
+        t0 = time.monotonic()
+        try:
+            with open(log, "w") as fh:
+                rc = subprocess.run(
+                    argv, stdout=fh, stderr=subprocess.STDOUT,
+                    timeout=tmo, env=env, cwd=REPO,
+                ).returncode
+            status = "ok" if rc == 0 else f"rc={rc}"
+        except subprocess.TimeoutExpired:
+            status = f"TIMEOUT {tmo}s"
+        dt = time.monotonic() - t0
+        if status != "ok":
+            failures += 1
+        print(f"{name:<18} {status:<12} {dt:7.1f}s  -> {log}", flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -405,7 +405,9 @@ def streaming_kernel_ridge(
     ]
     factors = []
     Ws = [jnp.zeros((sz, t), jnp.float32) for sz in sizes]
-    R = Y2.astype(jnp.float32)
+    # Panel-major residual (see streaming_krr_chunk_programs): sharded
+    # callers pay one reshard here, zero per-sweep R collectives after.
+    R = Y2.astype(jnp.float32).reshape(nb, block_rows, t)
 
     import contextlib
 
@@ -492,15 +494,21 @@ def streaming_krr_chunk_programs(
         )
         return G + lam_ * jnp.eye(sz, dtype=jnp.float32)
 
+    # The residual travels as (nb, block_rows, t): panels on the LEADING
+    # (unsharded) axis, rows of each panel on the shardable middle axis.
+    # A traced-index slice R3[p] then never touches the sharded
+    # dimension, so GSPMD keeps it local — the (N, t) layout with a
+    # traced-offset dynamic_slice cost a full all-gather of R per sweep
+    # on the virtual mesh (compiled-HLO finding, round 4; the one-time
+    # reshard into panel-major happens outside the sweep loop).
+
     @jax.jit
-    def zr(R, Wc, *bargs):
+    def zr(R3, Wc, *bargs):
         ops = maps[c].hoistable_operands(feature_dtype)
 
         def body(p, acc):
             Zp = chunk_Zp(p * block_rows, bargs, ops)
-            Rp = jax.lax.dynamic_slice(
-                R, (p * block_rows, 0), (block_rows, t)
-            )
+            Rp = jax.lax.dynamic_index_in_dim(R3, p, 0, keepdims=False)
             return acc + jax.lax.dot_general(
                 Zp, Rp, (((0,), (0,)), ((), ())),
                 precision=_prec(Zp.dtype),
@@ -511,23 +519,21 @@ def streaming_krr_chunk_programs(
         return jax.lax.fori_loop(0, nb, body, acc0) - lam_ * Wc
 
     @jax.jit
-    def apply_delta(R, delta, *bargs):
+    def apply_delta(R3, delta, *bargs):
         ops = maps[c].hoistable_operands(feature_dtype)
 
-        def body(p, R):
+        def body(p, R3):
             Zp = chunk_Zp(p * block_rows, bargs, ops)
             upd = jax.lax.dot_general(
                 Zp, delta.astype(Zp.dtype), (((1,), (0,)), ((), ())),
                 precision=_prec(Zp.dtype),
                 preferred_element_type=jnp.float32,
             )
-            Rp = jax.lax.dynamic_slice(
-                R, (p * block_rows, 0), (block_rows, t)
-            )
-            return jax.lax.dynamic_update_slice(
-                R, Rp - upd, (p * block_rows, 0)
+            Rp = jax.lax.dynamic_index_in_dim(R3, p, 0, keepdims=False)
+            return jax.lax.dynamic_update_index_in_dim(
+                R3, Rp - upd, p, 0
             )
 
-        return jax.lax.fori_loop(0, nb, body, R)
+        return jax.lax.fori_loop(0, nb, body, R3)
 
     return gram, zr, apply_delta
