@@ -58,13 +58,28 @@ def main():
     )
     out_x, t_x = timed("XLA segment_sum", xla_fn, vals, keys)
 
-    pl_fn = jax.jit(
-        lambda v, k: jnp.sum(jnp.abs(ps.segment_sum_flat(v, k, T)))
-    )
-    out_p, t_p = timed("Pallas two-pass", pl_fn, vals, keys)
-    print(f"{'speedup':<40} {t_x / t_p:9.2f} x")
-    rel = abs(float(out_x) - float(out_p)) / max(abs(float(out_x)), 1e-30)
-    print(f"{'|sum| parity (rel)':<40} {rel:9.2e}")
+    t_p = None
+    for mode in ("scalar", "lanemask"):
+        os.environ["SKYLARK_SCATTER_ACCUM"] = mode
+        try:
+            # fresh jit per mode: the env flag is read at trace time
+            pl_fn = jax.jit(
+                lambda v, k: jnp.sum(jnp.abs(ps.segment_sum_flat(v, k, T)))
+            )
+            out_p, t_m = timed(f"Pallas two-pass [{mode}]", pl_fn, vals, keys)
+        except Exception as e:  # noqa: BLE001 — report which mode lowers
+            print(f"Pallas [{mode}] FAILED: {type(e).__name__}: "
+                  f"{str(e)[:300]}")
+            continue
+        rel = abs(float(out_x) - float(out_p)) / max(abs(float(out_x)), 1e-30)
+        print(f"{'  speedup / |sum| parity':<40} {t_x / t_m:9.2f} x   "
+              f"rel={rel:.2e}")
+        if t_p is None or t_m < t_p:
+            t_p = t_m
+    os.environ.pop("SKYLARK_SCATTER_ACCUM", None)
+    if t_p is None:
+        print("Pallas kernel failed to lower in every mode")
+        return
 
     # pass 1 alone (partition-sort) — reuse internals
     from functools import partial

@@ -71,6 +71,28 @@ def supported(nnz: int, num_segments: int) -> bool:
 # ---------------------------------------------------------------------------
 
 
+def _cumsum_sublanes(x):
+    """Inclusive cumsum along axis 0 via log-step shifted adds — static
+    slices + pads only (Mosaic has no native cumulative-sum lowering;
+    jnp.cumsum inside a TPU kernel is not guaranteed to lower)."""
+    n, s = x.shape[0], 1
+    while s < n:
+        x = x + jnp.pad(x[:-s], ((s, 0), (0, 0)))
+        s *= 2
+    return x
+
+
+def _excl_cumsum_lanes(row):
+    """Exclusive cumsum along axis 1 of a (1, n) row, same log-step
+    construction (lane-axis shifts are static slices)."""
+    n, s = row.shape[1], 1
+    out = row
+    while s < n:
+        out = out + jnp.pad(out[:, :-s], ((0, 0), (s, 0)))
+        s *= 2
+    return out - row
+
+
 def _partition_kernel(
     V, PP, keys_ref, vals_ref, sk_ref, sv_ref, cnt_ref, dest_ref
 ):
@@ -80,14 +102,14 @@ def _partition_kernel(
     pid = jnp.minimum(keys // V, PP - 1)  # padding keys -> tail partition
     iota_p = jax.lax.broadcasted_iota(jnp.int32, (C, PP), 1)
     onehot = (pid[:, None] == iota_p).astype(jnp.int32)
-    counts = jnp.sum(onehot, axis=0)  # (PP,)
-    cnt_ref[0, :] = counts
+    counts_row = jnp.sum(onehot, axis=0, keepdims=True)  # (1, PP)
+    cnt_ref[0, :] = counts_row[0, :]
     # exclusive start of each partition's span within the sorted chunk,
     # plus each entry's rank among same-pid entries before it
-    pstart = jnp.cumsum(counts) - counts  # (PP,)
-    inc = jnp.cumsum(onehot, axis=0)  # (C, PP)
+    pstart_row = _excl_cumsum_lanes(counts_row)  # (1, PP)
+    inc = _cumsum_sublanes(onehot)  # (C, PP)
     rank = jnp.sum(onehot * inc, axis=1) - 1  # (C,)
-    dest_ref[0, :] = jnp.sum(onehot * pstart[None, :], axis=1) + rank
+    dest_ref[0, :] = jnp.sum(onehot * pstart_row, axis=1) + rank
 
     def body(i, c):
         d = dest_ref[0, i]
@@ -104,7 +126,8 @@ def _partition_kernel(
 
 
 def _accumulate_kernel(
-    V, base_ref, sk_ref, sv_ref, start_ref, stop_ref, out_ref, acc_ref
+    V, lanemask, base_ref, sk_ref, sv_ref, start_ref, stop_ref, out_ref,
+    acc_ref
 ):
     from jax.experimental import pallas as pl
 
@@ -119,11 +142,28 @@ def _accumulate_kernel(
     s = start_ref[0, 0]
     e = stop_ref[0, 0]
 
-    def entry(i, c):
-        local = sk_ref[0, i] - base
-        row, lane = local // 128, local % 128
-        acc_ref[row, lane] = acc_ref[row, lane] + sv_ref[0, i]
-        return c
+    if lanemask:
+        # Lane-masked RMW: dynamic sublane index + full-lane vector ops
+        # only (no dynamic LANE addressing, which Mosaic may not lower
+        # for scalar stores) — ~4 vector ops per entry.
+        lane_iota = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+
+        def entry(i, c):
+            local = sk_ref[0, i] - base
+            row, lane = local // 128, local % 128
+            acc_row = acc_ref[pl.ds(row, 1), :]
+            acc_ref[pl.ds(row, 1), :] = acc_row + jnp.where(
+                lane_iota == lane, sv_ref[0, i], jnp.float32(0)
+            )
+            return c
+
+    else:
+
+        def entry(i, c):
+            local = sk_ref[0, i] - base
+            row, lane = local // 128, local % 128
+            acc_ref[row, lane] = acc_ref[row, lane] + sv_ref[0, i]
+            return c
 
     jax.lax.fori_loop(s, e, entry, 0)
 
@@ -137,11 +177,24 @@ def _accumulate_kernel(
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("num_segments", "interpret"))
 def segment_sum_flat(vals, keys, num_segments: int, interpret: bool = False):
     """``out[t] = sum(vals[keys == t])`` for flat int32 keys in
     [0, num_segments).  Caller gates with :func:`supported`; ``vals``
     and ``keys`` are 1-D and equal length."""
+    # Accumulate mode: "scalar" (1 scalar RMW/entry — needs dynamic-lane
+    # addressing) or "lanemask" (vector RMW, no dynamic lanes).  Read
+    # OUTSIDE the jitted impl so a mode switch is a fresh trace, not a
+    # stale cache hit.
+    lanemask = os.environ.get("SKYLARK_SCATTER_ACCUM", "scalar") == "lanemask"
+    return _segment_sum_impl(vals, keys, num_segments, interpret, lanemask)
+
+
+@partial(
+    jax.jit, static_argnames=("num_segments", "interpret", "lanemask")
+)
+def _segment_sum_impl(
+    vals, keys, num_segments: int, interpret: bool, lanemask: bool
+):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -181,7 +234,7 @@ def segment_sum_flat(vals, keys, num_segments: int, interpret: bool = False):
     bases = (jnp.arange(P, dtype=jnp.int32) * V).reshape(P, 1)
 
     out = pl.pallas_call(
-        partial(_accumulate_kernel, V),
+        partial(_accumulate_kernel, V, lanemask),
         grid=(P, K),  # K fastest: accumulator persists across chunks
         in_specs=[
             pl.BlockSpec((1, 1), lambda p, k: (p, 0),
