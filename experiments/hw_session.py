@@ -19,9 +19,15 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 STAGES = [
-    # (name, argv, timeout_s)
+    # (name, argv, timeout_s[, extra_env])
     ("hw_guards", [sys.executable, "tests/_hw_guards.py"], 600),
     ("scatter_probe", [sys.executable, "experiments/scatter_probe.py"], 900),
+    (
+        "scatter_probe_c8192",
+        [sys.executable, "experiments/scatter_probe.py"],
+        900,
+        {"SKYLARK_SCATTER_CHUNK": "8192"},
+    ),
     ("bench_full", [sys.executable, "bench.py"], 1800),
     (
         "northstar_host",
@@ -39,14 +45,15 @@ def main() -> int:
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     failures = 0
-    for name, argv, tmo in STAGES:
+    for name, argv, tmo, *extra in STAGES:
         log = os.path.join(logdir, f"{name}.log")
+        stage_env = dict(env, **(extra[0] if extra else {}))
         t0 = time.monotonic()
         try:
             with open(log, "w") as fh:
                 rc = subprocess.run(
                     argv, stdout=fh, stderr=subprocess.STDOUT,
-                    timeout=tmo, env=env, cwd=REPO,
+                    timeout=tmo, env=stage_env, cwd=REPO,
                 ).returncode
             status = "ok" if rc == 0 else f"rc={rc}"
         except subprocess.TimeoutExpired:

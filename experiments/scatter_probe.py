@@ -45,7 +45,7 @@ def main():
     nnz = int(sys.argv[1]) if len(sys.argv) > 1 else 10_000_000
     T = int(sys.argv[2]) if len(sys.argv) > 2 else 1024 * 100_000
     print(f"device={jax.devices()[0]} nnz={nnz:.1e} T={T:.1e} "
-          f"plan(K,P,V)={ps._plan(nnz, T)}")
+          f"C={ps._C} plan(K,P,V)={ps._plan(nnz, T)}")
     k1, k2 = jax.random.split(jax.random.PRNGKey(0))
     keys = jax.random.randint(k1, (nnz,), 0, T, dtype=jnp.int32)
     vals = jax.random.normal(k2, (nnz,), jnp.float32)

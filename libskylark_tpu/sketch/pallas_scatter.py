@@ -44,7 +44,11 @@ import jax.numpy as jnp
 
 __all__ = ["segment_sum_flat", "supported"]
 
-_C = 2048  # entries per chunk (pass-1 grid step)
+# Entries per chunk (pass-1 grid step).  Larger C cuts pass-2 grid-step
+# count and chunk-revisit overhead at the cost of pass-1 VMEM (the
+# (C, P+1) one-hot/cumsum pair); env-tunable so the hardware probe can
+# sweep it (experiments/scatter_probe.py).
+_C = int(os.environ.get("SKYLARK_SCATTER_CHUNK", "2048"))
 _P = 64  # target partition count; V = ceil(T / P) rounded to 1024
 _VMEM_SLOTS = 2_097_152  # max V: an 8 MB f32 accumulator
 
