@@ -61,11 +61,20 @@ def _plan(nnz: int, num_segments: int):
     return K, P, V
 
 
+# The two passes hold (keys, vals) plus their sorted copies in HBM
+# (~16 B/entry beyond the caller's input); past this entry count the
+# working set crowds a 16 GB chip and the XLA path (in-place scatter)
+# is the safer choice (SJLT nnz=4 at 1e8 input nonzeros = 4e8 entries).
+_MAX_NNZ = 150_000_000
+
+
 def supported(nnz: int, num_segments: int) -> bool:
     if os.environ.get("SKYLARK_NO_PALLAS", "0") == "1":
         return False
     if nnz < 4 * _C or num_segments < 1024:
         return False  # too small to amortize two passes
+    if nnz > _MAX_NNZ:
+        return False
     _, P, V = _plan(nnz, num_segments)
     return V <= _VMEM_SLOTS and (P + 1) * V < (1 << 31)
 
