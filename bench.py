@@ -674,6 +674,68 @@ def _print_final() -> None:
     print(json.dumps(_FINAL), flush=True)
 
 
+class _BackendUnavailable:
+    """Sentinel returned by :func:`_init_backend` when the init budget is
+    exhausted; carries the last error string for the FAILED artifact."""
+
+    def __init__(self, error: str):
+        self.error = error
+
+
+def _init_backend():
+    """Backend init as a failable, retriable phase (VERDICT r4: the one
+    unguarded line in the file was ``jax.devices()[0]``, and it cost the
+    round its entire artifact when the tunnel was down).  Re-attempts
+    ``jax.devices()`` with backoff — clearing JAX's cached init failure
+    between attempts — for up to ``SKYLARK_BENCH_INIT_BUDGET_S``
+    (default: 40 % of the bench budget, capped at 900 s).  Returns the
+    device, or a :class:`_BackendUnavailable` sentinel on final failure;
+    the caller emits a parseable ``FAILED: backend-unavailable``
+    artifact and exits 0."""
+    init_budget = float(
+        os.environ.get(
+            "SKYLARK_BENCH_INIT_BUDGET_S", str(min(900.0, 0.4 * _BUDGET_S))
+        )
+    )
+    delay, last, hard_errors = 5.0, "unknown", 0
+    while True:
+        try:
+            return jax.devices()[0]
+        except Exception as e:  # noqa: BLE001 — UNAVAILABLE, tunnel flaps
+            last = f"{type(e).__name__}: {e}"
+            # Errors that don't self-identify as UNAVAILABLE are almost
+            # always deterministic misconfiguration (wrong platform, no
+            # plugin) — give them one retry, then stop burning the init
+            # budget.  Matching on the class of error, not exact text:
+            # PJRT messages can embed varying addresses/timestamps.
+            hard_errors += 0 if "UNAVAILABLE" in last else 1
+            if hard_errors >= 2:
+                return _BackendUnavailable(last)
+            print(
+                json.dumps(
+                    {
+                        "metric": "backend-init retry",
+                        "value": round(_remaining(), 1),
+                        "unit": "s-remaining",
+                        "vs_baseline": 0,
+                        "error": last[:200],
+                    }
+                ),
+                file=sys.stderr,
+                flush=True,
+            )
+        if time.monotonic() - _T0 > init_budget:
+            return _BackendUnavailable(last)
+        try:  # un-stick the cached failure so the next attempt is real
+            import jax.extend.backend as _eb
+
+            _eb.clear_backends()
+        except Exception:  # noqa: BLE001 — best-effort
+            pass
+        time.sleep(min(delay, max(1.0, init_budget - (time.monotonic() - _T0))))
+        delay = min(delay * 1.7, 60.0)
+
+
 def main() -> None:
     global _FINAL
     # The axon sitecustomize force-sets jax_platforms to "axon,cpu",
@@ -682,16 +744,51 @@ def main() -> None:
     # a congested tunnel it never wanted.
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-    dev = jax.devices()[0]
-    on_tpu = dev.platform in ("tpu", "axon")
-    peak = _peak_tflops(dev)
-    table: list[dict] = []
 
     def _flush_on_term(signum, frame):
         _print_final()
         sys.exit(0)
 
+    # Handler + provisional FAILED _FINAL are installed BEFORE backend
+    # init: a driver timeout that fires mid-retry-loop still flushes a
+    # parseable artifact (the round-4 failure mode).
+    provisional = {
+        "metric": "JLT dense sketch-apply throughput "
+        "(FAILED: killed-during-backend-init)",
+        "value": -1,
+        "unit": "error",
+        "vs_baseline": 0,
+    }
+    _FINAL = dict(provisional, submetrics=[dict(provisional)])
     signal.signal(signal.SIGTERM, _flush_on_term)
+
+    dev = _init_backend()
+    if isinstance(dev, _BackendUnavailable):
+        # Same last-line contract as every other terminal path: the
+        # FAILED headline carries a (single-row) submetrics table and
+        # goes out through _print_final.
+        row = {
+            "metric": "JLT dense sketch-apply throughput "
+            "(FAILED: backend-unavailable)",
+            "value": -1,
+            "unit": "error",
+            "vs_baseline": 0,
+            "error": dev.error[:200],
+        }
+        print(json.dumps(row), flush=True)
+        _FINAL = dict(row, submetrics=[dict(row)])
+        _print_final()
+        sys.exit(0)
+    # Init succeeded: re-stamp the provisional so a kill during the
+    # headline bench is attributed to the right phase (_FINAL holds
+    # copies, so rebuild it rather than mutating `provisional`).
+    provisional["metric"] = (
+        "JLT dense sketch-apply throughput (FAILED: killed-before-headline)"
+    )
+    _FINAL = dict(provisional, submetrics=[dict(provisional)])
+    on_tpu = dev.platform in ("tpu", "axon")
+    peak = _peak_tflops(dev)
+    table: list[dict] = []
 
     # -- flagships FIRST (round 4): a budget/timeout can no longer eat
     # the rows the driver exists to record.  The headline is firewalled
@@ -749,7 +846,7 @@ def main() -> None:
         ("ridge", 80, lambda: bench_ridge(on_tpu, table)),
         ("ADMM", 160, lambda: bench_admm(on_tpu, table)),
         ("QRFT", 90, lambda: bench_qrft(on_tpu, table)),
-        ("RLT", 80, lambda: bench_rlt(on_tpu, table, baseline_ms=None)),
+        ("RLT", 80, lambda: bench_rlt(on_tpu, table)),
     ]
     for name, est_s, fn in secondaries:
         if on_tpu and _remaining() < 0.6 * est_s:

@@ -36,13 +36,79 @@ from . import pallas_scatter
 from .base import Dimension, SketchTransform, register_sketch
 
 
+_KERNEL_COMPILES: bool | None = None
+
+
+def _kernel_compiles() -> bool:
+    """One-time compiled self-test of the Pallas scatter kernel on the
+    default backend.  The kernel's scalar-accumulate stores are the part
+    Mosaic may refuse to lower on some TPU generations; running the
+    shared validator once here (under ``ensure_compile_time_eval`` so it
+    executes eagerly even when the caller is mid-trace) turns a
+    would-be compile-time crash of every CWT/SJLT dense apply into a
+    warned, process-wide XLA fallback."""
+    global _KERNEL_COMPILES
+    for attempt in range(3):
+        if _KERNEL_COMPILES is not None:
+            break
+        import warnings
+
+        try:
+            # Shared validator (random keys across the full segment
+            # range — a kernel that lowers but mis-resolves dynamic-lane
+            # addressing must fail the comparison); same code path as
+            # the hardware guard, so the two cannot drift.  The verdict
+            # is cached unconditionally: callers sit inside jit traces,
+            # so whichever branch the first trace takes is baked into
+            # the compiled program anyway — a per-call re-probe would be
+            # an illusion (and nnz probes per SJLT trace, a stampede).
+            # ensure_compile_time_eval: under omnistaging the probe's
+            # ops would otherwise be staged into the *caller's* trace
+            # and the float() readback would raise ConcretizationError.
+            with jax.ensure_compile_time_eval():
+                err = pallas_scatter.self_check()
+            _KERNEL_COMPILES = err < 1e-5
+            if not _KERNEL_COMPILES:
+                warnings.warn(
+                    "Pallas scatter kernel compiled but miscomputed "
+                    f"(rel err {err:g} vs segment_sum); falling back to "
+                    "jax.ops.segment_sum for this process",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        except Exception as e:  # noqa: BLE001 — any lowering failure → XLA
+            # Transient device errors (tunnel flap) get two bounded
+            # in-probe retries; the final verdict is still cached
+            # unconditionally — it gets baked into callers' jit caches
+            # either way, so a post-hoc re-probe would be an illusion.
+            msg = repr(e)
+            transient = any(
+                tok in msg
+                for tok in ("UNAVAILABLE", "DEADLINE", "RESOURCE_EXHAUSTED")
+            )
+            if transient and attempt < 2:
+                import time
+
+                time.sleep(3.0)
+                continue
+            warnings.warn(
+                "Pallas scatter kernel probe failed; falling back to "
+                f"jax.ops.segment_sum for this process: {msg[:300]}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            _KERNEL_COMPILES = False
+    return _KERNEL_COMPILES
+
+
 def _segment_sum(addends, key, num_segments: int):
     """Flat scatter-add: the Pallas two-pass kernel on TPU (an order of
     magnitude past XLA's scatter lowering at 1e7+ nnz — see
     ``pallas_scatter``), ``jax.ops.segment_sum`` everywhere else.
     ``SKYLARK_PALLAS_SCATTER=1`` forces the kernel, ``=interpret`` runs
     it in interpret mode (CPU tests), ``SKYLARK_NO_PALLAS=1`` forces the
-    XLA path."""
+    XLA path.  The TPU-default branch only engages after a one-time
+    compiled probe confirms Mosaic can lower the kernel (ADVICE r4)."""
     ok = addends.dtype == jnp.float32 and pallas_scatter.supported(
         addends.shape[0], num_segments
     )  # f64 (x64 parity runs) must keep XLA's full-precision path
@@ -51,7 +117,12 @@ def _segment_sum(addends, key, num_segments: int):
         return pallas_scatter.segment_sum_flat(
             addends, key, num_segments, interpret=(mode == "interpret")
         )
-    if ok and mode != "0" and jax.default_backend() == "tpu":
+    if (
+        ok
+        and mode != "0"
+        and jax.default_backend() == "tpu"
+        and _kernel_compiles()
+    ):
         return pallas_scatter.segment_sum_flat(addends, key, num_segments)
     return jax.ops.segment_sum(addends, key, num_segments=num_segments)
 

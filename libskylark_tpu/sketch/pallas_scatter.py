@@ -42,7 +42,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-__all__ = ["segment_sum_flat", "supported"]
+__all__ = ["segment_sum_flat", "supported", "self_check"]
 
 # Entries per chunk (pass-1 grid step).  Larger C cuts pass-2 grid-step
 # count and chunk-revisit overhead at the cost of pass-1 VMEM (the
@@ -188,6 +188,25 @@ def _accumulate_kernel(
 # ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
+
+
+def self_check(
+    nnz: int = 40_000, num_segments: int = 1 << 17, interpret: bool = False
+) -> float:
+    """Max *relative* error of the kernel vs ``jax.ops.segment_sum`` on
+    random keys/values — the ONE validator shared by the library's
+    TPU-default probe (``hash._kernel_compiles``) and the hardware guard
+    (``tests/_hw_guards.py::guard_pallas_scatter_compiled``), so the two
+    cannot drift apart.  Raises on lowering failure; callers decide the
+    tolerance (1e-5 is the established hardware bar)."""
+    k1, k2 = jax.random.split(jax.random.PRNGKey(3))
+    keys = jax.random.randint(k1, (nnz,), 0, num_segments, dtype=jnp.int32)
+    vals = jax.random.normal(k2, (nnz,), jnp.float32)
+    out = segment_sum_flat(vals, keys, num_segments, interpret=interpret)
+    ref = jax.ops.segment_sum(vals, keys, num_segments=num_segments)
+    jax.block_until_ready((out, ref))
+    scale = jnp.maximum(jnp.max(jnp.abs(ref)), 1e-30)
+    return float(jnp.max(jnp.abs(out - ref)) / scale)
 
 
 def segment_sum_flat(vals, keys, num_segments: int, interpret: bool = False):

@@ -223,9 +223,12 @@ class TestKRR:
         VERDICT r3 item 6 — real device_put per panel) runs the same BCD
         math as large_scale_kernel_ridge: same context → same map →
         near-identical W on the logical vstack of the pool."""
+        import os
         import sys
 
-        sys.path.insert(0, "/root/repo")
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
         import experiments.northstar_krr as ns
 
         n_panels, br, d, s = 4, 64, 16, 32

@@ -52,3 +52,36 @@ def test_cli_sparse_path(tmp_path, rng):
     )
     assert rc == 0
     assert np.load(tmp_path / "o.S.npy").shape == (3,)
+
+
+def test_kernel_probe_runs_inside_jit_trace(monkeypatch):
+    """The one-time Pallas-scatter probe must execute eagerly even when
+    its first caller is mid-trace: under omnistaging the probe's ops
+    would otherwise be staged into the caller's trace and the float()
+    readback would raise ConcretizationTypeError — which the blanket
+    except would latch as a permanent (and wrong) kernel-broken verdict."""
+    import jax
+
+    from libskylark_tpu.sketch import hash as hash_mod
+    from libskylark_tpu.sketch import pallas_scatter
+
+    # Stand-in validator: same jnp-op + float() shape as the real
+    # self_check, minus the Pallas call (not lowerable on CPU compiled
+    # mode); what is under test is the trace-escape, not the kernel.
+    def fake_self_check():
+        x = jnp.arange(8.0)
+        return float(jnp.max(x) - jnp.max(x))
+
+    monkeypatch.setattr(pallas_scatter, "self_check", fake_self_check)
+    monkeypatch.setattr(hash_mod, "_KERNEL_COMPILES", None)
+
+    result = {}
+
+    @jax.jit
+    def traced(v):
+        result["ok"] = hash_mod._kernel_compiles()
+        return v * 2
+
+    traced(jnp.ones(4))
+    assert result["ok"] is True
+    assert hash_mod._KERNEL_COMPILES is True
