@@ -1,0 +1,82 @@
+"""Tunnel watcher: poll the axon TPU backend until it answers, then run
+the full hardware measurement battery (``hw_session.py``) exactly once.
+
+The shared tunnel comes and goes (round-4 lost its entire hardware
+artifact to a down window); this watcher turns "retry by hand until a
+quiet window opens" into a detached loop.  Each probe is a subprocess
+with its own timeout — a hung init costs one probe, not the watcher.
+
+Run: ``python experiments/tunnel_watch.py [max_hours]`` (default 11).
+Writes state to ``experiments/logs/tunnel_watch.log`` and the battery's
+own per-stage logs next to it.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOGDIR = os.path.join(REPO, "experiments", "logs")
+PROBE_TIMEOUT_S = 600  # live-tunnel init has been observed at 300-900 s
+SLEEP_S = 180
+
+PROBE = (
+    "import time, jax\n"
+    "t0 = time.time()\n"
+    "d = jax.devices()[0]\n"
+    "print('UP', d.platform, d.device_kind, 'init_s=%.1f' % (time.time()-t0),"
+    " flush=True)\n"
+)
+
+
+def main() -> int:
+    max_hours = float(sys.argv[1]) if len(sys.argv) > 1 else 11.0
+    os.makedirs(LOGDIR, exist_ok=True)
+    deadline = time.monotonic() + 3600.0 * max_hours
+    logpath = os.path.join(LOGDIR, "tunnel_watch.log")
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    attempt = 0
+    with open(logpath, "a") as log:
+        def say(msg: str) -> None:
+            stamp = time.strftime("%H:%M:%S")
+            log.write(f"[{stamp}] {msg}\n")
+            log.flush()
+            print(f"[{stamp}] {msg}", flush=True)
+
+        say(f"watcher start, budget {max_hours:.1f} h")
+        while time.monotonic() < deadline:
+            attempt += 1
+            try:
+                out = subprocess.run(
+                    [sys.executable, "-c", PROBE],
+                    capture_output=True, text=True,
+                    timeout=PROBE_TIMEOUT_S, env=env, cwd=REPO,
+                )
+                if out.returncode == 0 and "UP" in out.stdout:
+                    say(f"probe {attempt}: {out.stdout.strip().splitlines()[-1]}")
+                    say("tunnel is up -> running hw_session battery")
+                    rc = subprocess.run(
+                        [sys.executable, "experiments/hw_session.py"],
+                        stdout=log, stderr=subprocess.STDOUT,
+                        env=env, cwd=REPO,
+                    ).returncode
+                    say(f"hw_session done rc={rc}")
+                    return rc
+                tail = (out.stderr or out.stdout).strip().splitlines()
+                say(
+                    f"probe {attempt}: down (rc={out.returncode}) "
+                    + (tail[-1][:160] if tail else "")
+                )
+            except subprocess.TimeoutExpired:
+                say(f"probe {attempt}: hung > {PROBE_TIMEOUT_S}s (killed)")
+            time.sleep(SLEEP_S)
+        say("watcher budget exhausted, tunnel never answered")
+        return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
