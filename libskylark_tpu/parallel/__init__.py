@@ -12,11 +12,14 @@ invariant must be enforced by hand.
 """
 
 from .collectives import (
+    ShardedBCOO,
     columnwise_sharded,
     columnwise_sharded_sparse,
     columnwise_sharded_sparse_2d,
+    columnwise_sharded_sparse_out,
     rowwise_sharded,
     rowwise_sharded_sparse,
+    rowwise_sharded_sparse_out,
 )
 from .mesh import (
     ROWS,
@@ -53,4 +56,7 @@ __all__ = [
     "rowwise_sharded_sparse",
     "columnwise_sharded_sparse",
     "columnwise_sharded_sparse_2d",
+    "columnwise_sharded_sparse_out",
+    "rowwise_sharded_sparse_out",
+    "ShardedBCOO",
 ]

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..sketch.base import Dimension
@@ -37,6 +38,9 @@ __all__ = [
     "rowwise_sharded_sparse",
     "columnwise_sharded_sparse",
     "columnwise_sharded_sparse_2d",
+    "columnwise_sharded_sparse_out",
+    "rowwise_sharded_sparse_out",
+    "ShardedBCOO",
 ]
 
 
@@ -357,4 +361,237 @@ def _rowwise_sparse_program(S, block: int, mesh: Mesh):
         mesh=mesh,
         in_specs=(P(axes, None), P(axes, None), P(axes, None)),
         out_specs=P(axes, None),
+    )
+
+# ---------------------------------------------------------------------------
+# sparse -> SPARSE sharded output (SURVEY row 65: SpParMat -> SpParMat)
+# ---------------------------------------------------------------------------
+
+
+class ShardedBCOO:
+    """Row-block-sharded sparse sketch result with deferred duplicates.
+
+    The TPU re-expression of the reference's distributed-sparse output
+    (``sketch/hash_transform_CombBLAS.hpp:136-302``: SpParMat in,
+    SpParMat out).  Each mesh shard owns the contiguous row block
+    ``[k*row_block, (k+1)*row_block)`` of the logical ``shape`` and
+    holds its entries as flat (data, local-row, col) arrays — padding
+    entries carry ``data == 0`` at (0, 0), harmless under the
+    deferred-duplicate convention (they add zero).  Nothing here is ever
+    densified; ``to_bcoo``/``todense`` are explicit host-side exits.
+    """
+
+    def __init__(self, data, rows, cols, shape, row_block, mesh):
+        self.data, self.rows, self.cols = data, rows, cols
+        self.shape, self.row_block, self.mesh = shape, row_block, mesh
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def to_bcoo(self) -> jsparse.BCOO:
+        """Gather to one host BCOO, duplicates summed — the same
+        finalize step as the local BCOO apply (``hash.py
+        _apply_sparse``), for parity checks and hand-off.  Zero-data
+        padding entries (the capacity slack) are dropped host-side, so
+        the result's nse is entry-proportional, never buffer-sized."""
+        import numpy as np
+
+        p = self.data.shape[0]
+        d = np.asarray(self.data).reshape(p, -1)
+        r = np.asarray(self.rows).reshape(p, -1)
+        c = np.asarray(self.cols).reshape(p, -1)
+        grows = r + np.arange(p, dtype=r.dtype)[:, None] * self.row_block
+        keep = d.ravel() != 0
+        if not keep.any():
+            return jsparse.BCOO.fromdense(
+                jnp.zeros(self.shape, self.data.dtype), nse=1
+            )
+        dk, rk, ck = d.ravel()[keep], grows.ravel()[keep], c.ravel()[keep]
+        idx = jnp.stack([jnp.asarray(rk), jnp.asarray(ck)], axis=1)
+        out = jsparse.BCOO((jnp.asarray(dk), idx), shape=self.shape)
+        nse = min(out.nse, self.shape[0] * self.shape[1])
+        return out.sum_duplicates(nse=nse)
+
+    def todense(self):
+        return self.to_bcoo().todense()
+
+
+def columnwise_sharded_sparse_out(S, A, mesh: Mesh, capacity: int | None = None):
+    """BCOO A (N, m) -> BCOO S·A (S, m), output ROW-BLOCK-SHARDED and
+    never densified (closes SURVEY row 65's partial: the other P6
+    schedules merge into a dense (S, m) accumulator, the wrong
+    asymptotic when S is large and the output stays sparse).
+
+    Schedule: each shard hashes its row block with shard-local counter
+    windows (P5), relabels nonzeros to (bucket, col, v·val) — deferred
+    duplicates, exactly the local BCOO apply — then routes every entry
+    to the shard that owns its output row block through ONE tiled
+    ``all_to_all`` of fixed-capacity per-destination buffers (the TPU
+    answer to CombBLAS's SpParMat redistribution; ragged exchanges
+    don't exist under XLA's static shapes, so capacity is the padding).
+
+    ``capacity`` is the per-(source, destination) buffer length.  The
+    default — every entry of one source landing on one destination —
+    can never drop; a tighter value trades memory for silent dropping
+    of overflow entries, so only pass one derived from a real count.
+    Zero-value padding entries are routed to a sentinel destination and
+    never occupy capacity slots, so the relevant count is the max
+    per-(source, destination) number of REAL entries.
+    """
+    axes = tuple(mesh.axis_names)
+    p = mesh.size
+    n, m = A.shape
+    if n != S.n:
+        raise ValueError(f"columnwise apply needs A with {S.n} rows, got {A.shape}")
+    if n % p:
+        raise ValueError(f"rows {n} not divisible by mesh size {p}")
+    if S.s % p:
+        raise ValueError(
+            f"sparse-out needs S={S.s} divisible by mesh size {p} "
+            "(output is row-block-sharded)"
+        )
+    if n >= (1 << 32):
+        raise ValueError(f"sparse schedules support N < 2^32, got N={n}")
+    block, out_block = n // p, S.s // p
+    d, lr, cc = _shard_coo_rows(A, p, block)
+    entries = S.nnz * d.shape[1]
+    cap = entries if capacity is None else int(capacity)
+    dv, rv, cv = _columnwise_sparse_out_program(
+        S, block, out_block, cap, mesh
+    )(d, lr, cc)
+    return ShardedBCOO(dv, rv, cv, (S.s, m), out_block, mesh)
+
+
+def _columnwise_sparse_out_program(S, block: int, out_block: int, cap: int,
+                                   mesh: Mesh):
+    """Jittable device half of :func:`columnwise_sharded_sparse_out`;
+    factored out for the compiled-HLO schedule tests (the lock: one
+    all-to-all, NO psum, NO (S, m) dense accumulator)."""
+    axes = tuple(mesh.axis_names)
+    p = mesh.size
+
+    def local(d, lr, cc):
+        dtype = _coo_dtype(d)
+        d, lr, cc = d[0].astype(dtype), lr[0], cc[0]
+        idx = jax.lax.axis_index(axes)
+        off = jnp.uint32(idx) * jnp.uint32(block)
+        vals, rows = [], []
+        for h in range(S.nnz):
+            start = (h * S.n, off)
+            b = S.buckets(start=start, num=block)
+            v = S.values(dtype, start=start, num=block)
+            vals.append(d * v[lr])
+            rows.append(b[lr])
+        val = jnp.concatenate(vals)              # (E,)
+        row = jnp.concatenate(rows)              # global out rows [0, S)
+        col = jnp.tile(cc, S.nnz)
+        dest = row // jnp.int32(out_block)
+        # Zero-value entries (COO block padding — the hash values are
+        # nonzero a.s., so val == 0 iff the padded data slot was 0) are
+        # routed to the out-of-range sentinel destination p: they never
+        # occupy capacity slots, so a user capacity derived from REAL
+        # per-destination counts cannot drop real entries, and the
+        # out-of-bounds scatter row drops them before the exchange.
+        dest = jnp.where(val == 0, jnp.int32(p), dest)
+        # Sort by destination; position-in-segment via searchsorted.
+        order = jnp.argsort(dest)
+        sd = dest[order]
+        starts = jnp.searchsorted(sd, jnp.arange(p, dtype=sd.dtype))
+        pos = jnp.arange(sd.shape[0], dtype=jnp.int32) - starts[
+            jnp.minimum(sd, p - 1)
+        ].astype(jnp.int32)
+        if dtype == jnp.float32:
+            # Values ride the SAME packed int32 exchange (bitcast lane):
+            # the buffers are the payload, but launch latency is per-op.
+            buf = (
+                jnp.zeros((p, 3, cap), jnp.int32)
+                .at[sd, 0, pos].set(row[order], mode="drop")
+                .at[sd, 1, pos].set(col[order], mode="drop")
+                .at[sd, 2, pos].set(
+                    jax.lax.bitcast_convert_type(val[order], jnp.int32),
+                    mode="drop",
+                )
+            )
+            rbuf = jax.lax.all_to_all(buf, axes, 0, 0, tiled=True)
+            rr, rc = rbuf[:, 0], rbuf[:, 1]
+            rv = jax.lax.bitcast_convert_type(rbuf[:, 2], jnp.float32)
+        else:  # f64 (x64 parity runs): values need their own exchange
+            buf_v = jnp.zeros((p, cap), dtype).at[sd, pos].set(
+                val[order], mode="drop"
+            )
+            buf_i = (
+                jnp.zeros((p, 2, cap), jnp.int32)
+                .at[sd, 0, pos].set(row[order], mode="drop")
+                .at[sd, 1, pos].set(col[order], mode="drop")
+            )
+            rv = jax.lax.all_to_all(buf_v, axes, 0, 0, tiled=True)
+            ri = jax.lax.all_to_all(buf_i, axes, 0, 0, tiled=True)
+            rr, rc = ri[:, 0], ri[:, 1]
+        # Received rows are global; relabel to this shard's row block.
+        # Padding entries (value 0) clip to local row 0 — harmless.
+        lrows = jnp.clip(
+            rr - jnp.int32(idx) * jnp.int32(out_block), 0, out_block - 1
+        )
+        flat = (1, p * cap)
+        return (
+            rv.reshape(flat),
+            lrows.reshape(flat),
+            rc.reshape(flat),
+        )
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(axes, None), P(axes, None)),
+        out_specs=(P(axes, None), P(axes, None), P(axes, None)),
+    )
+
+
+def rowwise_sharded_sparse_out(S, A, mesh: Mesh):
+    """BCOO A (m, N), row-sharded -> BCOO A·Sᵀ (m, S), row-sharded,
+    never densified.  Communication-FREE (P2: the hashed axis is the
+    replicated feature axis): each shard relabels its own rows' column
+    indices with the full in-shard bucket table and keeps its entries
+    local — the output row owner is the input row owner."""
+    axes = tuple(mesh.axis_names)
+    p = mesh.size
+    m, n = A.shape
+    if n != S.n:
+        raise ValueError(f"rowwise apply needs A with {S.n} columns, got {A.shape}")
+    if m % p:
+        raise ValueError(f"rows {m} not divisible by mesh size {p}")
+    block = m // p
+    d, lr, cc = _shard_coo_rows(A, p, block)
+    dv, rv, cv = _rowwise_sparse_out_program(S, mesh)(d, lr, cc)
+    return ShardedBCOO(dv, rv, cv, (m, S.s), block, mesh)
+
+
+def _rowwise_sparse_out_program(S, mesh: Mesh):
+    """Jittable device half of :func:`rowwise_sharded_sparse_out`;
+    factored out for the compiled-HLO tests (the lock: ZERO collectives)."""
+    axes = tuple(mesh.axis_names)
+
+    def local(d, lr, cc):
+        dtype = _coo_dtype(d)
+        d, lr, cc = d[0].astype(dtype), lr[0], cc[0]
+        vals, cols = [], []
+        for h in range(S.nnz):
+            start = h * S.n
+            b = S.buckets(start=start, num=S.n)
+            v = S.values(dtype, start=start, num=S.n)
+            vals.append(d * v[cc])
+            cols.append(b[cc])
+        flat = (1, S.nnz * d.shape[0])
+        return (
+            jnp.concatenate(vals).reshape(flat),
+            jnp.tile(lr, S.nnz).reshape(flat),
+            jnp.concatenate(cols).reshape(flat),
+        )
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(axes, None), P(axes, None)),
+        out_specs=(P(axes, None), P(axes, None), P(axes, None)),
     )

@@ -138,6 +138,132 @@ class TestSparseShardedSchedules:
             columnwise_sharded_sparse(S2, A, mesh)  # 60 % 8 != 0
 
 
+class TestSparseOutSchedules:
+    """SURVEY row 65 (SpParMat → SpParMat, ``hash_transform_CombBLAS.hpp:
+    136-302``): sharded sparse sketches whose OUTPUT stays sparse and
+    sharded — columnwise routes relabeled entries to their output-row
+    owner through one fixed-capacity all_to_all exchange; rowwise is
+    communication-free.  Parity target: the local BCOO→BCOO apply."""
+
+    @pytest.mark.parametrize(
+        "sketch_cls,kw", [(CWT, {}), (SJLT, {"nnz": 3}), (WZT, {})]
+    )
+    def test_columnwise_matches_local(self, rng, sketch_cls, kw):
+        from libskylark_tpu.parallel import columnwise_sharded_sparse_out
+
+        n, s, m = 64, 40, 12
+        mesh = default_mesh()
+        S = sketch_cls(n, s, SketchContext(seed=41), **kw)
+        A, _ = _random_bcoo(rng, (n, m), density=0.3)
+        out = columnwise_sharded_sparse_out(S, A, mesh)
+        ref = S.apply(A, "columnwise")
+        np.testing.assert_allclose(
+            np.asarray(out.todense()), np.asarray(ref.todense()),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_columnwise_to_bcoo_stays_sparse(self, rng):
+        from libskylark_tpu.parallel import columnwise_sharded_sparse_out
+
+        n, s, m = 64, 4096, 8  # output (4096, 8): dense merge would be 32k
+        mesh = default_mesh()
+        S = CWT(n, s, SketchContext(seed=42))
+        A, _ = _random_bcoo(rng, (n, m), density=0.2)
+        out = columnwise_sharded_sparse_out(S, A, mesh)
+        # Per-shard storage is entry-proportional, never (S, m):
+        p = mesh.size
+        assert out.data.shape[1] <= p * S.nnz * max(1, A.nse)
+        bc = out.to_bcoo()
+        assert bc.shape == (s, m)
+        # ≤ one output entry per input nonzero (dedup can only shrink)
+        assert bc.nse <= S.nnz * A.nse + 1
+
+    def test_rowwise_matches_local(self, rng):
+        from libskylark_tpu.parallel import rowwise_sharded_sparse_out
+
+        n, s, m = 96, 24, 64
+        mesh = default_mesh()
+        for S in (
+            CWT(n, s, SketchContext(seed=43)),
+            SJLT(n, s, SketchContext(seed=44), nnz=2),
+        ):
+            A, _ = _random_bcoo(rng, (m, n), density=0.25)
+            out = rowwise_sharded_sparse_out(S, A, mesh)
+            ref = S.apply(A, "rowwise")
+            np.testing.assert_allclose(
+                np.asarray(out.todense()), np.asarray(ref.todense()),
+                rtol=1e-5, atol=1e-5,
+            )
+
+    def test_columnwise_shape_validation(self, rng):
+        from libskylark_tpu.parallel import columnwise_sharded_sparse_out
+
+        mesh = default_mesh()
+        A, _ = _random_bcoo(rng, (64, 8))
+        S = CWT(64, 12, SketchContext(seed=45))  # 12 % 8 != 0
+        with pytest.raises(ValueError, match="divisible"):
+            columnwise_sharded_sparse_out(S, A, mesh)
+
+    def test_safe_capacity_never_drops_on_hot_bucket(self, rng):
+        """Adversarial: a sketch where EVERY input row hashes to a
+        bucket owned by ONE shard must survive the default capacity
+        (all entries of one source to one destination).  The
+        concentration is constructed, not seed-hunted — a uniform hash
+        never concentrates 32 rows on one of 8 owners by chance."""
+        from libskylark_tpu.parallel import columnwise_sharded_sparse_out
+
+        class HotCWT(CWT):
+            """Every coordinate hashes to bucket 1 (owner shard 0)."""
+
+            def buckets(self, start=0, num=None):
+                base = super().buckets(start=start, num=num)
+                return jnp.ones_like(base)
+
+        n, s, m = 32, 16, 4
+        mesh = default_mesh()
+        S = HotCWT(n, s, SketchContext(seed=46))
+        A, _ = _random_bcoo(rng, (n, m), density=0.9)
+        out = columnwise_sharded_sparse_out(S, A, mesh)
+        ref = S.apply(A, "columnwise")  # local path uses the same override
+        np.testing.assert_allclose(
+            np.asarray(out.todense()), np.asarray(ref.todense()),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_tight_capacity_ignores_padding(self, rng):
+        """Padding entries ride the sentinel destination, so a capacity
+        equal to the true max per-(src, dst) REAL entry count loses
+        nothing even when shards are skewed (some heavily padded)."""
+        from libskylark_tpu.parallel import columnwise_sharded_sparse_out
+        from libskylark_tpu.parallel.collectives import _shard_coo_rows
+
+        n, s, m = 64, 16, 6
+        mesh = default_mesh()
+        p = mesh.size
+        # Skewed rows: all nonzeros in the first row block.
+        M = np.zeros((n, m))
+        M[: n // p] = rng.standard_normal((n // p, m))
+        from jax.experimental import sparse as jsparse
+
+        A = jsparse.BCOO.fromdense(jnp.asarray(M, jnp.float32))
+        S = CWT(n, s, SketchContext(seed=47))
+        # True per-(src,dst) real-entry count, computed host-side.
+        d, lr, cc = (np.asarray(x) for x in _shard_coo_rows(A, p, n // p))
+        need = 0
+        for src in range(p):
+            real = d[src] != 0
+            gl = lr[src][real] + src * (n // p)
+            dests = np.asarray(S.buckets())[gl] // (s // p)
+            if dests.size:
+                need = max(need, int(np.bincount(dests, minlength=p).max()))
+        out = columnwise_sharded_sparse_out(S, A, mesh, capacity=need)
+        ref = S.apply(A, "columnwise")
+        np.testing.assert_allclose(
+            np.asarray(out.todense()), np.asarray(ref.todense()),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
 class TestSparse2DGrid:
     """P6 2-D option (≙ hash_transform_CombBLAS's √p×√p grid): nonzeros
     owned by (row-block, col-block); per-shard local (S, m/pc)
@@ -317,6 +443,50 @@ class TestCompiledCommunicationSchedules:
             d, lr, cc,
         )
         assert counts == {"reduce-scatter": 1}, counts
+
+    @pytest.mark.parametrize("dtype,want", [(jnp.float32, 1), (jnp.float64, 2)])
+    def test_sparse_out_columnwise_all_to_all_only(self, rng, dtype, want):
+        """The sparse→sparse columnwise schedule is an entry EXCHANGE:
+        f32 rides ONE packed all-to-all (values bitcast into the index
+        buffer), f64 two (values + packed indices); no reduction
+        collective, and — the row-65 point — no (S, m) dense
+        accumulator anywhere in the program."""
+        from jax.experimental import sparse as jsparse
+
+        from libskylark_tpu.parallel.collectives import (
+            _columnwise_sparse_out_program,
+        )
+
+        n, s, m = 64, 40, 12
+        mesh = default_mesh()
+        S = SJLT(n, s, SketchContext(seed=38), nnz=3)
+        M = rng.standard_normal((n, m)) * (rng.random((n, m)) < 0.3)
+        A = jsparse.BCOO.fromdense(jnp.asarray(M, dtype))
+        block = n // mesh.size
+        d, lr, cc = self._split_coo(A, mesh, block)
+        cap = S.nnz * d.shape[1]
+        counts = _collective_counts(
+            _columnwise_sparse_out_program(
+                S, block, s // mesh.size, cap, mesh
+            ),
+            d, lr, cc,
+        )
+        assert counts == {"all-to-all": want}, counts
+
+    def test_sparse_out_rowwise_zero_collectives(self, rng):
+        from libskylark_tpu.parallel.collectives import (
+            _rowwise_sparse_out_program,
+        )
+
+        n, s, m = 96, 24, 64
+        mesh = default_mesh()
+        S = CWT(n, s, SketchContext(seed=39))
+        A, _ = _random_bcoo(rng, (m, n), density=0.25)
+        d, lr, cc = self._split_coo(A, mesh, m // mesh.size)
+        counts = _collective_counts(
+            _rowwise_sparse_out_program(S, mesh), d, lr, cc
+        )
+        assert not counts, f"sparse-out rowwise must be comm-free, got {counts}"
 
     def test_traced_start_requires_num(self):
         S = CWT(64, 8, SketchContext(seed=11))

@@ -39,6 +39,14 @@ _SKIP_MARKERS = (
     "DEADLINE_EXCEEDED",
     "failed to connect",
 )
+# The subset that cannot heal between parametrized world sizes (missing
+# capability, not a flaky coordinator): only these cache an env skip.
+_DETERMINISTIC_MARKERS = (
+    "UNIMPLEMENTED",
+    "not supported",
+    "NotImplementedError",
+    "Unable to initialize backend",
+)
 
 # Every rank must print these unconditionally...
 _REQUIRED = (
@@ -94,19 +102,24 @@ def test_multi_process_world(nprocs):
     except subprocess.TimeoutExpired:
         for p in procs:
             p.kill()
-        _ENV_SKIP = (
+        # Timeouts can be transient (loaded box) — skip this size only,
+        # don't poison the remaining world sizes.
+        pytest.skip(
             f"{nprocs}-process world did not complete within "
             f"{_TIMEOUT_S}s (distributed CPU runtime unavailable here)"
         )
-        pytest.skip(_ENV_SKIP)
 
     for rc, out, err in outs:
         if rc != 0 and any(m in err for m in _SKIP_MARKERS):
-            _ENV_SKIP = (
+            reason = (
                 "jax.distributed unsupported in this environment: "
                 + err.strip().splitlines()[-1][:300]
             )
-            pytest.skip(_ENV_SKIP)
+            # Only deterministic capability markers poison the cache;
+            # flaky connect/deadline failures retry at the next size.
+            if any(m in err for m in _DETERMINISTIC_MARKERS):
+                _ENV_SKIP = reason
+            pytest.skip(reason)
     for i, (rc, out, err) in enumerate(outs):
         assert rc == 0, (
             f"rank {i} failed (rc={rc})\nstdout:\n{out}\nstderr:\n{err[-3000:]}"
