@@ -1,0 +1,104 @@
+"""On-chip probe for the fused sampled-FJLT kernel (VERDICT r4 item 5).
+
+Measures, at the acknowledged f32 large-S floor shape
+(128K x 4096 -> 1024, 44.8 ms measured r2 on the two-step path):
+  1. the two-step path (Pallas WHT -> full (m, NB) in HBM -> XLA
+     sampled gather) — the current floor;
+  2. the fused kernel (selection + rescale in the epilogue, only
+     (m, S) ever written) — target < 40 ms;
+  3. the SRHT 3-pass bf16-split matmul for reference (the gate's
+     other contender; measured r2 as losing at this shape);
+  4. parity of 1-vs-2 on the live chip (the lane-gather lowering is
+     the open question — a Mosaic refusal shows up here as the probe
+     warning + identical timings).
+
+Run on the bench chip: ``python experiments/fjlt_fused_probe.py
+[m] [n] [s]``.  Results decide whether the `_sampled_kernel_compiles`
+gate ships enabled and recalibrate ``_GEMM_FPB`` if needed.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+import jax
+
+# The axon sitecustomize force-sets jax_platforms; restore env semantics
+# so a CPU smoke run (JAX_PLATFORMS=cpu) cannot hang on a down tunnel.
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from libskylark_tpu import SketchContext
+from libskylark_tpu.sketch import FJLT
+from libskylark_tpu.sketch import fjlt as fjlt_mod
+from libskylark_tpu.sketch import pallas_fut
+
+
+def timed(tag, fn, *args, reps=5):
+    out = jax.block_until_ready(fn(*args))  # compile
+    best = min(
+        (lambda t0: (jax.block_until_ready(fn(*args)),
+                     time.perf_counter() - t0))(time.perf_counter())[1]
+        for _ in range(reps)
+    )
+    print(f"{tag:<44} {best * 1e3:9.2f} ms", flush=True)
+    return out, best
+
+
+def main():
+    m = int(sys.argv[1]) if len(sys.argv) > 1 else 131_072
+    n = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+    s = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+    dev = jax.devices()[0]
+    print(f"device={dev} shape {m}x{n}->{s} f32", flush=True)
+
+    S1 = FJLT(n, s, SketchContext(seed=9))
+    rng = np.random.default_rng(0)
+    A = jnp.asarray(rng.standard_normal((m, n)).astype(np.float32))
+    jax.block_until_ready(A)
+    D = S1._rfut.diagonal(jnp.float32)
+    with jax.ensure_compile_time_eval():
+        idx = np.asarray(S1._ust.samples, np.int32)
+
+    print(
+        "supported_sampled:",
+        pallas_fut.supported_sampled(m, n, S1._nb, s),
+        " probe:", fjlt_mod._sampled_kernel_compiles(jnp.float32, S1._nb, s),
+        flush=True,
+    )
+
+    def two_step(x):
+        T = pallas_fut.rfut_rowwise(x, D, S1._nb)
+        return jnp.float32(np.sqrt(S1._nb / s)) * T[:, jnp.asarray(idx)]
+
+    out_two, t_two = timed("two-step (WHT kernel + XLA gather)",
+                           jax.jit(two_step), A)
+
+    if fjlt_mod._sampled_kernel_compiles(jnp.float32, S1._nb, s):
+        fused = jax.jit(
+            lambda x: pallas_fut.rfut_rowwise_sampled(x, D, S1._nb, idx)
+        )
+        out_f, t_f = timed("fused sampled kernel", fused, A)
+        err = float(jnp.max(jnp.abs(out_f - out_two)))
+        print(f"parity |fused - two-step| max = {err:g}", flush=True)
+        print(f"speedup: {t_two / t_f:.2f}x", flush=True)
+    else:
+        print("fused kernel unavailable (see probe warning above)",
+              flush=True)
+
+    if S1.n * s <= fjlt_mod._GEMM_MAX_ELEMENTS:
+        gemm = jax.jit(lambda x: S1._apply_srht_gemm(x, rowwise=True))
+        timed("SRHT 3-pass bf16-split matmul", gemm, A)
+
+
+if __name__ == "__main__":
+    main()

@@ -28,6 +28,11 @@ STAGES = [
         900,
         {"SKYLARK_SCATTER_CHUNK": "8192"},
     ),
+    (
+        "fjlt_fused_probe",
+        [sys.executable, "experiments/fjlt_fused_probe.py"],
+        900,
+    ),
     ("bench_full", [sys.executable, "bench.py"], 1800),
     (
         "northstar_host",

@@ -36,6 +36,68 @@ def _use_pallas() -> bool:
     )
 
 
+_SAMPLED_KERNEL_OK: dict = {}
+
+
+def _sampled_kernel_compiles(
+    dtype=jnp.float32, nb: int = 512, s: int = 128
+) -> bool:
+    """Compiled self-test of the fused sampled-FJLT kernel at the REAL
+    call's (dtype, NB, S) — Mosaic lowering of the lane gather can vary
+    with vector layout, so a tiny-shape pass must not green-light a
+    production shape.  Only the row count is shrunk (the grid iterates
+    rows; their count cannot change lowering).  Verdict cached per
+    configuration — same pattern and rationale as
+    ``hash._kernel_compiles``."""
+    key = (jnp.dtype(dtype).name, nb, s)
+    if key not in _SAMPLED_KERNEL_OK:
+        import warnings
+
+        from . import pallas_fut
+
+        try:
+            with jax.ensure_compile_time_eval():
+                rng = np.random.default_rng(0)
+                m = 8
+                x = jnp.asarray(
+                    rng.standard_normal((m, nb)).astype(np.float32)
+                ).astype(dtype)
+                d = jnp.asarray(
+                    rng.choice([-1.0, 1.0], nb).astype(np.float32)
+                ).astype(dtype)
+                idx = rng.integers(0, nb, s).astype(np.int32)
+                out = pallas_fut.rfut_rowwise_sampled(x, d, nb, idx)
+                ref = pallas_fut.rfut_rowwise(x, d, nb)[:, idx] * jnp.asarray(
+                    np.sqrt(nb / s), dtype
+                )
+                jax.block_until_ready((out, ref))
+                err = float(
+                    jnp.max(jnp.abs(out.astype(jnp.float32) - ref))
+                )
+                scale = float(jnp.max(jnp.abs(ref))) or 1.0
+            ok = err < 1e-2 * scale if dtype == jnp.bfloat16 else (
+                err < 1e-4 * scale
+            )
+            _SAMPLED_KERNEL_OK[key] = ok
+            if not ok:
+                warnings.warn(
+                    "fused sampled-FJLT kernel compiled but miscomputed "
+                    f"at {key} (err {err:g}); using the two-step WHT + "
+                    "gather path",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        except Exception as e:  # noqa: BLE001 — lowering failure → 2-step
+            warnings.warn(
+                "fused sampled-FJLT kernel probe failed at "
+                f"{key}; using the two-step WHT + gather path: {e!r:.300}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            _SAMPLED_KERNEL_OK[key] = False
+    return _SAMPLED_KERNEL_OK[key]
+
+
 # Effective MXU flops-per-HBM-byte at which the explicit subsampled-
 # Hadamard matmul overtakes the streamed WHT + lane gather, per matmul
 # dtype (measured on v5e: the gather runs far below streaming bandwidth,
@@ -233,12 +295,34 @@ class FJLT(SketchTransform):
 
     def _apply_pallas(self, A, interpret: bool = False):
         """Fused one-pass D·x → WHT kernel (natural order, matching the
-        XLA path), then the usual sampled gather."""
+        XLA path).  When the sampled-epilogue variant is supported (and
+        its compiled probe passes on this backend), the S-sample
+        selection + rescale happen IN the kernel and only (m, S) ever
+        reaches HBM — the f32 large-S fix (VERDICT r4 item 5); otherwise
+        the full (m, NB) transform is written and the usual XLA sampled
+        gather follows."""
         from . import pallas_fut
 
         if not jnp.issubdtype(A.dtype, jnp.floating):
             A = A.astype(jnp.float32)
         D = self._rfut.diagonal(A.dtype)
+        mode = os.environ.get("SKYLARK_PALLAS_FJLT_SAMPLED", "")
+        if (
+            mode != "0"
+            and pallas_fut.supported_sampled(
+                A.shape[0], self.n, self._nb, self.s
+            )
+            and (
+                interpret
+                or mode == "1"
+                or _sampled_kernel_compiles(A.dtype, self._nb, self.s)
+            )
+        ):
+            with jax.ensure_compile_time_eval():
+                idx = np.asarray(self._ust.samples, np.int32)
+            return pallas_fut.rfut_rowwise_sampled(
+                A, D, self._nb, idx, interpret=interpret
+            )
         T = pallas_fut.rfut_rowwise(A, D, self._nb, interpret=interpret)
         scale = jnp.asarray(np.sqrt(self._nb / self.s), T.dtype)
         return scale * self._ust.apply(T, Dimension.ROWWISE)
