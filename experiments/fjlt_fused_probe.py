@@ -72,7 +72,8 @@ def main():
     print(
         "supported_sampled:",
         pallas_fut.supported_sampled(m, n, S1._nb, s),
-        " probe:", fjlt_mod._sampled_kernel_compiles(jnp.float32, S1._nb, s),
+        " probe:", fjlt_mod._sampled_kernel_compiles(jnp.float32, S1._nb, s,
+        pallas_fut._tile_rows(m, S1._nb)),
         flush=True,
     )
 
@@ -83,7 +84,8 @@ def main():
     out_two, t_two = timed("two-step (WHT kernel + XLA gather)",
                            jax.jit(two_step), A)
 
-    if fjlt_mod._sampled_kernel_compiles(jnp.float32, S1._nb, s):
+    if fjlt_mod._sampled_kernel_compiles(jnp.float32, S1._nb, s,
+        pallas_fut._tile_rows(m, S1._nb)):
         fused = jax.jit(
             lambda x: pallas_fut.rfut_rowwise_sampled(x, D, S1._nb, idx)
         )

@@ -40,17 +40,20 @@ _SAMPLED_KERNEL_OK: dict = {}
 
 
 def _sampled_kernel_compiles(
-    dtype=jnp.float32, nb: int = 512, s: int = 128
+    dtype=jnp.float32, nb: int = 512, s: int = 128, tm: int = 8
 ) -> bool:
     """Compiled self-test of the fused sampled-FJLT kernel at the REAL
-    call's (dtype, NB, S) — Mosaic lowering of the lane gather can vary
-    with vector layout, so a tiny-shape pass must not green-light a
-    production shape.  Only the row count is shrunk (the grid iterates
-    rows; their count cannot change lowering).  Verdict cached per
-    configuration — same pattern and rationale as
+    call's (dtype, NB, S, tile) — Mosaic lowering of the lane gather can
+    vary with vector layout, and the layout depends on the block's
+    sublane count too, so the probe runs at m = the production tile
+    (``_tile_rows(tm, nb) == tm`` for any tile the caller selected).
+    Verdict cached per configuration; transient device errors get two
+    bounded retries — same pattern and rationale as
     ``hash._kernel_compiles``."""
-    key = (jnp.dtype(dtype).name, nb, s)
-    if key not in _SAMPLED_KERNEL_OK:
+    key = (jnp.dtype(dtype).name, nb, s, tm)
+    for attempt in range(3):
+        if key in _SAMPLED_KERNEL_OK:
+            break
         import warnings
 
         from . import pallas_fut
@@ -58,9 +61,8 @@ def _sampled_kernel_compiles(
         try:
             with jax.ensure_compile_time_eval():
                 rng = np.random.default_rng(0)
-                m = 8
                 x = jnp.asarray(
-                    rng.standard_normal((m, nb)).astype(np.float32)
+                    rng.standard_normal((tm, nb)).astype(np.float32)
                 ).astype(dtype)
                 d = jnp.asarray(
                     rng.choice([-1.0, 1.0], nb).astype(np.float32)
@@ -88,9 +90,18 @@ def _sampled_kernel_compiles(
                     stacklevel=2,
                 )
         except Exception as e:  # noqa: BLE001 — lowering failure → 2-step
+            msg = repr(e)
+            if attempt < 2 and any(
+                tok in msg
+                for tok in ("UNAVAILABLE", "DEADLINE", "RESOURCE_EXHAUSTED")
+            ):
+                import time
+
+                time.sleep(3.0)
+                continue
             warnings.warn(
                 "fused sampled-FJLT kernel probe failed at "
-                f"{key}; using the two-step WHT + gather path: {e!r:.300}",
+                f"{key}; using the two-step WHT + gather path: {msg[:300]}",
                 RuntimeWarning,
                 stacklevel=2,
             )
@@ -315,7 +326,12 @@ class FJLT(SketchTransform):
             and (
                 interpret
                 or mode == "1"
-                or _sampled_kernel_compiles(A.dtype, self._nb, self.s)
+                or _sampled_kernel_compiles(
+                    A.dtype,
+                    self._nb,
+                    self.s,
+                    pallas_fut._tile_rows(A.shape[0], self._nb),
+                )
             )
         ):
             with jax.ensure_compile_time_eval():

@@ -21,6 +21,7 @@ def blob_files(tmp_path, rng):
 
 
 class TestKrrCLI:
+    @pytest.mark.slow
     @pytest.mark.parametrize("alg", [0, 1, 2])
     def test_classification(self, blob_files, alg, capsys):
         from libskylark_tpu.cli.krr import main
@@ -36,6 +37,7 @@ class TestKrrCLI:
         acc = float(out.split("Test accuracy:")[1].split("%")[0])
         assert acc > 85.0
 
+    @pytest.mark.slow
     def test_regression(self, tmp_path, rng, capsys):
         from libskylark_tpu.cli.krr import main
 
@@ -56,6 +58,7 @@ class TestKrrCLI:
 
 
 class TestMlCLI:
+    @pytest.mark.slow
     def test_train_and_predict(self, blob_files, capsys):
         from libskylark_tpu.cli.ml import main
 
@@ -71,6 +74,7 @@ class TestMlCLI:
         acc = float(out.split("Test accuracy:")[1].split("%")[0])
         assert acc > 85.0
 
+    @pytest.mark.slow
     def test_predict_from_saved_model(self, blob_files, capsys):
         from libskylark_tpu.cli.ml import main
 
@@ -103,6 +107,7 @@ class TestGraftEntry:
         assert out.shape == (256, 10)
         assert np.all(np.isfinite(np.asarray(out)))
 
+    @pytest.mark.slow
     def test_dryrun_multichip_8(self):
         import sys
 

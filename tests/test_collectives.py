@@ -20,6 +20,7 @@ from libskylark_tpu.sketch import CWT, JLT, SJLT, WZT
 from libskylark_tpu.sketch import dense as dense_mod
 
 
+@pytest.mark.slow
 class TestShardMapSchedules:
     def test_rowwise_communication_free_matches_local(self, rng):
         n, s, m = 64, 16, 128
@@ -69,6 +70,7 @@ def _random_bcoo(rng, shape, density=0.1):
     return jsparse.BCOO.fromdense(jnp.asarray(M)), M
 
 
+@pytest.mark.slow
 class TestSparseShardedSchedules:
     """P6: sharded sparse hash sketches must equal the single-device BCOO
     apply (same counter windows → same buckets/values, only the schedule
@@ -138,6 +140,7 @@ class TestSparseShardedSchedules:
             columnwise_sharded_sparse(S2, A, mesh)  # 60 % 8 != 0
 
 
+@pytest.mark.slow
 class TestSparseOutSchedules:
     """SURVEY row 65 (SpParMat → SpParMat, ``hash_transform_CombBLAS.hpp:
     136-302``): sharded sparse sketches whose OUTPUT stays sparse and
@@ -264,6 +267,7 @@ class TestSparseOutSchedules:
         )
 
 
+@pytest.mark.slow
 class TestSparse2DGrid:
     """P6 2-D option (≙ hash_transform_CombBLAS's √p×√p grid): nonzeros
     owned by (row-block, col-block); per-shard local (S, m/pc)
@@ -399,6 +403,7 @@ class TestCompiledCommunicationSchedules:
 
         return _shard_coo_rows(A, mesh.size, block)
 
+    @pytest.mark.slow
     def test_sparse_rowwise_zero_collectives(self, rng):
         from libskylark_tpu.parallel.collectives import _rowwise_sparse_program
 
@@ -428,6 +433,7 @@ class TestCompiledCommunicationSchedules:
         )
         assert counts == {"all-reduce": 1}, counts
 
+    @pytest.mark.slow
     def test_sparse_columnwise_scatter_one_reduce_scatter(self, rng):
         from libskylark_tpu.parallel.collectives import (
             _columnwise_sparse_program,
@@ -444,6 +450,7 @@ class TestCompiledCommunicationSchedules:
         )
         assert counts == {"reduce-scatter": 1}, counts
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("dtype,want", [(jnp.float32, 1), (jnp.float64, 2)])
     def test_sparse_out_columnwise_all_to_all_only(self, rng, dtype, want):
         """The sparse→sparse columnwise schedule is an entry EXCHANGE:
@@ -473,6 +480,7 @@ class TestCompiledCommunicationSchedules:
         )
         assert counts == {"all-to-all": want}, counts
 
+    @pytest.mark.slow
     def test_sparse_out_rowwise_zero_collectives(self, rng):
         from libskylark_tpu.parallel.collectives import (
             _rowwise_sparse_out_program,
@@ -495,6 +503,7 @@ class TestCompiledCommunicationSchedules:
 
 
 class TestPanelBlockedApply:
+    @pytest.mark.slow
     def test_blocked_matches_unblocked(self, rng, monkeypatch):
         n, s, m = 250, 32, 10  # 250 % panel != 0 -> exercises the remainder
         A = jnp.asarray(rng.standard_normal((n, m)))
@@ -511,6 +520,7 @@ class TestPanelBlockedApply:
             np.asarray(out_r), np.asarray(ref_r), rtol=1e-9, atol=1e-11
         )
 
+    @pytest.mark.slow
     def test_sparse_over_threshold_raises(self, rng, monkeypatch):
         from jax.experimental import sparse as jsparse
 
@@ -545,6 +555,7 @@ class TestPanelBlockedApply:
 
 
 class TestLinearCLI:
+    @pytest.mark.slow
     def test_solves(self, tmp_path, rng, capsys):
         from libskylark_tpu.cli.linear import main
         from libskylark_tpu.io import write_libsvm

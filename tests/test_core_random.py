@@ -23,6 +23,7 @@ from libskylark_tpu.core import (
 )
 
 
+@pytest.mark.slow
 def test_window_matches_full():
     """Any window of the logical array equals the slice of the full array."""
     full = sample_window("normal", seed=7, base=100, full_shape=(32, 17))
@@ -34,6 +35,7 @@ def test_window_matches_full():
         np.testing.assert_array_equal(np.asarray(win), np.asarray(full[r0:r0 + r, c0:c0 + c]))
 
 
+@pytest.mark.slow
 def test_stream_vs_window():
     """A 1-D stream reshaped row-major equals the 2-D window of same base."""
     stream = sample("uniform", seed=3, base=50, num=6 * 9)
@@ -41,6 +43,7 @@ def test_stream_vs_window():
     np.testing.assert_array_equal(np.asarray(stream).reshape(6, 9), np.asarray(win))
 
 
+@pytest.mark.slow
 def test_uniform_cross_dtype_agreement():
     """f32 and f64 uniforms from the same counters agree to ~2^-24: an
     f32 (TPU) run and an f64/native-C run must see the SAME stream (a
@@ -54,6 +57,7 @@ def test_uniform_cross_dtype_agreement():
     assert np.abs(e32 - e64).max() / np.abs(e64).max() < 1e-4
 
 
+@pytest.mark.slow
 def test_traced_offset_stream_matches_static():
     """sample(base, offset=traced k) == sample(base+k) — including a
     window whose counters cross the 2^32 carry boundary."""
@@ -80,6 +84,7 @@ def test_seed_changes_values():
     assert not np.allclose(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_sharded_generation_bit_identical():
     """Generating under jit with a sharded output == single-device values.
 
@@ -168,6 +173,7 @@ def test_radical_inverse_base2():
     np.testing.assert_allclose(vals, [0.5, 0.25, 0.75])
 
 
+@pytest.mark.slow
 def test_halton_window_matches_coordinate():
     seq = LeapedHaltonSequence(d=4)
     win = np.asarray(seq.window(3, 5, dtype=jnp.float64))

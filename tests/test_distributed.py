@@ -28,6 +28,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # multi-process worlds: the slow tier
+
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _TIMEOUT_S = 300
 

@@ -97,6 +97,7 @@ class TestRFUT:
             rtol=1e-10,
         )
 
+    @pytest.mark.slow
     def test_dct_exact_size(self, rng):
         x = jnp.asarray(rng.standard_normal((60, 3)))
         T = RFUT(60, SketchContext(seed=6), fut="dct")
@@ -104,6 +105,7 @@ class TestRFUT:
 
 
 class TestFJLT:
+    @pytest.mark.slow
     @pytest.mark.parametrize("fut", ["wht", "dct"])
     def test_norm_preservation_statistical(self, rng, fut):
         n, s, m = 200, 64, 5
@@ -117,6 +119,7 @@ class TestFJLT:
         # average relative norm distortion ~ 1/sqrt(s); allow 3x slack
         assert np.mean(errs) < 3.0 / np.sqrt(s)
 
+    @pytest.mark.slow
     def test_rowwise_consistent(self, rng):
         n, s = 100, 32
         X = jnp.asarray(rng.standard_normal((4, n)))
@@ -126,6 +129,7 @@ class TestFJLT:
         R2 = S2.apply(X.T, "columnwise").T
         np.testing.assert_allclose(np.asarray(R1), np.asarray(R2), rtol=1e-10)
 
+    @pytest.mark.slow
     def test_json_roundtrip(self, rng):
         S = FJLT(50, 16, SketchContext(seed=9))
         S2 = from_json(S.to_json())
@@ -144,6 +148,7 @@ class TestFJLTSrhtGemm:
     @pytest.mark.parametrize(
         "dim,shape", [("rowwise", (8, 300)), ("columnwise", (300, 8))]
     )
+    @pytest.mark.slow
     def test_matches_wht_gather(self, rng, monkeypatch, dim, shape):
         n, s = 300, 32
         A = jnp.asarray(rng.standard_normal(shape))
@@ -228,6 +233,7 @@ def _laplacian_K(X, sigma):
 
 
 class TestRFT:
+    @pytest.mark.slow
     def test_gaussian_kernel_approx(self, rng):
         d, m, s, sigma = 10, 20, 4096, 2.0
         X = rng.standard_normal((m, d))
@@ -236,6 +242,7 @@ class TestRFT:
         Z = F.apply(jnp.asarray(X.T), "columnwise")  # (s, m)
         assert _kernel_mse(Z, K) < 0.05
 
+    @pytest.mark.slow
     def test_laplacian_kernel_approx(self, rng):
         d, m, s, sigma = 8, 20, 8192, 3.0
         X = rng.standard_normal((m, d))
@@ -244,6 +251,7 @@ class TestRFT:
         Z = F.apply(jnp.asarray(X.T), "columnwise")
         assert _kernel_mse(Z, K) < 0.08
 
+    @pytest.mark.slow
     def test_matern_features_finite_and_shaped(self, rng):
         F = MaternRFT(6, 512, SketchContext(seed=3), nu=1.5, l=2.0)
         Z = F.apply(jnp.asarray(rng.standard_normal((6, 9))), "columnwise")
@@ -252,6 +260,7 @@ class TestRFT:
         with pytest.raises(ValueError, match="2\\*nu"):
             MaternRFT(6, 64, SketchContext(seed=4), nu=0.7)
 
+    @pytest.mark.slow
     def test_rowwise_matches_columnwise(self, rng):
         d, s = 7, 128
         X = rng.standard_normal((5, d))
@@ -274,6 +283,7 @@ class TestRFT:
 
 
 class TestQRFT:
+    @pytest.mark.slow
     def test_gaussian_kernel_approx_qmc(self, rng):
         # QMC should beat plain MC at equal S (or at least match).
         d, m, s, sigma = 6, 15, 1024, 2.0
@@ -288,6 +298,7 @@ class TestQRFT:
         Z = F.apply(jnp.asarray(rng.standard_normal((5, 4))), "columnwise")
         assert np.all(np.isfinite(np.asarray(Z)))
 
+    @pytest.mark.slow
     def test_deterministic_in_skip(self, rng):
         X = jnp.asarray(rng.standard_normal((5, 3)))
         Z1 = GaussianQRFT(5, 64, SketchContext(seed=1), skip=7).apply(X)
@@ -304,6 +315,7 @@ class TestFastRFT:
         Z = F.apply(jnp.asarray(X.T), "columnwise")
         assert _kernel_mse(Z, K) < 0.06
 
+    @pytest.mark.slow
     def test_matern_finite(self, rng):
         F = FastMaternRFT(10, 256, SketchContext(seed=2), nu=1.0, l=1.5)
         Z = F.apply(jnp.asarray(rng.standard_normal((10, 6))), "columnwise")
@@ -424,6 +436,7 @@ class TestFastRFT:
 
 
 class TestRLT:
+    @pytest.mark.slow
     def test_expsemigroup_kernel_approx(self, rng):
         # k(x,y) = exp(-beta * sum_i sqrt(x_i + y_i)) on histograms.
         d, m, s, beta = 5, 12, 16384, 0.3
@@ -435,6 +448,7 @@ class TestRLT:
         Z = F.apply(jnp.asarray(X.T), "columnwise")
         assert _kernel_mse(Z, K) < 0.05
 
+    @pytest.mark.slow
     def test_qrlt_finite_and_kernel(self, rng):
         d, m, s, beta = 4, 10, 4096, 0.25
         X = rng.random((m, d))
@@ -485,6 +499,7 @@ class TestPPT:
         )
         assert Z.shape == (64, 4)
 
+    @pytest.mark.slow
     def test_bf16_dft_matches_fft(self, rng, monkeypatch):
         """The bf16 matmul-DFT fast path (sketch/ppt.py round 3) must
         agree with the complex-FFT path to bf16 feature accuracy and

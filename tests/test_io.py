@@ -175,6 +175,7 @@ class TestRemoteSchemeIntegration:
         assert [len(b[1]) for b in batches] == [10, 10, 10, 7]
         np.testing.assert_allclose(np.vstack([b[0] for b in batches]), Xl)
 
+    @pytest.mark.slow
     def test_streaming_predict_over_http(self, http_root, rng, capsys):
         """End-to-end: train locally, then stream predictions straight
         off the remote URL through the skylark-ml CLI."""

@@ -106,6 +106,7 @@ class TestApproximateSVD:
         assert U.shape == (64, 4) and s.shape == (4,) and V.shape == (32, 4)
 
 
+@pytest.mark.slow
 class TestStreamingSVD:
     """Matrix-free row-streamed randomized SVD vs materialized oracles."""
 
@@ -223,6 +224,7 @@ class TestApproximateLeastSquares:
                 ok += 1
         assert ok >= 3
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("sketch_type", ["JLT", "CWT"])
     def test_sketch_types(self, rng, sketch_type):
         A = jnp.asarray(rng.standard_normal((1000, 10)))
@@ -238,6 +240,7 @@ class TestApproximateLeastSquares:
 
 
 class TestCLI:
+    @pytest.mark.slow
     def test_svd_cli_profile(self, tmp_path, monkeypatch):
         from libskylark_tpu.cli.svd import main
 
@@ -269,6 +272,7 @@ class TestCLI:
         assert rc == 0
         assert np.load(tmp_path / "o.S.npy").shape == (3,)
 
+    @pytest.mark.slow
     def test_svd_cli_hdf5(self, tmp_path, rng):
         """HDF5 input parity (≙ skylark_svd's HDF5 role, VERDICT item 6)."""
         from libskylark_tpu.cli.svd import main
@@ -285,6 +289,7 @@ class TestCLI:
         s_ref = np.linalg.svd(X, compute_uv=False)[:3]
         np.testing.assert_allclose(s, s_ref, rtol=0.5)
 
+    @pytest.mark.slow
     def test_svd_cli_arclist(self, tmp_path, rng):
         """Arc-list input ≙ ReadArcList (skylark_svd.cpp:169-171): SVD of
         the graph adjacency."""
@@ -303,6 +308,7 @@ class TestCLI:
         U = np.load(tmp_path / "g.U.npy")
         assert U.shape[1] == 3 and np.isfinite(U).all()
 
+    @pytest.mark.slow
     def test_svd_cli_ascii_output(self, tmp_path, rng):
         """--ascii writes the reference's El::Write convention:
         prefix.U/.S/.V plain-text (skylark_svd.cpp:110-112)."""

@@ -93,6 +93,7 @@ class TestKernels:
 
 
 class TestKRR:
+    @pytest.mark.slow
     def test_exact_matches_direct(self, rng):
         X = jnp.asarray(rng.standard_normal((40, 5)))
         y = jnp.asarray(rng.standard_normal(40))
@@ -106,6 +107,7 @@ class TestKRR:
             np.asarray(m.predict(X))[:, 0], K @ a_ref, rtol=1e-6, atol=1e-8
         )
 
+    @pytest.mark.slow
     def test_approximate_close_to_exact(self, rng):
         X = jnp.asarray(rng.standard_normal((150, 6)))
         y = jnp.asarray(np.sin(np.asarray(X).sum(1)))
@@ -118,6 +120,7 @@ class TestKRR:
         pa = np.asarray(approx.predict(X))[:, 0]
         assert np.mean(np.abs(pe - pa)) < 0.1
 
+    @pytest.mark.slow
     def test_sketched_approximate(self, rng):
         X = jnp.asarray(rng.standard_normal((300, 4)))
         y = jnp.asarray(np.asarray(X).sum(1))
@@ -128,6 +131,7 @@ class TestKRR:
         pred = np.asarray(m.predict(X))[:, 0]
         assert np.corrcoef(pred, np.asarray(y))[0, 1] > 0.9
 
+    @pytest.mark.slow
     def test_faster_matches_exact(self, rng):
         X = jnp.asarray(rng.standard_normal((120, 5)))
         y = jnp.asarray(rng.standard_normal(120))
@@ -141,6 +145,7 @@ class TestKRR:
             np.asarray(fast.A), np.asarray(exact.A), rtol=1e-4, atol=1e-6
         )
 
+    @pytest.mark.slow
     def test_large_scale_close_to_approximate(self, rng):
         X = jnp.asarray(rng.standard_normal((200, 6)))
         y = jnp.asarray(np.sin(np.asarray(X).sum(1)))
@@ -153,6 +158,7 @@ class TestKRR:
         pred = np.asarray(m.predict(X))[:, 0]
         assert np.corrcoef(pred, np.asarray(y))[0, 1] > 0.9
 
+    @pytest.mark.slow
     def test_multi_target(self, rng):
         X = jnp.asarray(rng.standard_normal((60, 4)))
         Y = jnp.asarray(rng.standard_normal((60, 3)))
@@ -185,6 +191,7 @@ class TestKRR:
         scale = np.abs(p32).max() + 1e-30
         assert np.abs(p16 - p32).max() / scale < 0.05  # bf16-level
 
+    @pytest.mark.slow
     def test_streaming_matches_large_scale(self, rng):
         """streaming_kernel_ridge (rows AND features streamed — the
         single-chip 10M×4K north-star machinery) runs the same BCD
@@ -254,6 +261,7 @@ class TestKRR:
             W_host, np.asarray(m_ref.W), rtol=1e-3, atol=1e-5
         )
 
+    @pytest.mark.slow
     def test_streaming_small_n_default_block_rows(self, rng):
         """Small n with the DEFAULT block_rows must fall back to one
         whole-problem panel (nb=1), not raise (round-3 advisor finding:
@@ -277,6 +285,7 @@ class TestKRR:
 
 
 class TestRLSC:
+    @pytest.mark.slow
     def test_kernel_rlsc_separable(self, rng):
         X, y = two_blobs(rng, 40, 4)
         m = kernel_rlsc(GaussianKernel(4, 2.0), jnp.asarray(X), y, 0.01)
@@ -301,6 +310,7 @@ class TestRLSC:
         )
 
 
+@pytest.mark.slow
 class TestBlockADMM:
     def _maps(self, d, blocks, s_each, seed=11, sigma=2.0):
         ctx = SketchContext(seed=seed)
@@ -420,6 +430,7 @@ class TestModelPersistence:
             rtol=1e-6,
         )
 
+    @pytest.mark.slow
     def test_kernel_model_roundtrip(self, tmp_path, rng):
         X = jnp.asarray(rng.standard_normal((25, 3)))
         y = jnp.asarray(rng.standard_normal(25))
@@ -436,6 +447,7 @@ class TestModelContainer:
     """≙ model_container_t (model.hpp:1138-1255): polymorphic load +
     embedded label coding."""
 
+    @pytest.mark.slow
     def test_load_model_dispatch_feature_map(self, tmp_path, rng):
         from libskylark_tpu.core.context import SketchContext
         from libskylark_tpu.ml import FeatureMapModel, GaussianKernel, load_model

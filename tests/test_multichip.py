@@ -27,6 +27,7 @@ def mesh():
     return default_mesh()  # (4, 2) on the 8 virtual devices
 
 
+@pytest.mark.slow
 class TestShardedAlgorithms:
     def test_blendenpik_sharded_matches_local(self, rng, mesh):
         A = jnp.asarray(rng.standard_normal((2048, 24)))

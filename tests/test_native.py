@@ -148,6 +148,7 @@ class TestNativeModelPredict:
         with pytest.raises(SkylarkError):
             native.model_predict(tmp_path / "nope.json", np.zeros((2, 3)))
 
+    @pytest.mark.slow
     def test_1d_coef_squeezes_like_python(self, tmp_path):
         from libskylark_tpu.ml import FeatureMapModel, GaussianKernel
 
@@ -164,6 +165,7 @@ class TestNativeModelPredict:
         assert out.shape == ref.shape  # (9,), not (9, 1)
         np.testing.assert_allclose(out, ref, rtol=1e-6, atol=1e-9)
 
+    @pytest.mark.slow
     def test_handle_reuse(self, tmp_path):
         from libskylark_tpu.ml import FeatureMapModel, GaussianKernel
 
@@ -301,6 +303,7 @@ class TestCAPI:
     @pytest.mark.parametrize("stype,cls,param", [
         ("JLT", JLT, 0.0), ("CWT", CWT, 0.0),
     ])
+    @pytest.mark.slow
     def test_apply_matches_python(self, rng, stype, cls, param):
         n, s, m = 40, 12, 7
         A = rng.standard_normal((n, m))
@@ -332,6 +335,7 @@ class TestCAPI:
             nu.apply(A), np.asarray(pu.apply(A)), rtol=1e-12
         )
 
+    @pytest.mark.slow
     def test_serialization_cross_language(self, rng):
         # native JSON → Python reconstruction → same sketch; and back.
         n, s = 25, 6
@@ -406,6 +410,7 @@ class TestLibsvmParser:
 class TestExtendedNativeTypes:
     """FJLT / RFT / RLT native applies match the JAX path."""
 
+    @pytest.mark.slow
     def test_fjlt_matches_python(self, rng):
         from libskylark_tpu.sketch import FJLT
 
@@ -426,6 +431,7 @@ class TestExtendedNativeTypes:
         ("GaussianRFT", "GaussianRFT", 2.5),
         ("LaplacianRFT", "LaplacianRFT", 1.5),
     ])
+    @pytest.mark.slow
     def test_rft_matches_python(self, rng, stype, pname, param):
         import libskylark_tpu.sketch as sk
 
@@ -439,6 +445,7 @@ class TestExtendedNativeTypes:
             rtol=1e-8, atol=1e-10,
         )
 
+    @pytest.mark.slow
     def test_rlt_matches_python(self, rng):
         from libskylark_tpu.sketch import ExpSemigroupRLT
 
@@ -452,6 +459,7 @@ class TestExtendedNativeTypes:
             rtol=1e-8, atol=1e-10,
         )
 
+    @pytest.mark.slow
     def test_extended_serialization_roundtrip(self, rng):
         from libskylark_tpu.sketch import from_json
 
@@ -534,6 +542,7 @@ class TestQMCAndPPTNative:
     @pytest.mark.parametrize("stype,pname", [
         ("GaussianQRFT", "GaussianQRFT"), ("LaplacianQRFT", "LaplacianQRFT"),
     ])
+    @pytest.mark.slow
     def test_qrft_matches_python(self, rng, stype, pname):
         import libskylark_tpu.sketch as sk
 
@@ -548,6 +557,7 @@ class TestQMCAndPPTNative:
         )
         assert nctx.counter == 0  # QMC consumes no counters
 
+    @pytest.mark.slow
     def test_qrlt_matches_python(self, rng):
         from libskylark_tpu.sketch import ExpSemigroupQRLT
 
@@ -588,6 +598,7 @@ class TestQMCAndPPTNative:
         with pytest.raises(SkylarkError):
             native.NativeSketch.create(nctx, "PPT", 10, 12, 1.0, 1.0, -1.0)
 
+    @pytest.mark.slow
     def test_all_16_serialization_roundtrips(self, rng):
         from libskylark_tpu.sketch import from_json
 

@@ -85,6 +85,7 @@ class TestMetrics:
 
 
 class TestRLS:
+    @pytest.mark.slow
     def test_classification(self, rng):
         X, y = blobs(rng, 40, 5)
         model = RLS(GaussianKernel(5, 3.0)).train(X, y, regularization=1e-3)
@@ -112,6 +113,7 @@ class TestSketchRLS:
         )
         assert float(classification_accuracy(model.predict(X), y)) > 92.0
 
+    @pytest.mark.slow
     def test_approaches_exact_rls(self, rng):
         """More features → predictions approach exact kernel RLS (the
         reference's doctest contract: sketched accuracy tracks exact)."""

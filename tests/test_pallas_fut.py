@@ -10,6 +10,7 @@ from libskylark_tpu.sketch import pallas_fut
 
 
 class TestPallasRFUT:
+    @pytest.mark.slow
     @pytest.mark.parametrize("n,nb", [(4096, 4096), (200, 256), (2048, 2048)])
     def test_matches_xla_wht(self, rng, n, nb):
         m = 16
@@ -80,6 +81,7 @@ class TestPallasSampledFJLT:
             np.asarray(out), ref, rtol=1e-5, atol=1e-5
         )
 
+    @pytest.mark.slow
     def test_fjlt_fused_path_matches_xla(self, rng):
         n, s, m = 512, 128, 32
         A = jnp.asarray(rng.standard_normal((m, n)).astype(np.float32))

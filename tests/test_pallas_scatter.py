@@ -39,6 +39,7 @@ class TestKernelParity:
             (9_000, 1 << 17),
         ],
     )
+    @pytest.mark.slow
     def test_random_keys(self, rng, nnz, T):
         keys = rng.integers(0, T, nnz).astype(np.int32)
         vals = rng.standard_normal(nnz).astype(np.float32)
@@ -50,6 +51,7 @@ class TestKernelParity:
         np.testing.assert_allclose(out, _ref(vals, keys, T), rtol=1e-5,
                                    atol=1e-5)
 
+    @pytest.mark.slow
     def test_partition_boundaries_and_collisions(self, rng):
         nnz, T = 16_384, 300_000
         K, P, V = _plan(nnz, T)
@@ -82,6 +84,7 @@ class TestKernelParity:
 
 
 class TestHashIntegration:
+    @pytest.mark.slow
     def test_dense_output_matches_xla_path(self, rng):
         """CWT/SJLT dense_output through the kernel (interpret) must be
         bit-compatible with the XLA segment_sum path."""

@@ -77,6 +77,7 @@ class TestRegressionFramework:
         e2 = np.linalg.norm(x2 - x_true)
         assert e1 < e2
 
+    @pytest.mark.slow
     def test_sketched_dispatch(self, rng):
         A = jnp.asarray(rng.standard_normal((800, 10)))
         b = jnp.asarray(rng.standard_normal(800))
@@ -88,6 +89,7 @@ class TestRegressionFramework:
 
 
 class TestAsyFCG:
+    @pytest.mark.slow
     def test_spd_solve(self, rng):
         A = spd(rng, 96, cond=1e3)
         b = jnp.asarray(rng.standard_normal(96))
@@ -109,6 +111,7 @@ class TestAsyFCG:
 
 
 class TestSJLT:
+    @pytest.mark.slow
     def test_norm_preservation_statistical(self, rng):
         n, s = 300, 100
         X = jnp.asarray(rng.standard_normal((n, 6)))
@@ -120,6 +123,7 @@ class TestSJLT:
             errs.append(np.abs(np.linalg.norm(np.asarray(SX), axis=0) - norms) / norms)
         assert np.mean(errs) < 3.0 / np.sqrt(s)
 
+    @pytest.mark.slow
     def test_rowwise_matches_columnwise(self, rng):
         n, s = 50, 20
         X = rng.standard_normal((7, n))

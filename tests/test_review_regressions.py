@@ -11,6 +11,7 @@ from libskylark_tpu.linalg import SVDParams, approximate_svd
 from libskylark_tpu.sketch import CWT
 
 
+@pytest.mark.slow
 def test_approximate_svd_on_bcoo(rng):
     dense = rng.standard_normal((60, 20))
     dense[rng.random((60, 20)) < 0.6] = 0.0

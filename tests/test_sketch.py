@@ -42,6 +42,7 @@ def dense_operator(S, n, dtype=jnp.float64):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kind", ALL_TYPES)
 def test_columnwise_rowwise_consistency(kind, rng):
     """A @ Omega.T == (Omega @ A.T).T — rowwise is the transpose of
@@ -56,6 +57,7 @@ def test_columnwise_rowwise_consistency(kind, rng):
     np.testing.assert_allclose(np.asarray(out_row), np.asarray(out_col).T, rtol=1e-12)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kind", ALL_TYPES)
 def test_apply_matches_explicit_operator(kind, rng):
     """Columnwise apply == (operator realized via identity) @ A."""
@@ -68,6 +70,7 @@ def test_apply_matches_explicit_operator(kind, rng):
     np.testing.assert_allclose(out, op @ A, rtol=1e-10, atol=1e-12)
 
 
+@pytest.mark.slow
 def test_jlt_scale_and_distribution():
     n, s = 400, 200
     ctx = SketchContext(seed=11)
@@ -133,6 +136,7 @@ def test_nurst_weighted(rng):
 
 
 @pytest.mark.parametrize("kind", HASH_TYPES)
+@pytest.mark.slow
 def test_hash_sparse_matches_dense(kind, rng):
     n, s, m = 32, 8, 6
     A = rng.standard_normal((n, m))
@@ -153,6 +157,7 @@ def test_hash_sparse_matches_dense(kind, rng):
 
 
 @pytest.mark.parametrize("kind", DENSE_TYPES)
+@pytest.mark.slow
 def test_dense_sketch_sparse_input(kind, rng):
     n, s, m = 24, 6, 5
     A = rng.standard_normal((n, m))
@@ -171,6 +176,7 @@ def test_dense_sketch_sparse_input(kind, rng):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kind", DENSE_TYPES + HASH_TYPES)
 def test_sharded_equals_local(kind, rng):
     """Apply on a fully-sharded A equals apply on a single device.
@@ -192,6 +198,7 @@ def test_sharded_equals_local(kind, rng):
     np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-10)
 
 
+@pytest.mark.slow
 def test_window_realization_matches_full():
     """Any window of the realized dense operator == slice of full operator
     (shard-local realization invariant, P5)."""
@@ -208,6 +215,7 @@ def test_window_realization_matches_full():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kind", ALL_TYPES)
 def test_serialization_roundtrip(kind, rng):
     n, s, m = 25, 9, 4
@@ -225,6 +233,7 @@ def test_serialization_roundtrip(kind, rng):
     assert json.loads(blob)["creation_context"]["counter"] == 1000
 
 
+@pytest.mark.slow
 def test_context_counter_accounting():
     """Each transform advances the shared stream; transforms built from the
     same context stream are independent (≙ base/context.hpp:91-101)."""
@@ -245,6 +254,7 @@ def test_context_counter_accounting():
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("kind", ["JLT", "CWT"])
 def test_l2_embedding_preserves_singular_values(kind):
     """σ_i(SA) within σ_i(A)·(1±0.5) for all i, for at least one of 5 seeds
@@ -269,6 +279,7 @@ class TestHashScatterFallback:
     """The segment_sum path (production path for huge N*S) must stay
     covered: force it by shrinking the one-hot threshold."""
 
+    @pytest.mark.slow
     def test_scatter_matches_onehot(self, rng, monkeypatch):
         import jax.numpy as jnp
         from libskylark_tpu import SketchContext
@@ -291,6 +302,7 @@ class TestHashScatterFallback:
             )
 
 
+@pytest.mark.slow
 class TestSparseDenseOutput:
     """``dense_output=True`` (≙ hash_transform_Mixed.hpp sparse→dense):
     sort-free per-hash segment_sum must equal the BCOO relabel path."""
@@ -346,6 +358,7 @@ class TestHoistableOperands:
         "cls,kw",
         [("CWT", {}), ("SJLT", {"nnz": 3}), ("MMT", {}), ("WZT", {"p": 1.5})],
     )
+    @pytest.mark.slow
     @pytest.mark.parametrize("dim", ["rowwise", "columnwise"])
     @pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
     def test_hash_family(self, rng, cls, kw, dim, dtype):

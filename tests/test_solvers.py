@@ -34,6 +34,7 @@ def spd(rng, n, cond=100.0):
 
 
 class TestLSQR:
+    @pytest.mark.slow
     def test_well_conditioned(self, rng):
         A = jnp.asarray(rng.standard_normal((200, 30)))
         b = jnp.asarray(rng.standard_normal(200))
@@ -41,6 +42,7 @@ class TestLSQR:
         x_ref = np.linalg.lstsq(np.asarray(A), np.asarray(b), rcond=None)[0]
         np.testing.assert_allclose(np.asarray(x), x_ref, rtol=1e-6, atol=1e-8)
 
+    @pytest.mark.slow
     def test_multi_rhs(self, rng):
         A = jnp.asarray(rng.standard_normal((150, 20)))
         B = jnp.asarray(rng.standard_normal((150, 4)))
@@ -233,6 +235,7 @@ class TestCondEst:
 
 
 class TestBlockGaussSeidel:
+    @pytest.mark.slow
     def test_spd_converges(self, rng):
         A = spd(rng, 100, cond=50) + 0.5 * jnp.eye(100)
         x_true = rng.standard_normal(100)
@@ -242,6 +245,7 @@ class TestBlockGaussSeidel:
         )
         np.testing.assert_allclose(np.asarray(x), x_true, rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow
     def test_deterministic_given_context(self, rng):
         A = spd(rng, 30) + jnp.eye(30)
         b = jnp.asarray(rng.standard_normal(30))
@@ -275,6 +279,7 @@ class TestProx:
         Y = jnp.asarray(np.sign(rng.standard_normal(25)))
         self._check_prox_is_argmin(get_loss(name), V, 0.5, Y, rng)
 
+    @pytest.mark.slow
     def test_logistic_prox_minimizes_multiclass(self, rng):
         V = jnp.asarray(rng.standard_normal((4, 15)))
         Y = jnp.asarray(rng.integers(0, 4, 15))
@@ -319,6 +324,7 @@ class TestAsyFcgSchedules:
         )
         assert not np.array_equal(np.asarray(u0), np.asarray(u1))
 
+    @pytest.mark.slow
     def test_converges_and_deterministic(self, rng):
         from libskylark_tpu.solvers.asynch import asy_fcg
 
@@ -334,6 +340,7 @@ class TestAsyFcgSchedules:
 
 
 class TestCondEstSparse:
+    @pytest.mark.slow
     def test_bcoo_stays_sparse(self, rng):
         """cond_est takes BCOO without densifying (matvec-only, as the
         reference's template works on any multipliable type)."""
