@@ -69,13 +69,23 @@ def main():
     with jax.ensure_compile_time_eval():
         idx = np.asarray(S1._ust.samples, np.int32)
 
-    print(
-        "supported_sampled:",
-        pallas_fut.supported_sampled(m, n, S1._nb, s),
-        " probe:", fjlt_mod._sampled_kernel_compiles(jnp.float32, S1._nb, s,
-        pallas_fut._tile_rows(m, S1._nb)),
-        flush=True,
-    )
+    ok_shape = pallas_fut.supported_sampled(m, n, S1._nb, s)
+    tile = pallas_fut._tile_rows(m, S1._nb)
+    if tile is None:
+        print(
+            f"shape {m}x{n} has no qualifying row tile — neither kernel "
+            "path applies; nothing to measure", flush=True,
+        )
+        return
+
+    def probe() -> bool:
+        # supported_sampled guarantees tile is not None on this branch;
+        # an unsupported shape must not crash the battery stage.
+        return ok_shape and fjlt_mod._sampled_kernel_compiles(
+            jnp.float32, S1._nb, s, tile
+        )
+
+    print(f"supported_sampled: {ok_shape}  probe: {probe()}", flush=True)
 
     def two_step(x):
         T = pallas_fut.rfut_rowwise(x, D, S1._nb)
@@ -84,8 +94,7 @@ def main():
     out_two, t_two = timed("two-step (WHT kernel + XLA gather)",
                            jax.jit(two_step), A)
 
-    if fjlt_mod._sampled_kernel_compiles(jnp.float32, S1._nb, s,
-        pallas_fut._tile_rows(m, S1._nb)):
+    if probe():
         fused = jax.jit(
             lambda x: pallas_fut.rfut_rowwise_sampled(x, D, S1._nb, idx)
         )

@@ -17,6 +17,7 @@ from .collectives import (
     columnwise_sharded_sparse,
     columnwise_sharded_sparse_2d,
     columnwise_sharded_sparse_out,
+    columnwise_sharded_sparse_out_2d,
     rowwise_sharded,
     rowwise_sharded_sparse,
     rowwise_sharded_sparse_out,
@@ -57,6 +58,7 @@ __all__ = [
     "columnwise_sharded_sparse",
     "columnwise_sharded_sparse_2d",
     "columnwise_sharded_sparse_out",
+    "columnwise_sharded_sparse_out_2d",
     "rowwise_sharded_sparse_out",
     "ShardedBCOO",
 ]

@@ -39,6 +39,7 @@ __all__ = [
     "columnwise_sharded_sparse",
     "columnwise_sharded_sparse_2d",
     "columnwise_sharded_sparse_out",
+    "columnwise_sharded_sparse_out_2d",
     "rowwise_sharded_sparse_out",
     "ShardedBCOO",
 ]
@@ -249,6 +250,30 @@ def _shard_coo_grid(A, pr: int, pc: int, rblock: int, cblock: int):
     return jnp.asarray(d), jnp.asarray(lr), jnp.asarray(lc)
 
 
+def _validate_grid_2d(S, A, mesh: Mesh, fn_name: str):
+    """Shared preamble of the 2-D grid schedules: axis/shape/2^32
+    validation + host-side COO grid split.  Returns
+    ``(pr, pc, rblock, cblock, d, lr, lc)``."""
+    if len(mesh.axis_names) != 2:
+        raise ValueError(
+            f"{fn_name} needs a 2-axis mesh, got {mesh.axis_names}"
+        )
+    ax_r, ax_c = mesh.axis_names
+    pr, pc = mesh.shape[ax_r], mesh.shape[ax_c]
+    n, m = A.shape
+    if n != S.n:
+        raise ValueError(f"columnwise apply needs A with {S.n} rows, got {A.shape}")
+    if n % pr or m % pc:
+        raise ValueError(
+            f"shape {A.shape} not divisible by mesh grid ({pr}, {pc})"
+        )
+    if n >= (1 << 32):
+        raise ValueError(f"supports N < 2^32, got N={n}")
+    rblock, cblock = n // pr, m // pc
+    d, lr, lc = _shard_coo_grid(A, pr, pc, rblock, cblock)
+    return pr, pc, rblock, cblock, d, lr, lc
+
+
 def columnwise_sharded_sparse_2d(S, A, mesh: Mesh):
     """BCOO A (N, m) on a 2-D grid → dense S·A (S, m), column-sharded.
 
@@ -264,24 +289,9 @@ def columnwise_sharded_sparse_2d(S, A, mesh: Mesh):
     Needs a 2-axis mesh (e.g. ``make_mesh((pr, pc))``); N and m must
     divide the respective axis sizes.
     """
-    if len(mesh.axis_names) != 2:
-        raise ValueError(
-            f"columnwise_sharded_sparse_2d needs a 2-axis mesh, got "
-            f"{mesh.axis_names}"
-        )
-    ax_r, ax_c = mesh.axis_names
-    pr, pc = mesh.shape[ax_r], mesh.shape[ax_c]
-    n, m = A.shape
-    if n != S.n:
-        raise ValueError(f"columnwise apply needs A with {S.n} rows, got {A.shape}")
-    if n % pr or m % pc:
-        raise ValueError(
-            f"shape {A.shape} not divisible by mesh grid ({pr}, {pc})"
-        )
-    if n >= (1 << 32):
-        raise ValueError(f"supports N < 2^32, got N={n}")
-    rblock, cblock = n // pr, m // pc
-    d, lr, lc = _shard_coo_grid(A, pr, pc, rblock, cblock)
+    _, _, rblock, cblock, d, lr, lc = _validate_grid_2d(
+        S, A, mesh, "columnwise_sharded_sparse_2d"
+    )
     return _columnwise_sparse_2d_program(S, rblock, cblock, mesh)(d, lr, lc)
 
 
@@ -381,9 +391,13 @@ class ShardedBCOO:
     densified; ``to_bcoo``/``todense`` are explicit host-side exits.
     """
 
-    def __init__(self, data, rows, cols, shape, row_block, mesh):
+    def __init__(self, data, rows, cols, shape, row_block, mesh,
+                 col_block: int | None = None):
         self.data, self.rows, self.cols = data, rows, cols
         self.shape, self.row_block, self.mesh = shape, row_block, mesh
+        # 2-D grid results (√p×√p CombBLAS analogue): cols are local to
+        # the shard's column block of width col_block; None = global.
+        self.col_block = col_block
 
     @property
     def dtype(self):
@@ -397,17 +411,25 @@ class ShardedBCOO:
         the result's nse is entry-proportional, never buffer-sized."""
         import numpy as np
 
-        p = self.data.shape[0]
-        d = np.asarray(self.data).reshape(p, -1)
-        r = np.asarray(self.rows).reshape(p, -1)
-        c = np.asarray(self.cols).reshape(p, -1)
-        grows = r + np.arange(p, dtype=r.dtype)[:, None] * self.row_block
+        d = np.asarray(self.data)
+        r = np.asarray(self.rows)
+        c = np.asarray(self.cols)
+        if d.ndim == 2:  # 1-D row-block layout -> trivial 1-wide grid
+            d, r, c = d[:, None], r[:, None], c[:, None]
+        pr, pc = d.shape[0], d.shape[1]
+        grows = r + np.arange(pr, dtype=r.dtype)[:, None, None] * self.row_block
+        gcols = c + (
+            np.arange(pc, dtype=c.dtype)[None, :, None] * self.col_block
+            if self.col_block is not None
+            else 0
+        )
         keep = d.ravel() != 0
         if not keep.any():
             return jsparse.BCOO.fromdense(
                 jnp.zeros(self.shape, self.data.dtype), nse=1
             )
-        dk, rk, ck = d.ravel()[keep], grows.ravel()[keep], c.ravel()[keep]
+        dk = d.ravel()[keep]
+        rk, ck = grows.ravel()[keep], gcols.ravel()[keep]
         idx = jnp.stack([jnp.asarray(rk), jnp.asarray(ck)], axis=1)
         out = jsparse.BCOO((jnp.asarray(dk), idx), shape=self.shape)
         nse = min(out.nse, self.shape[0] * self.shape[1])
@@ -463,6 +485,66 @@ def columnwise_sharded_sparse_out(S, A, mesh: Mesh, capacity: int | None = None)
     return ShardedBCOO(dv, rv, cv, (S.s, m), out_block, mesh)
 
 
+def _exchange_entries(val, row, col, nparts: int, out_block: int, cap: int,
+                      axis, my_index):
+    """Route (val, row, col) entries to the mesh-axis peer owning row
+    block ``row // out_block`` via ONE tiled ``all_to_all`` of
+    fixed-capacity per-destination buffers (f32: values ride the packed
+    int32 index exchange via bitcast; f64 takes a second exchange).
+    Returns (values, LOCAL rows, cols), each (nparts, cap), for the
+    receiving shard.  Shared by the 1-D and 2-D sparse-out schedules.
+
+    Zero-value entries (COO block padding — the hash values are nonzero
+    a.s., so val == 0 iff the padded data slot was 0) are routed to the
+    out-of-range sentinel destination ``nparts``: they never occupy
+    capacity slots, so a user capacity derived from REAL
+    per-destination counts cannot drop real entries, and the
+    out-of-bounds scatter row drops them before the exchange."""
+    dtype = val.dtype
+    dest = row // jnp.int32(out_block)
+    dest = jnp.where(val == 0, jnp.int32(nparts), dest)
+    # Sort by destination; position-in-segment via searchsorted.
+    order = jnp.argsort(dest)
+    sd = dest[order]
+    starts = jnp.searchsorted(sd, jnp.arange(nparts, dtype=sd.dtype))
+    pos = jnp.arange(sd.shape[0], dtype=jnp.int32) - starts[
+        jnp.minimum(sd, nparts - 1)
+    ].astype(jnp.int32)
+    if dtype == jnp.float32:
+        # Values ride the SAME packed int32 exchange (bitcast lane):
+        # the buffers are the payload, but launch latency is per-op.
+        buf = (
+            jnp.zeros((nparts, 3, cap), jnp.int32)
+            .at[sd, 0, pos].set(row[order], mode="drop")
+            .at[sd, 1, pos].set(col[order], mode="drop")
+            .at[sd, 2, pos].set(
+                jax.lax.bitcast_convert_type(val[order], jnp.int32),
+                mode="drop",
+            )
+        )
+        rbuf = jax.lax.all_to_all(buf, axis, 0, 0, tiled=True)
+        rr, rc = rbuf[:, 0], rbuf[:, 1]
+        rv = jax.lax.bitcast_convert_type(rbuf[:, 2], jnp.float32)
+    else:  # f64 (x64 parity runs): values need their own exchange
+        buf_v = jnp.zeros((nparts, cap), dtype).at[sd, pos].set(
+            val[order], mode="drop"
+        )
+        buf_i = (
+            jnp.zeros((nparts, 2, cap), jnp.int32)
+            .at[sd, 0, pos].set(row[order], mode="drop")
+            .at[sd, 1, pos].set(col[order], mode="drop")
+        )
+        rv = jax.lax.all_to_all(buf_v, axis, 0, 0, tiled=True)
+        ri = jax.lax.all_to_all(buf_i, axis, 0, 0, tiled=True)
+        rr, rc = ri[:, 0], ri[:, 1]
+    # Received rows are global; relabel to this shard's row block.
+    # Padding entries (value 0) clip to local row 0 — harmless.
+    lrows = jnp.clip(
+        rr - jnp.int32(my_index) * jnp.int32(out_block), 0, out_block - 1
+    )
+    return rv, lrows, rc
+
+
 def _columnwise_sparse_out_program(S, block: int, out_block: int, cap: int,
                                    mesh: Mesh):
     """Jittable device half of :func:`columnwise_sharded_sparse_out`;
@@ -486,52 +568,8 @@ def _columnwise_sparse_out_program(S, block: int, out_block: int, cap: int,
         val = jnp.concatenate(vals)              # (E,)
         row = jnp.concatenate(rows)              # global out rows [0, S)
         col = jnp.tile(cc, S.nnz)
-        dest = row // jnp.int32(out_block)
-        # Zero-value entries (COO block padding — the hash values are
-        # nonzero a.s., so val == 0 iff the padded data slot was 0) are
-        # routed to the out-of-range sentinel destination p: they never
-        # occupy capacity slots, so a user capacity derived from REAL
-        # per-destination counts cannot drop real entries, and the
-        # out-of-bounds scatter row drops them before the exchange.
-        dest = jnp.where(val == 0, jnp.int32(p), dest)
-        # Sort by destination; position-in-segment via searchsorted.
-        order = jnp.argsort(dest)
-        sd = dest[order]
-        starts = jnp.searchsorted(sd, jnp.arange(p, dtype=sd.dtype))
-        pos = jnp.arange(sd.shape[0], dtype=jnp.int32) - starts[
-            jnp.minimum(sd, p - 1)
-        ].astype(jnp.int32)
-        if dtype == jnp.float32:
-            # Values ride the SAME packed int32 exchange (bitcast lane):
-            # the buffers are the payload, but launch latency is per-op.
-            buf = (
-                jnp.zeros((p, 3, cap), jnp.int32)
-                .at[sd, 0, pos].set(row[order], mode="drop")
-                .at[sd, 1, pos].set(col[order], mode="drop")
-                .at[sd, 2, pos].set(
-                    jax.lax.bitcast_convert_type(val[order], jnp.int32),
-                    mode="drop",
-                )
-            )
-            rbuf = jax.lax.all_to_all(buf, axes, 0, 0, tiled=True)
-            rr, rc = rbuf[:, 0], rbuf[:, 1]
-            rv = jax.lax.bitcast_convert_type(rbuf[:, 2], jnp.float32)
-        else:  # f64 (x64 parity runs): values need their own exchange
-            buf_v = jnp.zeros((p, cap), dtype).at[sd, pos].set(
-                val[order], mode="drop"
-            )
-            buf_i = (
-                jnp.zeros((p, 2, cap), jnp.int32)
-                .at[sd, 0, pos].set(row[order], mode="drop")
-                .at[sd, 1, pos].set(col[order], mode="drop")
-            )
-            rv = jax.lax.all_to_all(buf_v, axes, 0, 0, tiled=True)
-            ri = jax.lax.all_to_all(buf_i, axes, 0, 0, tiled=True)
-            rr, rc = ri[:, 0], ri[:, 1]
-        # Received rows are global; relabel to this shard's row block.
-        # Padding entries (value 0) clip to local row 0 — harmless.
-        lrows = jnp.clip(
-            rr - jnp.int32(idx) * jnp.int32(out_block), 0, out_block - 1
+        rv, lrows, rc = _exchange_entries(
+            val, row, col, p, out_block, cap, axes, idx
         )
         flat = (1, p * cap)
         return (
@@ -545,6 +583,97 @@ def _columnwise_sparse_out_program(S, block: int, out_block: int, cap: int,
         mesh=mesh,
         in_specs=(P(axes, None), P(axes, None), P(axes, None)),
         out_specs=(P(axes, None), P(axes, None), P(axes, None)),
+    )
+
+
+def columnwise_sharded_sparse_out_2d(S, A, mesh: Mesh,
+                                     capacity: int | None = None):
+    """BCOO A (N, m) on a 2-D grid -> BCOO S·A (S, m) on the SAME grid,
+    never densified — the full SpParMat→SpParMat analogue
+    (``sketch/hash_transform_CombBLAS.hpp:136-302``: the reference's
+    CombBLAS matrices are natively √p×√p-distributed, and its sketch
+    keeps the output on the grid).
+
+    Nonzeros are owned by (row-block, column-block).  An entry's output
+    column block is its INPUT column block (columnwise sketching leaves
+    columns alone), so routing is column-local: each shard relabels its
+    entries to (bucket, local col, v·val) with in-shard counter windows
+    (P5) and exchanges them with its mesh-COLUMN peers through one
+    tiled ``all_to_all`` over the mesh ROW axis.  Output: shard (i, j)
+    owns rows [i·S/pr, (i+1)·S/pr) × cols [j·m/pc, (j+1)·m/pc).
+    Communication ∝ entries, rides one mesh axis; memory is
+    entry-proportional — never an (S, m/pc) dense block (contrast
+    :func:`columnwise_sharded_sparse_2d`, the dense-output variant).
+
+    ``capacity`` as in :func:`columnwise_sharded_sparse_out`: per-
+    (source, destination) REAL-entry buffer length; the default cannot
+    drop.
+    """
+    pr, pc, rblock, cblock, d, lr, lc = _validate_grid_2d(
+        S, A, mesh, "columnwise_sharded_sparse_out_2d"
+    )
+    if S.s % pr:
+        raise ValueError(
+            f"sparse-out needs S={S.s} divisible by mesh rows {pr} "
+            "(output rows are block-sharded over the row axis)"
+        )
+    out_rblock = S.s // pr
+    entries = S.nnz * d.shape[2]
+    cap = entries if capacity is None else int(capacity)
+    dv, rv, cv = _columnwise_sparse_out_2d_program(
+        S, rblock, out_rblock, cap, mesh
+    )(d, lr, lc)
+    return ShardedBCOO(
+        dv, rv, cv, (S.s, A.shape[1]), out_rblock, mesh, col_block=cblock
+    )
+
+
+def _columnwise_sparse_out_2d_program(S, rblock: int, out_rblock: int,
+                                      cap: int, mesh: Mesh):
+    """Jittable device half of :func:`columnwise_sharded_sparse_out_2d`;
+    factored out for the compiled-HLO locks (one all-to-all over the
+    row axis only, NO psum, NO dense accumulator)."""
+    ax_r, ax_c = mesh.axis_names
+    pr = mesh.shape[ax_r]
+
+    def local(d, lr, lc):
+        dtype = _coo_dtype(d)
+        d, lr, lc = d[0, 0].astype(dtype), lr[0, 0], lc[0, 0]
+        i = jax.lax.axis_index(ax_r)
+        off = jnp.uint32(i) * jnp.uint32(rblock)
+        vals, rows = [], []
+        for h in range(S.nnz):
+            start = (h * S.n, off)
+            b = S.buckets(start=start, num=rblock)
+            v = S.values(dtype, start=start, num=rblock)
+            vals.append(d * v[lr])
+            rows.append(b[lr])
+        val = jnp.concatenate(vals)
+        row = jnp.concatenate(rows)              # global out rows [0, S)
+        col = jnp.tile(lc, S.nnz)                # LOCAL cols: stay put
+        rv, lrows, rc = _exchange_entries(
+            val, row, col, pr, out_rblock, cap, ax_r, i
+        )
+        flat = (1, 1, pr * cap)
+        return (
+            rv.reshape(flat),
+            lrows.reshape(flat),
+            rc.reshape(flat),
+        )
+
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(
+            P(ax_r, ax_c, None),
+            P(ax_r, ax_c, None),
+            P(ax_r, ax_c, None),
+        ),
+        out_specs=(
+            P(ax_r, ax_c, None),
+            P(ax_r, ax_c, None),
+            P(ax_r, ax_c, None),
+        ),
     )
 
 

@@ -207,6 +207,41 @@ class TestSparseOutSchedules:
         with pytest.raises(ValueError, match="divisible"):
             columnwise_sharded_sparse_out(S, A, mesh)
 
+    @pytest.mark.parametrize(
+        "sketch_cls,kw", [(CWT, {}), (SJLT, {"nnz": 3})]
+    )
+    def test_2d_grid_matches_local(self, rng, sketch_cls, kw):
+        """Full SpParMat→SpParMat: input on a (4, 2) grid, output on the
+        SAME grid, routing column-local over the mesh row axis."""
+        from libskylark_tpu.parallel import (
+            columnwise_sharded_sparse_out_2d,
+            make_mesh,
+        )
+
+        mesh = make_mesh((4, 2), ("r", "c"))
+        n, s, m = 32, 16, 10
+        S = sketch_cls(n, s, SketchContext(seed=61), **kw)
+        A, _ = _random_bcoo(rng, (n, m), density=0.35)
+        out = columnwise_sharded_sparse_out_2d(S, A, mesh)
+        assert out.col_block == m // 2
+        ref = S.apply(A, "columnwise")
+        np.testing.assert_allclose(
+            np.asarray(out.todense()), np.asarray(ref.todense()),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_2d_grid_needs_2d_mesh(self, rng):
+        from libskylark_tpu.parallel import (
+            columnwise_sharded_sparse_out_2d,
+            make_mesh,
+        )
+
+        mesh = make_mesh((8,), ("p",))  # 1-axis: must be rejected
+        A, _ = _random_bcoo(rng, (64, 8))
+        S = CWT(64, 16, SketchContext(seed=62))
+        with pytest.raises(ValueError, match="2-axis"):
+            columnwise_sharded_sparse_out_2d(S, A, mesh)
+
     def test_safe_capacity_never_drops_on_hot_bucket(self, rng):
         """Adversarial: a sketch where EVERY input row hashes to a
         bucket owned by ONE shard must survive the default capacity
@@ -479,6 +514,31 @@ class TestCompiledCommunicationSchedules:
             d, lr, cc,
         )
         assert counts == {"all-to-all": want}, counts
+
+    @pytest.mark.slow
+    def test_sparse_out_2d_one_row_axis_all_to_all(self, rng):
+        """The 2-D sparse-out exchange rides the mesh ROW axis only:
+        one all-to-all (f32), no reduction collective, no dense block."""
+        from jax.experimental import sparse as jsparse
+
+        from libskylark_tpu.parallel import make_mesh
+        from libskylark_tpu.parallel.collectives import (
+            _columnwise_sparse_out_2d_program,
+            _shard_coo_grid,
+        )
+
+        n, s, m = 32, 16, 10
+        mesh = make_mesh((4, 2), ("r", "c"))
+        S = CWT(n, s, SketchContext(seed=63))
+        M = rng.standard_normal((n, m)) * (rng.random((n, m)) < 0.35)
+        A = jsparse.BCOO.fromdense(jnp.asarray(M, jnp.float32))
+        d, lr, lc = _shard_coo_grid(A, 4, 2, n // 4, m // 2)
+        cap = S.nnz * d.shape[2]
+        counts = _collective_counts(
+            _columnwise_sparse_out_2d_program(S, n // 4, s // 4, cap, mesh),
+            d, lr, lc,
+        )
+        assert counts == {"all-to-all": 1}, counts
 
     @pytest.mark.slow
     def test_sparse_out_rowwise_zero_collectives(self, rng):
