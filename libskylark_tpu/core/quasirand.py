@@ -40,19 +40,23 @@ def primes(n: int) -> np.ndarray:
         limit *= 2
 
 
-def radical_inverse(base, idx) -> jnp.ndarray:
+def radical_inverse(base, idx, ndigits: int = 41) -> jnp.ndarray:
     """Van der Corput radical inverse of ``idx + 1`` in ``base``.
 
     Matches ``RadialInverseFunction`` (``base/quasirand.hpp:9-20``) including
     its 1-based indexing.  ``base`` and ``idx`` broadcast elementwise.
+
+    ``ndigits`` bounds the digit loop; the default (41 digits of base>=2)
+    exhausts any 41-bit index.  Iterations past the base's last nonzero
+    digit add exactly 0.0, so a smaller static bound (when the caller
+    knows ``max(idx)``) is BIT-IDENTICAL, just cheaper — ``window()``
+    exploits this per prime base.
     """
     fdtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
     base = jnp.asarray(base)
     res0 = jnp.asarray(idx) + 1
     shape = jnp.broadcast_shapes(base.shape, res0.shape)
     fbase = base.astype(fdtype)
-    # 41 digits of base>=2 exhaust any 41-bit index; enough for our windows.
-    ndigits = 41
 
     def body(_, carry):
         r, m, res = carry
@@ -89,11 +93,45 @@ class LeapedHaltonSequence:
         return radical_inverse(p, jnp.asarray(idx) * self.leap)
 
     def window(self, idx0: int, num: int, dtype=jnp.float32) -> jnp.ndarray:
-        """(num, d) block of the sequence starting at index ``idx0``."""
+        """(num, d) block of the sequence starting at index ``idx0``.
+
+        The 41-digit loop is wasteful for most dimensions: a base-p
+        digit expansion of the (static) max index in the window has only
+        ``ceil(log_p(max))`` nonzero digits — 2-4 for the large primes
+        that dominate wide sequences — and the iterations past it add
+        exactly 0.0.  Columns are therefore grouped into a few static
+        digit tiers and each tier runs its own (shorter) loop; the
+        result is bit-identical to the full 41-digit loop."""
         itype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
         idx = (idx0 + jnp.arange(num, dtype=itype))[:, None] * self.leap
-        p = jnp.asarray(primes(self.d))[None, :].astype(itype)
-        return radical_inverse(p, idx).astype(dtype)
+        p_np = primes(self.d)
+        if not p_np.size:
+            return jnp.zeros((num, 0), dtype)
+        max_res = (idx0 + num) * self.leap + 1  # static bound on idx+1
+        # Exact integer digit count (float logs undercount by one at
+        # p^k boundaries, which would drop the leading digit): smallest
+        # k with p^k > max_res, via arbitrary-precision Python ints.
+        need = np.empty(p_np.size, np.int64)
+        for j, p in enumerate(p_np):
+            k, acc = 1, int(p)
+            while acc <= max_res and k < 41:
+                acc *= int(p)
+                k += 1
+            need[j] = k
+        tiers = (2, 3, 4, 6, 8, 12, 16, 24, 32, 41)
+        tier = np.array([min(t for t in tiers if t >= k) for k in need])
+        pieces, col_order = [], []
+        for t in tiers:
+            sel = np.flatnonzero(tier == t)
+            if not sel.size:
+                continue
+            pb = jnp.asarray(p_np[sel])[None, :].astype(itype)
+            pieces.append(radical_inverse(pb, idx, ndigits=int(t)))
+            col_order.append(sel)
+        if len(pieces) == 1:
+            return pieces[0].astype(dtype)
+        inv = np.argsort(np.concatenate(col_order))
+        return jnp.concatenate(pieces, axis=1)[:, inv].astype(dtype)
 
     # -- serialization ------------------------------------------------------
 

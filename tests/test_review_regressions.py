@@ -86,3 +86,50 @@ def test_kernel_probe_runs_inside_jit_trace(monkeypatch):
     traced(jnp.ones(4))
     assert result["ok"] is True
     assert hash_mod._KERNEL_COMPILES is True
+
+
+def test_halton_window_tiered_digits_bit_identical():
+    """window()'s per-base digit tiers must be BIT-identical to the full
+    41-digit loop (skipped iterations add exactly 0.0)."""
+    from libskylark_tpu.core.quasirand import (
+        LeapedHaltonSequence,
+        primes,
+        radical_inverse,
+    )
+
+    seq = LeapedHaltonSequence(200)
+    for idx0, num in ((0, 16), (1000, 8), (123456, 4)):
+        out = seq.window(idx0, num, dtype=jnp.float64)
+        itype = jnp.int64
+        idx = (idx0 + jnp.arange(num, dtype=itype))[:, None] * seq.leap
+        p = jnp.asarray(primes(seq.d))[None, :].astype(itype)
+        full = radical_inverse(p, idx, ndigits=41).astype(jnp.float64)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(full))
+
+
+def test_halton_window_exact_at_power_boundaries():
+    """Digit counts must be exact integers: float logs undercount at
+    p^k boundaries (review r5), dropping the leading digit for those
+    columns.  Constructs a window whose max index sits exactly at a
+    prime power and checks against the full 41-digit loop."""
+    from libskylark_tpu.core.quasirand import (
+        LeapedHaltonSequence,
+        primes,
+        radical_inverse,
+    )
+
+    seq = LeapedHaltonSequence(30, leap=1)  # leap=1: indices are raw
+    p5 = int(primes(30)[2])  # base 5
+    idx0 = p5**6 - 3  # window straddles 5^6 exactly
+    out = seq.window(idx0, 6, dtype=jnp.float64)
+    idx = (idx0 + jnp.arange(6, dtype=jnp.int64))[:, None]
+    p = jnp.asarray(primes(seq.d))[None, :].astype(jnp.int64)
+    full = radical_inverse(p, idx, ndigits=41).astype(jnp.float64)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(full))
+
+
+def test_halton_window_zero_dims():
+    from libskylark_tpu.core.quasirand import LeapedHaltonSequence
+
+    out = LeapedHaltonSequence(0, leap=7).window(0, 4)
+    assert out.shape == (4, 0)
