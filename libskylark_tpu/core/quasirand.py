@@ -107,7 +107,14 @@ class LeapedHaltonSequence:
         p_np = primes(self.d)
         if not p_np.size:
             return jnp.zeros((num, 0), dtype)
-        max_res = (idx0 + num) * self.leap + 1  # static bound on idx+1
+        try:
+            start = int(idx0)  # tier math needs a static window start
+        except (TypeError, jax.errors.ConcretizationTypeError):
+            # Traced idx0 (window() is public API): keep the old fully
+            # traceable 41-digit path rather than concretizing.
+            p = jnp.asarray(p_np)[None, :].astype(itype)
+            return radical_inverse(p, idx).astype(dtype)
+        max_res = (start + num) * self.leap + 1  # static bound on idx+1
         # Exact integer digit count (float logs undercount by one at
         # p^k boundaries, which would drop the leading digit): smallest
         # k with p^k > max_res, via arbitrary-precision Python ints.

@@ -21,6 +21,7 @@ from .collectives import (
     rowwise_sharded,
     rowwise_sharded_sparse,
     rowwise_sharded_sparse_out,
+    suggest_sparse_out_capacity,
 )
 from .mesh import (
     ROWS,
@@ -60,5 +61,6 @@ __all__ = [
     "columnwise_sharded_sparse_out",
     "columnwise_sharded_sparse_out_2d",
     "rowwise_sharded_sparse_out",
+    "suggest_sparse_out_capacity",
     "ShardedBCOO",
 ]

@@ -41,6 +41,7 @@ __all__ = [
     "columnwise_sharded_sparse_out",
     "columnwise_sharded_sparse_out_2d",
     "rowwise_sharded_sparse_out",
+    "suggest_sparse_out_capacity",
     "ShardedBCOO",
 ]
 
@@ -543,6 +544,37 @@ def _exchange_entries(val, row, col, nparts: int, out_block: int, cap: int,
         rr - jnp.int32(my_index) * jnp.int32(out_block), 0, out_block - 1
     )
     return rv, lrows, rc
+
+
+def suggest_sparse_out_capacity(S, A, mesh: Mesh) -> int:
+    """Exact per-(source, destination) REAL-entry capacity for
+    :func:`columnwise_sharded_sparse_out` on this (sketch, matrix, mesh)
+    — the tightest value that cannot drop (padding never counts: it
+    rides the sentinel destination).  Host-side: hashes the nonzero
+    global rows once with the same counter-derived buckets the schedule
+    uses.  Worth calling when the default (every entry of one source on
+    one destination) over-allocates badly — e.g. near-uniform hashes,
+    where the true max is ≈ entries/p + O(√entries)."""
+    import numpy as np
+
+    p = mesh.size
+    n = A.shape[0]
+    block, out_block = n // p, S.s // p
+    rows = np.asarray(A.indices[:, 0])
+    data = np.asarray(A.data)
+    buckets = np.asarray(S.buckets())  # (nnz*N,) flat layout
+    need = 1
+    for src in range(p):
+        sel = (rows // block == src) & (data != 0)
+        gl = rows[sel]
+        if not gl.size:
+            continue
+        # All hash functions of one source share the destination buffer.
+        dests = np.concatenate(
+            [buckets[h * S.n + gl] // out_block for h in range(S.nnz)]
+        )
+        need = max(need, int(np.bincount(dests, minlength=p).max()))
+    return need
 
 
 def _columnwise_sparse_out_program(S, block: int, out_block: int, cap: int,

@@ -273,7 +273,6 @@ class TestSparseOutSchedules:
         equal to the true max per-(src, dst) REAL entry count loses
         nothing even when shards are skewed (some heavily padded)."""
         from libskylark_tpu.parallel import columnwise_sharded_sparse_out
-        from libskylark_tpu.parallel.collectives import _shard_coo_rows
 
         n, s, m = 64, 16, 6
         mesh = default_mesh()
@@ -285,15 +284,13 @@ class TestSparseOutSchedules:
 
         A = jsparse.BCOO.fromdense(jnp.asarray(M, jnp.float32))
         S = CWT(n, s, SketchContext(seed=47))
-        # True per-(src,dst) real-entry count, computed host-side.
-        d, lr, cc = (np.asarray(x) for x in _shard_coo_rows(A, p, n // p))
-        need = 0
-        for src in range(p):
-            real = d[src] != 0
-            gl = lr[src][real] + src * (n // p)
-            dests = np.asarray(S.buckets())[gl] // (s // p)
-            if dests.size:
-                need = max(need, int(np.bincount(dests, minlength=p).max()))
+        from libskylark_tpu.parallel import suggest_sparse_out_capacity
+
+        need = suggest_sparse_out_capacity(S, A, mesh)
+        # Tight: with one hot source block and a near-uniform hash over
+        # p destinations, the exact count sits near nse/p — far under
+        # the drop-proof default of nnz*nse.
+        assert need < S.nnz * A.nse // 2
         out = columnwise_sharded_sparse_out(S, A, mesh, capacity=need)
         ref = S.apply(A, "columnwise")
         np.testing.assert_allclose(
