@@ -56,8 +56,8 @@ _REQUIRED = (
     "timer-mismatch",
 )
 # ...and these either pass or print a reasoned per-check SKIP (gloo may
-# not implement every collective on CPU).
-_OK_OR_SKIP = ("psum-scatter", "all-to-all")
+# not implement every collective on CPU; sparse-out rides all_to_all).
+_OK_OR_SKIP = ("psum-scatter", "all-to-all", "sparse-out")
 
 
 def _free_port() -> int:
