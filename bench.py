@@ -463,14 +463,62 @@ def bench_sparse_cwt(on_tpu, table):
 
         return jax.jit(run)
 
-    per = _rep_diff(build, (data, idx), r1=1, r2=3, rounds=8)
-    _emit(
-        f"CWT BCOO {n}x{m} nnz={nnz:.0e} -> {s} dense_output",
-        per * 1e3,
-        "ms",
-        357.0 / (per * 1e3) if on_tpu else 1.0,
-        table,
-    )
+    # Measure BOTH scatter paths so the driver artifact itself carries
+    # the Pallas-kernel-vs-XLA comparison (round 5: the kernel has
+    # hardware evidence only if a tunnel window opens; this row pair is
+    # the fallback evidence).  The env var is read at trace time, so
+    # each setting builds a distinct program.
+    prev = os.environ.get("SKYLARK_PALLAS_SCATTER")
+    try:
+        # XLA row first: a forced-kernel lowering failure must not cost
+        # the baseline measurement.
+        from libskylark_tpu.sketch import pallas_scatter
+
+        for tag, setting in (("xla", "0"), ("pallas", "1")):
+            label = f"CWT BCOO {n}x{m} nnz={nnz:.0e} -> {s} dense_output" + (
+                f" [{tag}]" if on_tpu else ""
+            )
+            if tag == "pallas":
+                # The forced setting is honored only when the kernel's
+                # own gate admits the shape — a silent XLA fallthrough
+                # must not masquerade as a kernel measurement.
+                if not pallas_scatter.supported(nnz, s * m):
+                    _emit(
+                        f"{label} (skipped: shape outside kernel gate)",
+                        -1, "skipped", 0, table, contention=None,
+                    )
+                    continue
+                if _remaining() < 0.6 * 150:
+                    _emit(
+                        f"{label} (skipped: budget)", -1, "skipped", 0,
+                        table, contention=None,
+                    )
+                    continue
+            if on_tpu:
+                os.environ["SKYLARK_PALLAS_SCATTER"] = setting
+            try:
+                per = _rep_diff(build, (data, idx), r1=1, r2=3, rounds=8)
+            except Exception as e:  # noqa: BLE001 — forced kernel may
+                # not lower on this generation; report, keep the pair
+                _emit(
+                    f"{label} (FAILED: {type(e).__name__})", -1, "error",
+                    0, table, contention=None,
+                )
+                continue
+            _emit(
+                label,
+                per * 1e3,
+                "ms",
+                357.0 / (per * 1e3) if on_tpu else 1.0,
+                table,
+            )
+            if not on_tpu:
+                break  # CPU smoke: one row, no kernel path to compare
+    finally:
+        if prev is None:
+            os.environ.pop("SKYLARK_PALLAS_SCATTER", None)
+        else:
+            os.environ["SKYLARK_PALLAS_SCATTER"] = prev
 
 
 def bench_streaming_krr(on_tpu, table):
