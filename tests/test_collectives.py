@@ -230,6 +230,64 @@ class TestSparseOutSchedules:
             rtol=1e-5, atol=1e-5,
         )
 
+    def test_property_sweep_random_configs(self, rng):
+        """Randomized property sweep: shapes, densities, sketch types,
+        and capacity choices drawn per round; parity vs the local BCOO
+        apply must hold for every draw (edge shards, hot buckets, and
+        sparse corners appear naturally across draws)."""
+        from libskylark_tpu.parallel import (
+            columnwise_sharded_sparse_out,
+            suggest_sparse_out_capacity,
+        )
+
+        mesh = default_mesh()
+        p = mesh.size
+        for trial in range(6):
+            n = p * int(rng.integers(2, 9))
+            m = int(rng.integers(1, 14))
+            s = p * int(rng.integers(1, 7))
+            density = float(rng.uniform(0.05, 0.9))
+            cls, kw = [(CWT, {}), (SJLT, {"nnz": 2}), (WZT, {})][trial % 3]
+            S = cls(n, s, SketchContext(seed=100 + trial), **kw)
+            A, _ = _random_bcoo(rng, (n, m), density=density)
+            if trial % 2 == 0:
+                # Half the trials run f32: the bitcast single-exchange
+                # lane of _exchange_entries is otherwise invisible under
+                # the suite's forced x64 (the known f32-parity trap).
+                from jax.experimental import sparse as jsparse
+
+                A = jsparse.BCOO(
+                    (A.data.astype(jnp.float32), A.indices), shape=A.shape
+                )
+            cap = (
+                None if trial % 2
+                else suggest_sparse_out_capacity(S, A, mesh)
+            )
+            out = columnwise_sharded_sparse_out(S, A, mesh, capacity=cap)
+            ref = S.apply(A, "columnwise")
+            np.testing.assert_allclose(
+                np.asarray(out.todense()), np.asarray(ref.todense()),
+                rtol=1e-5, atol=1e-5,
+                err_msg=f"trial={trial} n={n} m={m} s={s} "
+                        f"density={density:.2f} cap={cap}",
+            )
+
+    def test_empty_matrix(self, rng):
+        """nse=0 input: all shards hold only padding; the result is the
+        all-zero sketch (and to_bcoo's empty-keep path)."""
+        from jax.experimental import sparse as jsparse
+
+        from libskylark_tpu.parallel import columnwise_sharded_sparse_out
+
+        mesh = default_mesh()
+        n, s, m = 32, 16, 4
+        A = jsparse.BCOO.fromdense(jnp.zeros((n, m), jnp.float32), nse=1)
+        S = CWT(n, s, SketchContext(seed=48))
+        out = columnwise_sharded_sparse_out(S, A, mesh)
+        np.testing.assert_array_equal(
+            np.asarray(out.todense()), np.zeros((s, m), np.float32)
+        )
+
     def test_2d_grid_needs_2d_mesh(self, rng):
         from libskylark_tpu.parallel import (
             columnwise_sharded_sparse_out_2d,
