@@ -439,6 +439,57 @@ class ShardedBCOO:
     def todense(self):
         return self.to_bcoo().todense()
 
+    def sketch_columnwise(self, S2, dense_output: bool = True,
+                          scatter: bool = False,
+                          capacity: int | None = None):
+        """Apply a second sketch to this sharded sparse result WITHOUT
+        leaving the device: the per-shard (data, local-row, col) arrays
+        are exactly the row-block-split input of the sharded columnwise
+        programs, so chaining S2·(S1·A) costs no host exit, no gather,
+        and no densification in between (≙ the reference chaining
+        sketches on SpParMat, e.g. sketch-and-solve pipelines over
+        CombBLAS matrices).  Duplicate entries are fine — hashing is
+        linear in entries.
+
+        ``dense_output=True`` runs the dense-merge schedule (one psum;
+        ``scatter`` leaves rows sharded); ``False`` runs the sparse-out
+        exchange and returns another :class:`ShardedBCOO`."""
+        if self.col_block is not None:
+            raise ValueError(
+                "chaining from a 2-D grid result is not supported — "
+                "its column indices are block-local; gather via "
+                "to_bcoo() first"
+            )
+        p = self.mesh.size
+        n2, m2 = self.shape
+        if S2.n != n2:
+            raise ValueError(
+                f"columnwise chain needs S2.n == {n2}, got {S2.n}"
+            )
+        if n2 >= (1 << 32):
+            raise ValueError(f"sparse schedules support N < 2^32, got {n2}")
+        if (scatter or not dense_output) and S2.s % p:
+            # Same precondition the non-chained entry points enforce —
+            # without it the failure is an opaque reduce_scatter
+            # lowering error instead of this message.
+            raise ValueError(
+                f"chain needs S={S2.s} divisible by mesh size {p} "
+                "(sharded output rows)"
+            )
+        if dense_output:
+            return _columnwise_sparse_program(
+                S2, m2, self.row_block, self.mesh, scatter
+            )(self.data, self.rows, self.cols)
+        cap = (
+            S2.nnz * self.data.shape[1] if capacity is None else int(capacity)
+        )
+        dv, rv, cv = _columnwise_sparse_out_program(
+            S2, self.row_block, S2.s // p, cap, self.mesh
+        )(self.data, self.rows, self.cols)
+        return ShardedBCOO(
+            dv, rv, cv, (S2.s, m2), S2.s // p, self.mesh
+        )
+
 
 def columnwise_sharded_sparse_out(S, A, mesh: Mesh, capacity: int | None = None):
     """BCOO A (N, m) -> BCOO S·A (S, m), output ROW-BLOCK-SHARDED and

@@ -288,6 +288,51 @@ class TestSparseOutSchedules:
             np.asarray(out.todense()), np.zeros((s, m), np.float32)
         )
 
+    def test_chain_device_resident(self, rng):
+        """S2·(S1·A) chained on-device: the sharded result's per-shard
+        entry arrays feed the next schedule directly — no host exit, no
+        densification in between.  Both the dense-merge and sparse-out
+        second hops must match the local chain (duplicates are fine:
+        hashing is linear in entries)."""
+        from libskylark_tpu.parallel import columnwise_sharded_sparse_out
+
+        mesh = default_mesh()
+        n, m, s1, s2 = 64, 10, 40, 16
+        S1 = CWT(n, s1, SketchContext(seed=71))
+        S2 = SJLT(s1, s2, SketchContext(seed=72), nnz=2)
+        A, _ = _random_bcoo(rng, (n, m), density=0.3)
+        mid = columnwise_sharded_sparse_out(S1, A, mesh)
+        ref = np.asarray(
+            S2.apply(S1.apply(A, "columnwise"), "columnwise").todense()
+        )
+        dense_chain = mid.sketch_columnwise(S2, dense_output=True)
+        np.testing.assert_allclose(
+            np.asarray(dense_chain), ref, rtol=1e-5, atol=1e-5
+        )
+        sparse_chain = mid.sketch_columnwise(S2, dense_output=False)
+        np.testing.assert_allclose(
+            np.asarray(sparse_chain.todense()), ref, rtol=1e-5, atol=1e-5
+        )
+        # Validation: wrong inner dimension, non-divisible scatter, and
+        # 2-D-grid sources all raise cleanly.
+        with pytest.raises(ValueError, match="S2.n"):
+            mid.sketch_columnwise(CWT(s1 + 8, 8, SketchContext(seed=73)))
+        with pytest.raises(ValueError, match="divisible"):
+            mid.sketch_columnwise(
+                CWT(s1, 12, SketchContext(seed=74)), scatter=True
+            )
+        from libskylark_tpu.parallel import (
+            columnwise_sharded_sparse_out_2d,
+            make_mesh,
+        )
+
+        grid = make_mesh((4, 2), ("r", "c"))
+        mid2d = columnwise_sharded_sparse_out_2d(
+            CWT(n, 16, SketchContext(seed=75)), A, grid
+        )
+        with pytest.raises(ValueError, match="2-D grid"):
+            mid2d.sketch_columnwise(CWT(16, 8, SketchContext(seed=76)))
+
     def test_2d_grid_needs_2d_mesh(self, rng):
         from libskylark_tpu.parallel import (
             columnwise_sharded_sparse_out_2d,
