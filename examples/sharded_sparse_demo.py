@@ -62,6 +62,12 @@ def main():
         f" to_bcoo nse={out_sp.to_bcoo().nse})"
     )
 
+    S2 = sky.sketch.CWT(s, 64, sky.SketchContext(seed=2027))
+    chained = out_sp.sketch_columnwise(S2, dense_output=True)
+    ref2 = np.asarray(S2.apply(S.apply(A, "columnwise"), "columnwise").todense())
+    np.testing.assert_allclose(np.asarray(chained), ref2, rtol=1e-5, atol=1e-5)
+    print(f"2b. device-resident chain S2·(S1·A): OK {chained.shape}")
+
     # default_mesh() is already a near-square 2-axis grid over all
     # devices; odd device counts or non-dividing shapes skip with the
     # library's own error rather than crashing mid-demo.
