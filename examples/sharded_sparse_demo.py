@@ -64,7 +64,7 @@ def main():
 
     S2 = sky.sketch.CWT(s, 64, sky.SketchContext(seed=2027))
     chained = out_sp.sketch_columnwise(S2, dense_output=True)
-    ref2 = np.asarray(S2.apply(S.apply(A, "columnwise"), "columnwise").todense())
+    ref2 = np.asarray(S2.apply(ref, "columnwise").todense())
     np.testing.assert_allclose(np.asarray(chained), ref2, rtol=1e-5, atol=1e-5)
     print(f"2b. device-resident chain S2·(S1·A): OK {chained.shape}")
 

@@ -77,8 +77,11 @@ def _sampled_kernel_compiles(
                     jnp.max(jnp.abs(out.astype(jnp.float32) - ref))
                 )
                 scale = float(jnp.max(jnp.abs(ref))) or 1.0
+            # f32 threshold matches the hardware guard's 1e-5 bar (the
+            # fused and two-step paths run identical ops modulo the
+            # scale-multiply order, so real error is ~1 ulp).
             ok = err < 1e-2 * scale if dtype == jnp.bfloat16 else (
-                err < 1e-4 * scale
+                err < 1e-5 * scale
             )
             _SAMPLED_KERNEL_OK[key] = ok
             if not ok:
