@@ -10,7 +10,7 @@ collectives instead of MPI.
 
 __version__ = "0.3.0"
 
-from . import core, graph, io, linalg, ml, parallel, sketch, solvers, utils
+from . import core, graph, io, linalg, ml, parallel, resilient, sketch, solvers, utils
 from .core import SketchContext
 
 __all__ = [
@@ -20,6 +20,7 @@ __all__ = [
     "linalg",
     "ml",
     "parallel",
+    "resilient",
     "sketch",
     "solvers",
     "utils",

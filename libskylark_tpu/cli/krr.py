@@ -46,6 +46,15 @@ def main(argv=None) -> int:
                    help="train/test container (hdf5 via "
                         "skylark-convert2hdf5 or the reference layout)")
     p.add_argument("--x64", action="store_true")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="directory for solver checkpoints; enables "
+                        "preemption-safe chunked execution of the "
+                        "iterative (-a 1) path")
+    p.add_argument("--checkpoint-every", type=int, default=25,
+                   help="solver iterations per checkpoint round")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the newest valid checkpoint in "
+                        "--checkpoint-dir")
     args = p.parse_args(argv)
 
     import jax
@@ -79,7 +88,14 @@ def main(argv=None) -> int:
         use_fast=args.use_fast,
         tolerance=args.tolerance,
         max_split=args.max_split,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        resume=args.resume,
     )
+    if args.checkpoint_dir and args.algorithm != 1:
+        print("warning: --checkpoint-dir applies to the iterative "
+              "solver (-a 1); other algorithms run unchunked",
+              file=sys.stderr)
 
     Xj = X if is_sparse else jnp.asarray(X)
     t0 = time.perf_counter()

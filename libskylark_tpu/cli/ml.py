@@ -52,6 +52,14 @@ def main(argv=None) -> int:
                         "memory; one prediction per line)")
     p.add_argument("--batch", type=int, default=4096,
                    help="streaming predict batch size")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="directory for ADMM checkpoints; enables "
+                        "preemption-safe chunked training")
+    p.add_argument("--checkpoint-every", type=int, default=5,
+                   help="ADMM iterations per checkpoint round")
+    p.add_argument("--resume", action="store_true",
+                   help="resume training from the newest valid checkpoint "
+                        "in --checkpoint-dir")
     args = p.parse_args(argv)
 
     import jax
@@ -111,13 +119,38 @@ def main(argv=None) -> int:
                 args.valfile, args.fileformat, args.sparse, n_features=d
             )
         t0 = time.perf_counter()
-        model = solver.train(
-            np.asarray(X) if not is_sparse else X,
-            y,
-            regression=args.regression,
-            Xv=Xv,
-            Yv=Yv,
-        )
+        if args.checkpoint_dir:
+            # Preemption-safe path: host rounds of --checkpoint-every ADMM
+            # iterations, a rotated CRC-guarded checkpoint after each.
+            # Per-iteration validation scoring is a train()-only feature.
+            from ..resilient import ResilientParams, ResilientRunner
+
+            if args.valfile:
+                print("warning: --valfile is ignored under "
+                      "--checkpoint-dir (score the saved model instead)",
+                      file=sys.stderr)
+            model = ResilientRunner(
+                solver.chunked(
+                    np.asarray(X) if not is_sparse else X,
+                    y,
+                    regression=args.regression,
+                ),
+                ResilientParams(
+                    am_i_printing=True,
+                    log_level=1,
+                    checkpoint_dir=args.checkpoint_dir,
+                    checkpoint_every=args.checkpoint_every,
+                    resume=args.resume,
+                ),
+            ).run()
+        else:
+            model = solver.train(
+                np.asarray(X) if not is_sparse else X,
+                y,
+                regression=args.regression,
+                Xv=Xv,
+                Yv=Yv,
+            )
         print(f"Training took {time.perf_counter() - t0:.3f} sec; "
               f"final objective {model.history[-1]:.6e}")
         # The model JSON embeds the label coding (≙ get_column_coding).

@@ -24,6 +24,7 @@ from .least_squares import (
 from .svd import (
     SVDParams,
     approximate_svd,
+    approximate_svd_chunked,
     approximate_symmetric_svd,
     power_iteration,
     streaming_approximate_svd,
@@ -33,6 +34,7 @@ from .svd import (
 __all__ = [
     "SVDParams",
     "approximate_svd",
+    "approximate_svd_chunked",
     "approximate_symmetric_svd",
     "power_iteration",
     "streaming_approximate_svd",

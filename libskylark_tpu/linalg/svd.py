@@ -17,6 +17,7 @@ TPU design notes:
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +27,7 @@ from ..core.context import SketchContext
 from ..core.matrices import gaussian_matrix
 from ..core.params import Params
 from ..parallel.mesh import fully_replicated
+from ..resilient.chunked import ChunkedSolver
 from ..sketch.base import Dimension
 from ..sketch.dense import JLT
 
@@ -33,6 +35,7 @@ __all__ = [
     "SVDParams",
     "power_iteration",
     "approximate_svd",
+    "approximate_svd_chunked",
     "approximate_symmetric_svd",
     "streaming_approximate_svd",
     "synthetic_lowrank_blocks",
@@ -109,6 +112,86 @@ def power_iteration(A, Q, num_iterations: int, orthogonalize: bool = True):
     return lax.fori_loop(0, num_iterations, body, Q)
 
 
+def approximate_svd_chunked(
+    A,
+    rank: int,
+    context: SketchContext,
+    params: SVDParams | None = None,
+) -> ChunkedSolver:
+    """Chunkable randomized SVD: the power-iteration sweeps (the long part
+    for ``num_iterations > 0``) run as jitted ≤ k-step segments whose state
+    (iteration counter + current basis Y) checkpoints between chunks; the
+    sketch in ``init_state`` is counter-based (JLT), so a resumed process
+    rebuilds the identical test matrix and the resumed run is bit-identical
+    to the uninterrupted chunked run.  ``extract_result`` performs the
+    trailing QR → small SVD → truncate of :func:`approximate_svd`.
+    """
+    params = params or SVDParams()
+    if not hasattr(A, "todense"):  # keep BCOO sparse inputs as-is
+        A = jnp.asarray(A)
+    m, n = A.shape
+    k, s = _sketch_size(rank, params, n, m)
+    niter = max(params.num_iterations, 0)
+    orthogonalize = not params.skip_qr
+
+    def init_state():
+        # Q = A·Omegaᵀ — rowwise JLT sketch (nla/svd.hpp:255-257).
+        omega = JLT(n, s, context)
+        return dict(
+            it=jnp.zeros((), jnp.int32),
+            Y=omega.apply(A, Dimension.ROWWISE),
+        )
+
+    # A enters as an ARGUMENT (dense array or BCOO pytree) so jit
+    # references a device buffer instead of baking A into the program.
+    @partial(jax.jit, static_argnames=("num_iters",))
+    def _chunk(st, A, num_iters: int):
+        stop = jnp.minimum(st["it"] + num_iters, niter)
+
+        def cond(c):
+            return c["it"] < stop
+
+        def body(c):
+            Y = A @ (A.T @ c["Y"])
+            return dict(it=c["it"] + 1, Y=_orth(Y) if orthogonalize else Y)
+
+        return lax.while_loop(cond, body, st)
+
+    def step_chunk(st, num_iters: int):
+        return _chunk(st, A, num_iters)
+
+    def extract_result(st):
+        Y = st["Y"]
+        # The power-iteration body already ends orthonormalized unless
+        # skip_qr, so only orthonormalize here when the loop didn't.
+        Q = Y if (niter > 0 and orthogonalize) else _orth(Y)
+
+        # B = Aᵀ·Q (n, s); small SVD; rotate back (nla/svd.hpp:266-285).
+        # Both products pinned: the MXU default would put ~2e-3 (bf16)
+        # error into the singular values (via B) and U's orthogonality
+        # (via the rotation) on hardware.  The power-iteration sweeps keep
+        # the fast default — they only steer the subspace.
+        # (BCOO has no precision knob and does not ride the MXU bf16 path —
+        # its matmul keeps the sparse dispatch.)
+        AtQ = A.T @ Q if hasattr(A, "todense") else jnp.dot(
+            A.T, Q, precision="highest"
+        )
+        B = fully_replicated(AtQ)
+        W, sv, Zt = jnp.linalg.svd(B, full_matrices=False)  # B = W·sv·Zt
+        # A ≈ Q·Bᵀ = (Q·Ztᵀ)·diag(sv)·Wᵀ
+        U = jnp.dot(Q, Zt.T, precision="highest")
+        return U[:, :k], sv[:k], W[:, :k]
+
+    return ChunkedSolver(
+        init_state=init_state,
+        step_chunk=step_chunk,
+        extract_result=extract_result,
+        is_done=lambda st: int(st["it"]) >= niter,
+        iteration=lambda st: int(st["it"]),
+        kind="approximate_svd",
+    )
+
+
 def approximate_svd(
     A,
     rank: int,
@@ -119,39 +202,13 @@ def approximate_svd(
     ``A ≈ U @ diag(s) @ V.T``, U: (m, rank), V: (n, rank).
 
     ≙ ``ApproximateSVD`` (``nla/svd.hpp:222-318``): JLT sketch of the row
-    space → power iteration → QR → small SVD → truncate.
+    space → power iteration → QR → small SVD → truncate.  One chunk of the
+    full sweep budget through :func:`approximate_svd_chunked`.
     """
     params = params or SVDParams()
-    if not hasattr(A, "todense"):  # keep BCOO sparse inputs as-is
-        A = jnp.asarray(A)
-    m, n = A.shape
-    k, s = _sketch_size(rank, params, n, m)
-
-    # Q = A·Omegaᵀ — rowwise JLT sketch (nla/svd.hpp:255-257).
-    omega = JLT(n, s, context)
-    Y = omega.apply(A, Dimension.ROWWISE)
-
-    # Power iteration on the sketched basis (nla/svd.hpp:260);
-    # its body already ends orthonormalized unless skip_qr, so only
-    # orthonormalize here when the loop didn't.
-    Y = power_iteration(A, Y, params.num_iterations, not params.skip_qr)
-    Q = Y if (params.num_iterations > 0 and not params.skip_qr) else _orth(Y)
-
-    # B = Aᵀ·Q (n, s); small SVD; rotate back (nla/svd.hpp:266-285).
-    # Both products pinned: the MXU default would put ~2e-3 (bf16) error
-    # into the singular values (via B) and U's orthogonality (via the
-    # rotation) on hardware.  The power-iteration sweep above keeps the
-    # fast default — it only steers the subspace.
-    # (BCOO has no precision knob and does not ride the MXU bf16 path —
-    # its matmul keeps the sparse dispatch.)
-    AtQ = A.T @ Q if hasattr(A, "todense") else jnp.dot(
-        A.T, Q, precision="highest"
-    )
-    B = fully_replicated(AtQ)
-    W, sv, Zt = jnp.linalg.svd(B, full_matrices=False)  # B = W·sv·Zt
-    # A ≈ Q·Bᵀ = (Q·Ztᵀ)·diag(sv)·Wᵀ
-    U = jnp.dot(Q, Zt.T, precision="highest")
-    return U[:, :k], sv[:k], W[:, :k]
+    sol = approximate_svd_chunked(A, rank, context, params)
+    st = sol.step_chunk(sol.init_state(), max(params.num_iterations, 1))
+    return sol.extract_result(st)
 
 
 def approximate_symmetric_svd(

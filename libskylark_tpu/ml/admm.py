@@ -32,14 +32,17 @@ iteration, ``BlockADMM.hpp:375``).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Sequence
+from functools import partial
+from typing import Any, Callable, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.scipy.linalg import solve_triangular
 
 from ..core.params import Params
+from ..resilient.chunked import ChunkedSolver
 from ..sketch.base import Dimension
 from ..solvers.prox import get_loss, get_regularizer
 from ..utils.timer import PhaseTimer
@@ -47,6 +50,25 @@ from .coding import dummy_coding
 from .model import FeatureMapModel
 
 __all__ = ["ADMMParams", "BlockADMMSolver"]
+
+
+@dataclass
+class _PreparedRun:
+    """Everything ``train``/``chunked`` need that is NOT checkpointable
+    state: the realized feature blocks, cached Cholesky factors, targets,
+    the jittable step function, and the initial state tuple.  All of it is
+    deterministically rebuilt from (X, Y, maps, params) on resume — only
+    the state tuple rides the checkpoint."""
+
+    Zs: list
+    Ls: list
+    Yp: Any
+    state0: tuple
+    step: Callable
+    timer: PhaseTimer
+    d: int
+    classes: Any
+    dtype: Any
 
 
 @dataclass
@@ -84,14 +106,10 @@ class BlockADMMSolver:
             Z = Z * jnp.asarray(np.sqrt(S.s / d), Z.dtype)
         return Z
 
-    def train(self, X, Y, classes=None, regression: bool = False,
-              Xv=None, Yv=None):
-        """X (n, d); Y (n,) labels (classification) or (n,)/(n, t) targets
-        (regression).  Optional validation set (Xv, Yv) is scored every
-        iteration (≙ the per-iteration validation predict,
-        ``BlockADMM.hpp:509-540``) into ``model.val_history``.  Returns a
-        ``FeatureMapModel`` (with ``.classes`` and ``.history`` attached).
-        BCOO input is densified (the partitioned reshape needs strides)."""
+    def _prepare(self, X, Y, classes=None, regression: bool = False) -> _PreparedRun:
+        """Shared setup for :meth:`train` and :meth:`chunked`: realize the
+        feature blocks, cache the Cholesky factors, build the jittable
+        per-iteration step and the initial state tuple."""
         p = self.params
         X = X.todense() if hasattr(X, "todense") else jnp.asarray(X)
         n, d = X.shape
@@ -218,6 +236,24 @@ class BlockADMMSolver:
             jnp.zeros((P, D, k), dtype),     # ZtObar_ij
             jnp.zeros((), dtype),            # obj
         )
+        return _PreparedRun(
+            Zs=Zs, Ls=Ls, Yp=Yp, state0=state, step=step, timer=timer,
+            d=d, classes=classes, dtype=dtype,
+        )
+
+    def train(self, X, Y, classes=None, regression: bool = False,
+              Xv=None, Yv=None):
+        """X (n, d); Y (n,) labels (classification) or (n,)/(n, t) targets
+        (regression).  Optional validation set (Xv, Yv) is scored every
+        iteration (≙ the per-iteration validation predict,
+        ``BlockADMM.hpp:509-540``) into ``model.val_history``.  Returns a
+        ``FeatureMapModel`` (with ``.classes`` and ``.history`` attached).
+        BCOO input is densified (the partitioned reshape needs strides)."""
+        p = self.params
+        run = self._prepare(X, Y, classes, regression)
+        Zs, Ls, Yp = run.Zs, run.Ls, run.Yp
+        state, step, timer = run.state0, run.step, run.timer
+        d, classes = run.d, run.classes
         have_val = Xv is not None and Yv is not None
         if have_val:
             Xv = Xv.todense() if hasattr(Xv, "todense") else jnp.asarray(Xv)
@@ -280,3 +316,69 @@ class BlockADMMSolver:
         model.val_history = val_history
         model.timers = timer
         return model
+
+    def chunked(self, X, Y, classes=None, regression: bool = False) -> ChunkedSolver:
+        """Preemption-safe ADMM: a ``ChunkedSolver`` whose state pytree is
+        (iteration counter, the 10-tuple ADMM state, objective trace) —
+        exactly what a resumed process cannot recompute.  The feature
+        blocks, Cholesky factors, and targets are rebuilt by
+        :meth:`_prepare` on resume (deterministic: counter-based maps,
+        pinned-precision factor products), so a run resumed from a chunk
+        boundary is bit-identical to the uninterrupted chunked run.
+
+        Validation scoring is a ``train``-only feature; drive this with
+        ``resilient.ResilientRunner`` and score the returned model.
+        """
+        p = self.params
+        run = self._prepare(X, Y, classes, regression)
+        maxiter = int(p.maxiter)
+
+        def init_state():
+            return dict(
+                it=jnp.zeros((), jnp.int32),
+                inner=run.state0,
+                objs=jnp.zeros((maxiter,), run.dtype),
+            )
+
+        # Zs/Ls/Yp enter as ARGUMENTS for the same reason as in train():
+        # jit would bake closed-over device arrays into the program as
+        # constants.
+        @partial(jax.jit, static_argnames=("num_iters",))
+        def _chunk(st, Zs, Ls, Yp, num_iters: int):
+            stop = jnp.minimum(st["it"] + num_iters, maxiter)
+
+            def cond(c):
+                return c["it"] < stop
+
+            def body(c):
+                inner = run.step(c["inner"], Zs, Ls, Yp)
+                return dict(
+                    it=c["it"] + 1,
+                    inner=inner,
+                    objs=c["objs"].at[c["it"]].set(inner[-1]),
+                )
+
+            return lax.while_loop(cond, body, st)
+
+        def step_chunk(st, num_iters: int):
+            return _chunk(st, run.Zs, run.Ls, run.Yp, num_iters)
+
+        def extract_result(st):
+            it = int(st["it"])
+            model = FeatureMapModel(
+                self.maps, st["inner"][0], scale_maps=p.scale_maps,
+                input_dim=run.d, classes=run.classes,
+            )
+            model.history = [float(o) for o in np.asarray(st["objs"][:it])]
+            model.val_history = []
+            model.timers = run.timer
+            return model
+
+        return ChunkedSolver(
+            init_state=init_state,
+            step_chunk=step_chunk,
+            extract_result=extract_result,
+            is_done=lambda st: int(st["it"]) >= maxiter,
+            iteration=lambda st: int(st["it"]),
+            kind="block_admm",
+        )

@@ -62,6 +62,11 @@ class KrrParams(Params):
     res_print: int = 10
     iter_lim: int = 1000
     max_split: int = 0              # feature chunk size (large-scale)
+    # Preemption safety (resilient.ResilientRunner over the CG path; no
+    # reference counterpart — the reference is MPI fail-stop):
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 25      # CG iterations per checkpoint round
+    resume: bool = False
 
 
 def _psd_gram(A, B):
@@ -206,14 +211,27 @@ def faster_kernel_ridge(
     n = K.shape[0]
     Kl = K + lam * jnp.eye(n, dtype=K.dtype)
     P = _FeatureMapPrecond(kernel, lam, X, s, context, params)
-    A, info = cg(
-        Kl,
-        Y2,
-        precond=P,
-        params=KrylovParams(
-            tolerance=params.tolerance, iter_lim=params.iter_lim
-        ),
-    )
+    kp = KrylovParams(tolerance=params.tolerance, iter_lim=params.iter_lim)
+    if params.checkpoint_dir:
+        # Preemption-safe CG: everything outside the CG state (Gram,
+        # preconditioner) is deterministically rebuilt from (X, context)
+        # on resume, so only the Krylov carry rides the checkpoint.
+        from ..resilient import ResilientParams, ResilientRunner
+        from ..solvers.krylov import cg_chunked
+
+        A, info = ResilientRunner(
+            cg_chunked(Kl, Y2, precond=P, params=kp),
+            ResilientParams(
+                am_i_printing=params.am_i_printing,
+                log_level=params.log_level,
+                prefix=params.prefix,
+                checkpoint_dir=params.checkpoint_dir,
+                checkpoint_every=params.checkpoint_every,
+                resume=params.resume,
+            ),
+        ).run()
+    else:
+        A, info = cg(Kl, Y2, precond=P, params=kp)
     model = KernelModel(kernel, X, A)
     model.info = info
     return model

@@ -1,0 +1,24 @@
+"""Preemption-safe solver runtime (no reference counterpart — SURVEY §5
+notes the MPI fail-stop model; this subsystem is the TPU-production answer).
+
+- ``chunked``: the ``ChunkedSolver`` contract (init_state / step_chunk /
+  extract_result) that krylov / ADMM / randomized-SVD expose
+- ``runner``: ``ResilientRunner`` — host rounds of K device iterations with
+  rotated CRC-guarded checkpoints, resume, retry, divergence guards
+- ``faults``: deterministic fault injection (preemption, corruption,
+  transient IO) + ``with_retries`` backoff
+"""
+
+from .chunked import ChunkedSolver
+from .faults import FaultPlan, SimulatedPreemption, corrupt_checkpoint, with_retries
+from .runner import ResilientParams, ResilientRunner
+
+__all__ = [
+    "ChunkedSolver",
+    "ResilientParams",
+    "ResilientRunner",
+    "FaultPlan",
+    "SimulatedPreemption",
+    "corrupt_checkpoint",
+    "with_retries",
+]

@@ -1,0 +1,51 @@
+"""The chunked-execution contract between solvers and the runner.
+
+A preemption-safe solve is a host-level loop over *chunks* of K device
+iterations: each chunk is one jitted ``lax.while_loop``/``fori_loop``
+segment whose carry pytree is exported back to the host, so a checkpoint
+can be committed between chunks without breaking jit.  Solvers expose this
+by returning a :class:`ChunkedSolver` from a ``*_chunked`` factory
+(``solvers.krylov.lsqr_chunked``, ``ml.BlockADMMSolver.chunked``,
+``linalg.approximate_svd_chunked``); the one-shot APIs are thin wrappers
+that run a single chunk of the full iteration budget.
+
+The contract the callables must satisfy for resume to be *bit-for-bit*:
+
+- ``init_state()`` is deterministic given the factory's inputs (counter-
+  based RNG, no wall-clock, no fresh PRNG keys), so a resumed process can
+  rebuild everything that is NOT in the checkpoint (operators, cached
+  factors) identically.
+- ``step_chunk(state, k)`` advances AT MOST k device iterations and is a
+  pure function of ``state`` — running chunks ``[0,k), [k,2k), ...`` in one
+  process gives bit-identical state to running ``[0,k)`` in one process and
+  ``[k,2k), ...`` in another that loaded the chunk-1 checkpoint.
+- ``state`` is a pytree of arrays (checkpointable by
+  ``utils.save_solver_state``); anything non-array lives in the factory
+  closure and is rebuilt on resume.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+__all__ = ["ChunkedSolver"]
+
+
+@dataclass
+class ChunkedSolver:
+    """Host-driveable solver: state-out/state-in chunks of device work.
+
+    ``iteration``/``is_done`` read the state's on-device counters (one
+    scalar host sync each — the price of a checkpointable boundary, paid
+    once per chunk rather than once per iteration).
+    """
+
+    init_state: Callable[[], Any]
+    step_chunk: Callable[[Any, int], Any]
+    extract_result: Callable[[Any], Any]
+    is_done: Callable[[Any], bool]
+    iteration: Callable[[Any], int]
+    #: stable tag recorded in checkpoint metadata; a resume refuses to load
+    #: a checkpoint written by a different solver kind.
+    kind: str = "chunked_solver"

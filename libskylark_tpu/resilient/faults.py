@@ -1,0 +1,120 @@
+"""Fault injection + retry machinery for the resilient runner.
+
+The reference's answer to faults is MPI fail-stop: any failure kills the
+world.  A production TPU job instead sees three recoverable fault classes,
+each of which this module can *inject* deterministically so the recovery
+paths are exercised on every PR (tests/test_resilient.py, ``faults``
+marker):
+
+- **Preemption**: the process dies at an arbitrary point.  Simulated at a
+  chunk boundary (the only place the runner's recovery guarantee applies)
+  by raising :class:`SimulatedPreemption` from the plan's hook.
+- **Checkpoint corruption**: storage flips bits at rest.
+  :func:`corrupt_checkpoint` damages a committed file in place; recovery is
+  the store's newest-valid fallback.
+- **Transient IO errors**: flaky filesystem/network during a save.
+  Injected as ``OSError`` on the first attempts of a save; recovery is
+  :func:`with_retries` exponential backoff.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+__all__ = [
+    "SimulatedPreemption",
+    "FaultPlan",
+    "corrupt_checkpoint",
+    "with_retries",
+]
+
+
+class SimulatedPreemption(RuntimeError):
+    """Stands in for the process being killed: raised from a fault-plan
+    hook, it unwinds the runner exactly as a preemption would leave it —
+    committed checkpoints on disk, nothing else."""
+
+
+def corrupt_checkpoint(path, nbytes: int = 64, offset: int | None = None):
+    """Flip ``nbytes`` bytes of a committed checkpoint file in place.
+
+    ``path`` is the ``.npz`` file (as returned by ``CheckpointStore.save``).
+    Default offset targets the middle of the file — inside the zip members,
+    so either a leaf CRC or the container itself fails validation.
+    """
+    size = os.path.getsize(path)
+    if offset is None:
+        offset = size // 2
+    nbytes = min(nbytes, size - offset)
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        chunk = f.read(nbytes)
+        f.seek(offset)
+        f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+def with_retries(
+    fn,
+    retries: int = 3,
+    backoff: float = 0.05,
+    exceptions=(OSError,),
+    sleep=time.sleep,
+):
+    """Call ``fn()`` with exponential backoff: up to ``retries`` additional
+    attempts after the first, sleeping ``backoff * 2**attempt`` between.
+    ``sleep`` is injectable so tests don't wait out the backoff."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except exceptions:
+            if attempt >= retries:
+                raise
+            sleep(backoff * (2**attempt))
+            attempt += 1
+
+
+@dataclass
+class FaultPlan:
+    """Deterministic fault schedule, keyed by chunk index (0-based, counted
+    from the start of *this process*, so a resumed run has its own chunk 0).
+
+    - ``preempt_after_chunk``: raise :class:`SimulatedPreemption` right
+      after that chunk's checkpoint is committed.
+    - ``io_errors_on_save``: ``{chunk: n}`` — the first ``n`` save attempts
+      of that chunk's checkpoint raise ``OSError``.
+    - ``nan_after_chunk``: make the *solver* diverge by poisoning the state
+      the runner hands to the next chunk (the runner consults
+      :meth:`poison` — used to exercise the divergence guard end-to-end).
+    """
+
+    preempt_after_chunk: int | None = None
+    io_errors_on_save: dict = field(default_factory=dict)
+    nan_after_chunk: int | None = None
+    _save_attempts: dict = field(default_factory=dict, repr=False)
+
+    def before_save(self, chunk: int) -> None:
+        budget = self.io_errors_on_save.get(chunk, 0)
+        seen = self._save_attempts.get(chunk, 0)
+        self._save_attempts[chunk] = seen + 1
+        if seen < budget:
+            raise OSError(f"injected transient IO error (chunk {chunk}, attempt {seen})")
+
+    def after_commit(self, chunk: int) -> None:
+        if self.preempt_after_chunk is not None and chunk == self.preempt_after_chunk:
+            raise SimulatedPreemption(f"injected preemption after chunk {chunk}")
+
+    def poison(self, chunk: int, state):
+        if self.nan_after_chunk is None or chunk != self.nan_after_chunk:
+            return state
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree.map(
+            lambda l: jnp.full_like(l, jnp.nan)
+            if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+            else l,
+            state,
+        )

@@ -16,7 +16,17 @@ from .accelerated import FasterLeastSquaresParams, faster_least_squares, lsrn_le
 from .asynch import asy_fcg
 from .cond_est import CondEstParams, CondEstResult, cond_est
 from .gauss_seidel import randomized_block_gauss_seidel
-from .krylov import KrylovParams, cg, chebyshev, flexible_cg, lsqr
+from .krylov import (
+    KrylovParams,
+    cg,
+    cg_chunked,
+    chebyshev,
+    chebyshev_chunked,
+    flexible_cg,
+    flexible_cg_chunked,
+    lsqr,
+    lsqr_chunked,
+)
 from .precond import IdPrecond, MatPrecond, TriInversePrecond
 from .prox import LOSSES, REGULARIZERS, get_loss, get_regularizer
 from .regression import RegressionProblem, solve_regression
@@ -27,6 +37,10 @@ __all__ = [
     "cg",
     "flexible_cg",
     "chebyshev",
+    "lsqr_chunked",
+    "cg_chunked",
+    "flexible_cg_chunked",
+    "chebyshev_chunked",
     "IdPrecond",
     "MatPrecond",
     "TriInversePrecond",

@@ -18,6 +18,15 @@ TPU design:
 - Stopping: per-column Paige-Saunders S1/S2 tests plus the reference's
   stagnation detector (``LSQR.hpp:193-230``); the loop exits when every
   column has converged or stagnated.
+
+Preemption safety: every solver is structured as a ``*_chunked`` factory
+returning a :class:`~libskylark_tpu.resilient.chunked.ChunkedSolver` —
+``init_state()`` builds the loop carry, ``step_chunk(state, k)`` runs one
+jitted while-loop segment of ≤ k iterations, ``extract_result(state)``
+finishes.  The classic one-shot entry points (``lsqr`` etc.) run a single
+chunk of the full ``iter_lim`` budget, so they keep their exact semantics;
+``resilient.ResilientRunner`` drives the same factories in checkpointed
+host rounds.
 """
 
 from __future__ import annotations
@@ -31,9 +40,20 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..core.params import Params
+from ..resilient.chunked import ChunkedSolver
 from .precond import IdPrecond
 
-__all__ = ["KrylovParams", "lsqr", "cg", "flexible_cg", "chebyshev"]
+__all__ = [
+    "KrylovParams",
+    "lsqr",
+    "cg",
+    "flexible_cg",
+    "chebyshev",
+    "lsqr_chunked",
+    "cg_chunked",
+    "flexible_cg_chunked",
+    "chebyshev_chunked",
+]
 
 
 @dataclass
@@ -60,14 +80,36 @@ def _as2d(b):
     return (b[:, None], True) if b.ndim == 1 else (b, False)
 
 
-def lsqr(A, B, precond=None, params: KrylovParams | None = None, x0=None):
-    """Preconditioned LSQR for ``min_X ||A X - B||`` (per column).
+def _chunk_stepper(body, iter_lim: int, done_of=None):
+    """Jitted ≤ num_iters while-loop segment over carry dicts holding a
+    global ``it`` counter.  ``done_of(state)`` adds the solver's on-device
+    convergence predicate to the loop condition."""
 
-    ``precond`` is a *right* preconditioner N (≙ ``outplace_precond_t``):
-    LSQR runs on A·N and returns ``X = N·Y`` (Blendenpik/LSRN use this).
-    Returns ``(X, info)`` with ``info = {"iterations", "flag", "resid"}``;
-    flag 0 = converged, 1 = iter limit, per column 2 = stagnated.
-    """
+    @partial(jax.jit, static_argnames=("num_iters",))
+    def step_chunk(s, num_iters: int):
+        stop = jnp.minimum(s["it"] + num_iters, iter_lim)
+
+        def cond(st):
+            go = st["it"] < stop
+            if done_of is not None:
+                go = go & ~done_of(st)
+            return go
+
+        return lax.while_loop(cond, body, s)
+
+    return step_chunk
+
+
+def _one_shot(factory_state_solver, iter_lim: int):
+    sol = factory_state_solver
+    return sol.extract_result(sol.step_chunk(sol.init_state(), max(iter_lim, 0)))
+
+
+def lsqr_chunked(
+    A, B, precond=None, params: KrylovParams | None = None, x0=None
+) -> ChunkedSolver:
+    """Chunkable LSQR: state in/out per ≤ k-iteration jitted segment (see
+    :func:`lsqr` for the math and return convention of the result)."""
     params = params or KrylovParams()
     N = precond or IdPrecond()
     matvec0, rmatvec0 = _ops(A)
@@ -83,35 +125,32 @@ def lsqr(A, B, precond=None, params: KrylovParams | None = None, x0=None):
         x0 = jnp.asarray(x0)
         if x0.ndim == 1:
             x0 = x0[:, None]
-    U = B if x0 is None else B - matvec0(x0)
-    beta = _colnorm(U)
-    U = U / jnp.where(beta > 0, beta, 1)
-    V = rmatvec(U)
-    alpha = _colnorm(V)
-    V = V / jnp.where(alpha > 0, alpha, 1)
-    n = V.shape[0]
-    k = B.shape[1]
 
-    Y0 = jnp.zeros((n, k), dtype)
-    state = dict(
-        it=jnp.zeros((), jnp.int32),
-        Y=Y0,
-        U=U,
-        V=V,
-        W=V,
-        alpha=alpha,
-        beta=beta,
-        rhobar=alpha,
-        phibar=beta,
-        anorm=jnp.zeros((), dtype),
-        done=beta <= btol * _colnorm(B),
-        stag=jnp.zeros((k,), jnp.int32),
-        arnorm_best=jnp.full((k,), jnp.inf, dtype),
-        bnorm=_colnorm(B),
-    )
-
-    def cond(s):
-        return (s["it"] < params.iter_lim) & ~jnp.all(s["done"])
+    def init_state():
+        U = B if x0 is None else B - matvec0(x0)
+        beta = _colnorm(U)
+        U = U / jnp.where(beta > 0, beta, 1)
+        V = rmatvec(U)
+        alpha = _colnorm(V)
+        V = V / jnp.where(alpha > 0, alpha, 1)
+        n = V.shape[0]
+        k = B.shape[1]
+        return dict(
+            it=jnp.zeros((), jnp.int32),
+            Y=jnp.zeros((n, k), dtype),
+            U=U,
+            V=V,
+            W=V,
+            alpha=alpha,
+            beta=beta,
+            rhobar=alpha,
+            phibar=beta,
+            anorm=jnp.zeros((), dtype),
+            done=beta <= btol * _colnorm(B),
+            stag=jnp.zeros((k,), jnp.int32),
+            arnorm_best=jnp.full((k,), jnp.inf, dtype),
+            bnorm=_colnorm(B),
+        )
 
     def body(s):
         U, V, W, Y = s["U"], s["V"], s["W"], s["Y"]
@@ -170,48 +209,66 @@ def lsqr(A, B, precond=None, params: KrylovParams | None = None, x0=None):
             bnorm=s["bnorm"],
         )
 
-    s = lax.while_loop(cond, body, state)
-    X = N.apply(s["Y"])
-    if x0 is not None:
-        X = X + x0
-    info = {
-        "iterations": s["it"],
-        "flag": jnp.where(jnp.all(s["done"]), 0, 1),
-        "resid": s["phibar"],
-    }
-    return (X[:, 0] if squeeze else X), info
+    def extract_result(s):
+        X = N.apply(s["Y"])
+        if x0 is not None:
+            X = X + x0
+        info = {
+            "iterations": s["it"],
+            "flag": jnp.where(jnp.all(s["done"]), 0, 1),
+            "resid": s["phibar"],
+        }
+        return (X[:, 0] if squeeze else X), info
+
+    return ChunkedSolver(
+        init_state=init_state,
+        step_chunk=_chunk_stepper(
+            body, params.iter_lim, done_of=lambda st: jnp.all(st["done"])
+        ),
+        extract_result=extract_result,
+        is_done=lambda s: int(s["it"]) >= params.iter_lim
+        or bool(jnp.all(s["done"])),
+        iteration=lambda s: int(s["it"]),
+        kind="lsqr",
+    )
 
 
-def cg(A, B, precond=None, params: KrylovParams | None = None, x0=None):
-    """Preconditioned conjugate gradient for SPD ``A X = B`` (multi-RHS).
+def lsqr(A, B, precond=None, params: KrylovParams | None = None, x0=None):
+    """Preconditioned LSQR for ``min_X ||A X - B||`` (per column).
 
-    ≙ ``algorithms/Krylov/CG.hpp:24-150`` (with ``precond`` the outplace
-    M ≈ A⁻¹ as in ``FasterKernelRidge``'s feature-map preconditioner).
+    ``precond`` is a *right* preconditioner N (≙ ``outplace_precond_t``):
+    LSQR runs on A·N and returns ``X = N·Y`` (Blendenpik/LSRN use this).
+    Returns ``(X, info)`` with ``info = {"iterations", "flag", "resid"}``;
+    flag 0 = converged, 1 = iter limit, per column 2 = stagnated.
     """
+    params = params or KrylovParams()
+    return _one_shot(lsqr_chunked(A, B, precond, params, x0), params.iter_lim)
+
+
+def cg_chunked(
+    A, B, precond=None, params: KrylovParams | None = None, x0=None
+) -> ChunkedSolver:
+    """Chunkable preconditioned CG (see :func:`cg`)."""
     params = params or KrylovParams()
     M = precond or IdPrecond()
     matvec, _ = _ops(A)
     B, squeeze = _as2d(B)
     dtype = B.dtype
     tol = jnp.asarray(params.tolerance, dtype)
-
-    X = jnp.zeros_like(B) if x0 is None else jnp.asarray(x0).reshape(B.shape)
-    R = B - matvec(X) if x0 is not None else B
-    Z = M.apply(R)
-    P = Z
-    rz = jnp.sum(R * Z, axis=0)
     bnorm = _colnorm(B)
-    state = dict(
-        it=jnp.zeros((), jnp.int32),
-        X=X,
-        R=R,
-        P=P,
-        rz=rz,
-        done=_colnorm(R) <= tol * jnp.maximum(bnorm, 1e-30),
-    )
 
-    def cond(s):
-        return (s["it"] < params.iter_lim) & ~jnp.all(s["done"])
+    def init_state():
+        X = jnp.zeros_like(B) if x0 is None else jnp.asarray(x0).reshape(B.shape)
+        R = B - matvec(X) if x0 is not None else B
+        Z = M.apply(R)
+        return dict(
+            it=jnp.zeros((), jnp.int32),
+            X=X,
+            R=R,
+            P=Z,
+            rz=jnp.sum(R * Z, axis=0),
+            done=_colnorm(R) <= tol * jnp.maximum(bnorm, 1e-30),
+        )
 
     def body(s):
         Q = matvec(s["P"])
@@ -226,26 +283,43 @@ def cg(A, B, precond=None, params: KrylovParams | None = None, x0=None):
         done = s["done"] | (_colnorm(R) <= tol * jnp.maximum(bnorm, 1e-30))
         return dict(it=s["it"] + 1, X=X, R=R, P=P, rz=rz_new, done=done)
 
-    s = lax.while_loop(cond, body, state)
-    info = {
-        "iterations": s["it"],
-        "flag": jnp.where(jnp.all(s["done"]), 0, 1),
-        "resid": _colnorm(s["R"]),
-    }
-    return (s["X"][:, 0] if squeeze else s["X"]), info
+    def extract_result(s):
+        info = {
+            "iterations": s["it"],
+            "flag": jnp.where(jnp.all(s["done"]), 0, 1),
+            "resid": _colnorm(s["R"]),
+        }
+        return (s["X"][:, 0] if squeeze else s["X"]), info
+
+    return ChunkedSolver(
+        init_state=init_state,
+        step_chunk=_chunk_stepper(
+            body, params.iter_lim, done_of=lambda st: jnp.all(st["done"])
+        ),
+        extract_result=extract_result,
+        is_done=lambda s: int(s["it"]) >= params.iter_lim
+        or bool(jnp.all(s["done"])),
+        iteration=lambda s: int(s["it"]),
+        kind="cg",
+    )
 
 
-def flexible_cg(
-    A, B, precond=None, params: KrylovParams | None = None, memory: int = 5
-):
-    """Flexible CG: supports a *varying* preconditioner by re-orthogonalizing
-    the search direction against the last ``memory`` directions.
+def cg(A, B, precond=None, params: KrylovParams | None = None, x0=None):
+    """Preconditioned conjugate gradient for SPD ``A X = B`` (multi-RHS).
 
-    ≙ ``algorithms/Krylov/FlexibleCG.hpp:23`` (used with the inexact/
-    randomized inner preconditioners of AsyFCG, ``algorithms/asynch/
-    AsyFCG.hpp``).  ``precond`` may be a function ``(R, it) -> Z`` for
-    iteration-dependent preconditioning, or a fixed precond object.
+    ≙ ``algorithms/Krylov/CG.hpp:24-150`` (with ``precond`` the outplace
+    M ≈ A⁻¹ as in ``FasterKernelRidge``'s feature-map preconditioner).
     """
+    params = params or KrylovParams()
+    return _one_shot(cg_chunked(A, B, precond, params, x0), params.iter_lim)
+
+
+def flexible_cg_chunked(
+    A, B, precond=None, params: KrylovParams | None = None, memory: int = 5
+) -> ChunkedSolver:
+    """Chunkable FlexibleCG (see :func:`flexible_cg`).  The ring buffers of
+    past directions ride the state pytree, so a resumed run keeps the same
+    re-orthogonalization window."""
     params = params or KrylovParams()
     matvec, _ = _ops(A)
     B, squeeze = _as2d(B)
@@ -260,23 +334,19 @@ def flexible_cg(
     else:
         apply_M = lambda R, it: precond.apply(R)
 
-    # Ring buffers of past directions P and A·P, per RHS column.
-    Pbuf = jnp.zeros((memory, m, k), dtype)
-    Qbuf = jnp.zeros((memory, m, k), dtype)
-    pq = jnp.ones((memory, k), dtype)  # pᵀAp normalizers (1 avoids 0-div)
     bnorm = _colnorm(B)
-    state = dict(
-        it=jnp.zeros((), jnp.int32),
-        X=jnp.zeros_like(B),
-        R=B,
-        Pbuf=Pbuf,
-        Qbuf=Qbuf,
-        pq=pq,
-        done=bnorm <= tol,
-    )
 
-    def cond(s):
-        return (s["it"] < params.iter_lim) & ~jnp.all(s["done"])
+    def init_state():
+        return dict(
+            it=jnp.zeros((), jnp.int32),
+            X=jnp.zeros_like(B),
+            R=B,
+            # Ring buffers of past directions P and A·P, per RHS column.
+            Pbuf=jnp.zeros((memory, m, k), dtype),
+            Qbuf=jnp.zeros((memory, m, k), dtype),
+            pq=jnp.ones((memory, k), dtype),  # pᵀAp normalizers (1 avoids 0-div)
+            done=bnorm <= tol,
+        )
 
     def body(s):
         Z = apply_M(s["R"], s["it"])
@@ -298,13 +368,94 @@ def flexible_cg(
             it=s["it"] + 1, X=X, R=R, Pbuf=Pbuf, Qbuf=Qbuf, pq=pq, done=done
         )
 
-    s = lax.while_loop(cond, body, state)
-    info = {
-        "iterations": s["it"],
-        "flag": jnp.where(jnp.all(s["done"]), 0, 1),
-        "resid": _colnorm(s["R"]),
-    }
-    return (s["X"][:, 0] if squeeze else s["X"]), info
+    def extract_result(s):
+        info = {
+            "iterations": s["it"],
+            "flag": jnp.where(jnp.all(s["done"]), 0, 1),
+            "resid": _colnorm(s["R"]),
+        }
+        return (s["X"][:, 0] if squeeze else s["X"]), info
+
+    return ChunkedSolver(
+        init_state=init_state,
+        step_chunk=_chunk_stepper(
+            body, params.iter_lim, done_of=lambda st: jnp.all(st["done"])
+        ),
+        extract_result=extract_result,
+        is_done=lambda s: int(s["it"]) >= params.iter_lim
+        or bool(jnp.all(s["done"])),
+        iteration=lambda s: int(s["it"]),
+        kind="flexible_cg",
+    )
+
+
+def flexible_cg(
+    A, B, precond=None, params: KrylovParams | None = None, memory: int = 5
+):
+    """Flexible CG: supports a *varying* preconditioner by re-orthogonalizing
+    the search direction against the last ``memory`` directions.
+
+    ≙ ``algorithms/Krylov/FlexibleCG.hpp:23`` (used with the inexact/
+    randomized inner preconditioners of AsyFCG, ``algorithms/asynch/
+    AsyFCG.hpp``).  ``precond`` may be a function ``(R, it) -> Z`` for
+    iteration-dependent preconditioning, or a fixed precond object.
+    """
+    params = params or KrylovParams()
+    return _one_shot(
+        flexible_cg_chunked(A, B, precond, params, memory), params.iter_lim
+    )
+
+
+def chebyshev_chunked(
+    A, B, sigma_lo: float, sigma_hi: float, params: KrylovParams | None = None
+) -> ChunkedSolver:
+    """Chunkable Chebyshev semi-iteration (see :func:`chebyshev`).  The
+    recurrence depends only on the absolute iteration index, which rides
+    the state, so chunk boundaries don't disturb the polynomial."""
+    params = params or KrylovParams()
+    matvec, _ = _ops(A)
+    B, squeeze = _as2d(B)
+    dtype = B.dtype
+    d = jnp.asarray((sigma_hi + sigma_lo) / 2, dtype)
+    c = jnp.asarray((sigma_hi - sigma_lo) / 2, dtype)
+
+    def init_state():
+        X0 = jnp.zeros_like(B)
+        return dict(
+            it=jnp.zeros((), jnp.int32),
+            X=X0,
+            Xprev=X0,
+            alpha=jnp.asarray(0, dtype),
+        )
+
+    def body(s):
+        i, X, Xprev = s["it"], s["X"], s["Xprev"]
+        R = B - matvec(X)
+        alpha = jnp.where(
+            i == 0,
+            1.0 / d,
+            jnp.where(
+                i == 1,
+                d / (d * d - c * c / 2),
+                1.0 / (d - s["alpha"] * c * c / 4),
+            ),
+        ).astype(dtype)
+        beta = jnp.where(i == 0, 0.0, alpha * d - 1.0).astype(dtype)
+        Xnew = X + alpha * R + beta * (X - Xprev)
+        return dict(it=i + 1, X=Xnew, Xprev=X, alpha=alpha)
+
+    def extract_result(s):
+        info = {"iterations": s["it"], "flag": jnp.asarray(0)}
+        return (s["X"][:, 0] if squeeze else s["X"]), info
+
+    return ChunkedSolver(
+        init_state=init_state,
+        step_chunk=_chunk_stepper(body, params.iter_lim),
+        extract_result=extract_result,
+        is_done=lambda s: int(s["it"]) >= params.iter_lim,
+        iteration=lambda s: int(s["it"]),
+        kind="chebyshev",
+    )
 
 
 def chebyshev(A, B, sigma_lo: float, sigma_hi: float, params: KrylovParams | None = None):
@@ -315,29 +466,6 @@ def chebyshev(A, B, sigma_lo: float, sigma_hi: float, params: KrylovParams | Non
     for row-sharded A beyond the matvec itself).
     """
     params = params or KrylovParams()
-    matvec, _ = _ops(A)
-    B, squeeze = _as2d(B)
-    dtype = B.dtype
-    d = jnp.asarray((sigma_hi + sigma_lo) / 2, dtype)
-    c = jnp.asarray((sigma_hi - sigma_lo) / 2, dtype)
-
-    def body(i, carry):
-        X, Xprev, alpha_prev = carry
-        R = B - matvec(X)
-        alpha = jnp.where(
-            i == 0,
-            1.0 / d,
-            jnp.where(
-                i == 1,
-                d / (d * d - c * c / 2),
-                1.0 / (d - alpha_prev * c * c / 4),
-            ),
-        ).astype(dtype)
-        beta = jnp.where(i == 0, 0.0, alpha * d - 1.0).astype(dtype)
-        Xnew = X + alpha * R + beta * (X - Xprev)
-        return (Xnew, X, alpha)
-
-    X0 = jnp.zeros_like(B)
-    X, _, _ = lax.fori_loop(0, params.iter_lim, body, (X0, X0, jnp.asarray(0, dtype)))
-    info = {"iterations": jnp.asarray(params.iter_lim), "flag": jnp.asarray(0)}
-    return (X[:, 0] if squeeze else X), info
+    return _one_shot(
+        chebyshev_chunked(A, B, sigma_lo, sigma_hi, params), params.iter_lim
+    )

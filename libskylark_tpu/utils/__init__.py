@@ -2,9 +2,11 @@
 phase timers, exceptions, solver checkpointing."""
 
 from . import profiling
-from .checkpoint import load_solver_state, save_solver_state
+from .checkpoint import CheckpointStore, load_solver_state, save_solver_state
 from .exceptions import (
     AllocationError,
+    CheckpointError,
+    ConvergenceError,
     IOError_,
     InvalidParameters,
     SkylarkError,
@@ -23,6 +25,9 @@ __all__ = [
     "SketchError",
     "UnsupportedError",
     "IOError_",
+    "ConvergenceError",
+    "CheckpointError",
     "save_solver_state",
     "load_solver_state",
+    "CheckpointStore",
 ]

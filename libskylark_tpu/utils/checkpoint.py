@@ -2,54 +2,211 @@
 
 The reference has serialization but no solver checkpointing (SURVEY §5:
 "MPI fail-stop model; no checkpoint-restart of solver state"); this module
-adds the basic capability the TPU build should provide: save/restore of a
+provides the durable half of the preemption story: save/restore of a
 solver's pytree state + metadata, so a long LSQR/CG/ADMM run can resume
-after preemption.
+after preemption (``resilient.ResilientRunner`` drives the chunked
+execution half).
 
-Format: ONE ``<path>.npz`` holding the flattened pytree leaves plus an
-embedded JSON metadata string — a single ``os.replace`` commits the
-checkpoint atomically.  All counter-based transforms already round-trip
-through their own JSON (``sketch.base``), so a solver checkpoint composes:
+Format (version 2): ONE ``<path>.npz`` holding the flattened pytree leaves
+plus an embedded JSON metadata string — a single ``os.replace`` commits the
+checkpoint atomically.  The metadata records a format version, per-leaf
+CRC32 checksums, and per-leaf dtype strings (numpy's npz container drops
+extension dtypes like bfloat16 to raw void — the recorded dtype restores
+them on load).  All counter-based transforms already round-trip through
+their own JSON (``sketch.base``), so a solver checkpoint composes:
 (transform JSON, state npz, iteration counter).
+
+:class:`CheckpointStore` layers keep-last-N rotation on top, with
+automatic fallback to the newest *valid* slot when the newest file is
+corrupt (half-written by a preemption mid-``os.replace`` is impossible,
+but corrupt-at-rest storage is not).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import zipfile
+import zlib
 
 import jax
 import numpy as np
 
-__all__ = ["save_solver_state", "load_solver_state"]
+from .exceptions import CheckpointError
+
+__all__ = [
+    "save_solver_state",
+    "load_solver_state",
+    "CheckpointStore",
+    "FORMAT_VERSION",
+]
+
+FORMAT_VERSION = 2
+
+
+def _leaf_bytes(arr: np.ndarray) -> bytes:
+    return np.ascontiguousarray(arr).tobytes()
 
 
 def save_solver_state(path, state, metadata: dict | None = None) -> None:
     """``state`` is any pytree of arrays; saved atomically (tmp+rename)."""
     leaves, treedef = jax.tree.flatten(state)
+    arrays = [np.asarray(v) for v in leaves]
     meta = {
         "skylark_object_type": "solver_checkpoint",
-        "num_leaves": len(leaves),
+        "format_version": FORMAT_VERSION,
+        "num_leaves": len(arrays),
         "treedef": str(treedef),
+        "leaf_dtypes": [str(a.dtype) for a in arrays],
+        "leaf_crc32": [zlib.crc32(_leaf_bytes(a)) for a in arrays],
         "metadata": metadata or {},
     }
     tmp = str(path) + ".tmp.npz"
     np.savez(
         tmp,
         __meta__=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
-        **{f"leaf_{i}": np.asarray(v) for i, v in enumerate(leaves)},
+        **{f"leaf_{i}": a for i, a in enumerate(arrays)},
     )
     os.replace(tmp, str(path) + ".npz")
+
+
+def _restore_dtype(arr: np.ndarray, name: str | None) -> np.ndarray:
+    if name is None:
+        return arr
+    want = np.dtype(name)  # extension dtypes resolve via jax's ml_dtypes
+    if arr.dtype == want:
+        return arr
+    # npz stores bfloat16 & friends as raw void of the same itemsize.
+    if arr.dtype.itemsize == want.itemsize:
+        return arr.view(want)
+    return arr.astype(want)
 
 
 def load_solver_state(path, like=None):
     """Returns ``(state, metadata)``.  If ``like`` (a pytree prototype) is
     given, the saved leaves are unflattened into its structure; otherwise
-    the flat leaf list is returned."""
-    data = np.load(str(path) + ".npz")
-    meta = json.loads(bytes(data["__meta__"]).decode())
-    leaves = [data[f"leaf_{i}"] for i in range(meta["num_leaves"])]
+    the flat leaf list is returned.
+
+    Raises :class:`CheckpointError` (an ``IOError_``) when the file is not
+    a solver checkpoint, leaves are missing, or a CRC32 check fails.
+    """
+    fname = str(path) + ".npz"
+    try:
+        with np.load(fname) as data:
+            if "__meta__" not in data.files:
+                raise CheckpointError(f"{fname}: missing __meta__ record")
+            try:
+                meta = json.loads(bytes(data["__meta__"]).decode())
+            except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                raise CheckpointError(f"{fname}: unreadable metadata: {e}")
+            if meta.get("skylark_object_type") != "solver_checkpoint":
+                raise CheckpointError(
+                    f"{fname}: skylark_object_type is "
+                    f"{meta.get('skylark_object_type')!r}, expected "
+                    f"'solver_checkpoint'"
+                )
+            num = meta["num_leaves"]
+            present = {k for k in data.files if k.startswith("leaf_")}
+            expected = {f"leaf_{i}" for i in range(num)}
+            if present != expected:
+                raise CheckpointError(
+                    f"{fname}: num_leaves={num} but file holds "
+                    f"{sorted(present)}"
+                )
+            # Leaves are materialized inside the with-block: np.load memory-
+            # maps the zip and a leaked handle keeps the fd (and on some
+            # platforms the file lock) alive indefinitely.
+            leaves = [data[f"leaf_{i}"] for i in range(num)]
+    except (
+        OSError,
+        zlib.error,
+        ValueError,
+        EOFError,
+        KeyError,
+        zipfile.BadZipFile,
+    ) as e:
+        if isinstance(e, CheckpointError):
+            raise
+        raise CheckpointError(f"{fname}: unreadable container: {e}")
+
+    dtypes = meta.get("leaf_dtypes") or [None] * num
+    crcs = meta.get("leaf_crc32")
+    for i, arr in enumerate(leaves):
+        if crcs is not None and zlib.crc32(_leaf_bytes(arr)) != crcs[i]:
+            raise CheckpointError(f"{fname}: CRC32 mismatch on leaf_{i}")
+        leaves[i] = _restore_dtype(arr, dtypes[i])
+
     if like is not None:
         treedef = jax.tree.structure(like)
+        if treedef.num_leaves != num:
+            raise CheckpointError(
+                f"{fname}: prototype has {treedef.num_leaves} leaves, "
+                f"checkpoint has {num}"
+            )
         return jax.tree.unflatten(treedef, leaves), meta["metadata"]
     return leaves, meta["metadata"]
+
+
+class CheckpointStore:
+    """Keep-last-N rotation of step-indexed checkpoints in one directory.
+
+    Slots are ``<prefix>-<step:012d>.npz``; :meth:`save` commits a new slot
+    atomically then prunes the oldest beyond ``keep_last``.
+    :meth:`load_latest` walks slots newest→oldest and returns the first
+    that passes integrity validation, so one corrupt-at-rest file costs at
+    most ``checkpoint_every`` iterations of recomputation, not the run.
+    """
+
+    def __init__(self, directory, keep_last: int = 3, prefix: str = "ckpt"):
+        if keep_last < 1:
+            raise ValueError(f"keep_last must be >= 1, got {keep_last}")
+        self.directory = str(directory)
+        self.keep_last = int(keep_last)
+        self.prefix = prefix
+        os.makedirs(self.directory, exist_ok=True)
+
+    def _slot(self, step: int) -> str:
+        return os.path.join(self.directory, f"{self.prefix}-{step:012d}")
+
+    def steps(self) -> list[int]:
+        """Ascending step indices of the slots currently on disk."""
+        out = []
+        pre, suf = self.prefix + "-", ".npz"
+        for name in os.listdir(self.directory):
+            if name.startswith(pre) and name.endswith(suf):
+                try:
+                    out.append(int(name[len(pre):-len(suf)]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def save(self, state, step: int, metadata: dict | None = None) -> str:
+        meta = dict(metadata or {})
+        meta["step"] = int(step)
+        slot = self._slot(step)
+        save_solver_state(slot, state, meta)
+        for old in self.steps()[: -self.keep_last]:
+            try:
+                os.remove(self._slot(old) + ".npz")
+            except OSError:
+                pass  # pruning is best-effort; a leftover slot is harmless
+        return slot + ".npz"
+
+    def load_latest(self, like=None):
+        """Returns ``(state, metadata, step)`` from the newest valid slot,
+        or ``None`` when no slot exists.  Raises :class:`CheckpointError`
+        only when every slot on disk fails validation."""
+        steps = self.steps()
+        if not steps:
+            return None
+        errors = []
+        for step in reversed(steps):
+            try:
+                state, meta = load_solver_state(self._slot(step), like=like)
+                return state, meta, step
+            except CheckpointError as e:
+                errors.append(str(e))
+        raise CheckpointError(
+            "no valid checkpoint among "
+            f"{len(steps)} slot(s): " + "; ".join(errors)
+        )

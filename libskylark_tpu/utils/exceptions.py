@@ -13,6 +13,8 @@ __all__ = [
     "SketchError",
     "UnsupportedError",
     "IOError_",
+    "ConvergenceError",
+    "CheckpointError",
 ]
 
 
@@ -40,3 +42,25 @@ class UnsupportedError(SkylarkError, NotImplementedError):
 
 class IOError_(SkylarkError, IOError):
     code = 105
+
+
+class ConvergenceError(SkylarkError):
+    """An iterative solve diverged (NaN/Inf iterates) or was halted by a
+    guard.  ``result`` carries the best iterate observed before the halt
+    (``(X, info)`` for Krylov solvers, a model for ADMM) so callers can
+    degrade gracefully instead of receiving silent garbage."""
+
+    code = 106
+
+    def __init__(self, msg, result=None, iteration=None):
+        super().__init__(msg)
+        self.result = result
+        self.iteration = iteration
+
+
+class CheckpointError(IOError_):
+    """A checkpoint failed integrity validation (bad CRC, wrong object
+    type, missing leaves, unreadable container).  Subclasses ``IOError_``
+    so pre-existing IO error handling keeps working."""
+
+    code = 107

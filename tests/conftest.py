@@ -22,8 +22,46 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+import signal  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# Fault-injection tests must never hang the tier-1 run (a botched resume
+# path could loop forever waiting on a checkpoint that never appears), so
+# every ``faults``-marked test gets a hard per-test alarm.  They stay
+# inside the ``-m 'not slow'`` selection on purpose: the recovery paths
+# run on every PR.
+FAULTS_TIMEOUT_S = 120
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-injection / resilience tests (preemption, corrupt "
+        "checkpoints, transient IO); tier-1, guarded by a per-test "
+        f"{FAULTS_TIMEOUT_S}s timeout",
+    )
+
+
+@pytest.fixture(autouse=True)
+def _faults_timeout(request):
+    if request.node.get_closest_marker("faults") is None:
+        yield
+        return
+
+    def _alarm(signum, frame):
+        raise TimeoutError(
+            f"faults test exceeded {FAULTS_TIMEOUT_S}s hard timeout"
+        )
+
+    old = signal.signal(signal.SIGALRM, _alarm)
+    signal.alarm(FAULTS_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
 
 
 @pytest.fixture
