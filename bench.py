@@ -751,12 +751,15 @@ def _init_backend():
             return jax.devices()[0]
         except Exception as e:  # noqa: BLE001 — UNAVAILABLE, tunnel flaps
             last = f"{type(e).__name__}: {e}"
-            # Errors that don't self-identify as UNAVAILABLE are almost
+            # Errors that don't self-identify as transient are almost
             # always deterministic misconfiguration (wrong platform, no
             # plugin) — give them one retry, then stop burning the init
-            # budget.  Matching on the class of error, not exact text:
-            # PJRT messages can embed varying addresses/timestamps.
-            hard_errors += 0 if "UNAVAILABLE" in last else 1
+            # budget.  Transience is judged by gRPC status tokens in the
+            # message (UNAVAILABLE = tunnel flap, DEADLINE = slow
+            # backend boot, RESOURCE_EXHAUSTED = device contention), not
+            # exact text: PJRT messages embed varying addresses.
+            transient_tokens = ("UNAVAILABLE", "DEADLINE", "RESOURCE_EXHAUSTED")
+            hard_errors += 0 if any(t in last for t in transient_tokens) else 1
             if hard_errors >= 2:
                 return _BackendUnavailable(last)
             print(

@@ -10,7 +10,19 @@ collectives instead of MPI.
 
 __version__ = "0.3.0"
 
-from . import core, graph, io, linalg, ml, parallel, resilient, sketch, solvers, utils
+from . import (
+    core,
+    graph,
+    io,
+    linalg,
+    ml,
+    parallel,
+    resilient,
+    sketch,
+    solvers,
+    streaming,
+    utils,
+)
 from .core import SketchContext
 
 __all__ = [
@@ -23,6 +35,7 @@ __all__ = [
     "resilient",
     "sketch",
     "solvers",
+    "streaming",
     "utils",
     "SketchContext",
     "__version__",

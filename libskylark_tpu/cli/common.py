@@ -9,6 +9,7 @@ __all__ = [
     "load_classes",
     "load_dataset",
     "print_test_metrics",
+    "scan_dims",
     "stream_dataset",
 ]
 
@@ -64,6 +65,27 @@ def stream_dataset(path, fileformat: str, d: int, batch: int, sparse: bool):
         return stream_hdf5(path, batch, sparse=sparse)
     if fileformat == "hdf5_sparse":
         return stream_hdf5(path, batch, sparse=True)
+    raise ValueError(f"unknown fileformat {fileformat!r}; use {FILE_FORMATS}")
+
+
+def scan_dims(path, fileformat: str) -> tuple[int, int]:
+    """Global ``(n_examples, n_features)`` of a dataset WITHOUT loading
+    it — streaming drivers need the shape up front (rows address the
+    sketch counter stream).  LIBSVM takes one tokenize-only pass
+    (``io.scan_libsvm_dims``); HDF5 reads the stored shape."""
+    if fileformat == "libsvm":
+        from ..io import scan_libsvm_dims
+
+        return scan_libsvm_dims(path)
+    if fileformat in ("hdf5_dense", "hdf5_sparse"):
+        from ..utils.deps import require
+
+        h5py = require("h5py")
+        with h5py.File(path, "r") as f:
+            if "X" in f:
+                return int(f["X"].shape[0]), int(f["X"].shape[1])
+            d, n, _ = (int(v) for v in f["dimensions"][:])
+            return n, d
     raise ValueError(f"unknown fileformat {fileformat!r}; use {FILE_FORMATS}")
 
 

@@ -18,6 +18,18 @@ from .common import FILE_FORMATS
 _ALGS = {0: "exact", 1: "faster", 2: "approximate", 3: "sketched", 4: "largescale"}
 
 
+def _kernel_params(args) -> dict:
+    """--kernel flag → ctor kwargs (≙ the reference's per-kernel flags)."""
+    return {
+        "linear": {},
+        "gaussian": {"sigma": args.sigma},
+        "polynomial": {"q": args.q, "c": args.c, "gamma": args.gamma},
+        "laplacian": {"sigma": args.sigma},
+        "expsemigroup": {"beta": args.beta},
+        "matern": {"nu": args.nu, "l": args.l},
+    }[args.kernel]
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="skylark-krr")
     p.add_argument("--trainfile", required=True)
@@ -55,6 +67,13 @@ def main(argv=None) -> int:
     p.add_argument("--resume", action="store_true",
                    help="resume from the newest valid checkpoint in "
                         "--checkpoint-dir")
+    p.add_argument("--stream", action="store_true",
+                   help="out-of-core training: stream the train file in "
+                        "--batch-rows row blocks, accumulating the "
+                        "random-feature Gram per batch (approximate "
+                        "KRR only; X is never resident)")
+    p.add_argument("--batch-rows", type=int, default=4096,
+                   help="rows per streamed batch (with --stream)")
     args = p.parse_args(argv)
 
     import jax
@@ -70,17 +89,11 @@ def main(argv=None) -> int:
     from .common import load_dataset
 
     is_sparse = args.sparse or args.fileformat == "hdf5_sparse"
+    if args.stream:
+        return _stream_main(args, is_sparse)
     X, y = load_dataset(args.trainfile, args.fileformat, args.sparse)
     n, d = X.shape
-    kparams = {
-        "linear": {},
-        "gaussian": {"sigma": args.sigma},
-        "polynomial": {"q": args.q, "c": args.c, "gamma": args.gamma},
-        "laplacian": {"sigma": args.sigma},
-        "expsemigroup": {"beta": args.beta},
-        "matern": {"nu": args.nu, "l": args.l},
-    }[args.kernel]
-    kernel = kernel_by_name(args.kernel, d, **kparams)
+    kernel = kernel_by_name(args.kernel, d, **_kernel_params(args))
     ctx = SketchContext(seed=args.seed)
     params = KrrParams(
         am_i_printing=True,
@@ -138,6 +151,64 @@ def main(argv=None) -> int:
     model.save(args.modelfile)
     print(f"Model saved to {args.modelfile}")
 
+    if args.testfile:
+        Xt, yt = load_dataset(
+            args.testfile, args.fileformat, args.sparse, n_features=d
+        )
+        Xtj = Xt if is_sparse else jnp.asarray(Xt)
+        print_test_metrics(model, Xtj, yt, args.regression)
+    return 0
+
+
+def _stream_main(args, is_sparse: bool) -> int:
+    """Out-of-core training: one streamed pass of random-feature Gram
+    accumulation (``streaming.kernel_ridge``) — the approximate (-a 2)
+    path with X never resident.  Classification needs the label coding
+    (and so the class set) before the pass; regression only for now."""
+    if _ALGS[args.algorithm] != "approximate":
+        print("error: --stream supports the approximate feature-map "
+              "path only; use -a 2", file=sys.stderr)
+        return 2
+    if not args.regression:
+        print("error: --stream needs --regression (label coding would "
+              "need the class set before the pass)", file=sys.stderr)
+        return 2
+
+    import jax.numpy as jnp
+
+    from ..core.context import SketchContext
+    from ..ml import KrrParams, kernel_by_name
+    from ..ml import krr as krr_mod
+    from ..streaming import StreamParams, skip_batches
+    from .common import load_dataset, print_test_metrics, scan_dims, stream_dataset
+
+    n, d = scan_dims(args.trainfile, args.fileformat)
+    print(f"Streaming {n}x{d} in batches of {args.batch_rows} rows")
+    kernel = kernel_by_name(args.kernel, d, **_kernel_params(args))
+    kparams = KrrParams(am_i_printing=True, log_level=1)
+
+    def batches(start: int):
+        it = stream_dataset(
+            args.trainfile, args.fileformat, d, args.batch_rows,
+            args.sparse or args.fileformat == "hdf5_sparse",
+        )
+        return skip_batches(it, start) if start else it
+
+    sp = StreamParams(
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        resume=args.resume,
+    )
+    t0 = time.perf_counter()
+    model = krr_mod.streaming_approximate_kernel_ridge(
+        kernel, batches, args.lam, args.numfeatures,
+        SketchContext(seed=args.seed), kparams, stream_params=sp,
+    )
+    dt = time.perf_counter() - t0
+    print(f"Training (streamed approximate, "
+          f"{model.info['batches']} batches) took {dt:.3f} sec")
+    model.save(args.modelfile)
+    print(f"Model saved to {args.modelfile}")
     if args.testfile:
         Xt, yt = load_dataset(
             args.testfile, args.fileformat, args.sparse, n_features=d

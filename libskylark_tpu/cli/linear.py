@@ -22,6 +22,20 @@ def main(argv=None) -> int:
     p.add_argument("--x64", action="store_true")
     p.add_argument("--shard", action="store_true",
                    help="shard the input rows over all visible devices")
+    p.add_argument("--stream", action="store_true",
+                   help="out-of-core sketch-and-solve: stream the input "
+                        "in --batch-rows row blocks instead of reading "
+                        "it whole (one pass; A is never resident)")
+    p.add_argument("--batch-rows", type=int, default=4096,
+                   help="rows per streamed batch (with --stream)")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="with --stream: checkpoint the partial sketch "
+                        "so a killed pass can resume bit-for-bit")
+    p.add_argument("--checkpoint-every", type=int, default=8,
+                   help="streamed batches per checkpoint round")
+    p.add_argument("--resume", action="store_true",
+                   help="resume a streamed pass from the newest valid "
+                        "checkpoint in --checkpoint-dir")
     args = p.parse_args(argv)
 
     import jax
@@ -34,6 +48,8 @@ def main(argv=None) -> int:
     from ..io import read_libsvm
     from ..solvers import RegressionProblem, solve_regression
 
+    if args.stream:
+        return _stream_main(args)
     A, b = read_libsvm(args.inputfile, sparse=args.sparse)
     Aj = A if args.sparse else jnp.asarray(A)
     if args.shard:
@@ -61,6 +77,56 @@ def main(argv=None) -> int:
     r = np.linalg.norm(np.asarray(Aj @ jnp.asarray(x)) - b)
     print(f"Solved {A.shape[0]}x{A.shape[1]} ({args.solver}) in {dt:.3f}s; "
           f"residual {r:.6e}")
+    np.save(args.solution, x)
+    print(f"Solution -> {args.solution}")
+    return 0
+
+
+def _stream_main(args) -> int:
+    """Out-of-core path: one streamed sketch-and-solve pass.
+
+    ≙ the whole-file path with ``--solver sketched``, but the sketch
+    applies decompose over row blocks (``streaming.sketch_least_squares``)
+    so the file never needs to fit in memory.  Other --solver choices
+    need the resident matrix and are rejected up front.
+    """
+    if args.solver not in ("sketched", "accelerated"):
+        print(f"error: --stream is sketch-and-solve only; --solver "
+              f"{args.solver} needs the resident matrix", file=sys.stderr)
+        return 2
+    if args.shard:
+        print("warning: --shard is a whole-matrix layout; ignored with "
+              "--stream", file=sys.stderr)
+
+    from ..core.context import SketchContext
+    from ..io import scan_libsvm_dims, stream_libsvm
+    from ..linalg import streaming_least_squares
+    from ..streaming import StreamParams, skip_batches
+
+    nrows, ncols = scan_libsvm_dims(args.inputfile)
+    print(f"Streaming {nrows}x{ncols} in batches of {args.batch_rows} rows")
+
+    def batches(start: int):
+        it = stream_libsvm(
+            args.inputfile, ncols, batch=args.batch_rows,
+            sparse=args.sparse,
+        )
+        return skip_batches(it, start) if start else it
+
+    sp = StreamParams(
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
+        resume=args.resume,
+    )
+    t0 = time.perf_counter()
+    x, info = streaming_least_squares(
+        batches, nrows, ncols, SketchContext(seed=args.seed),
+        sparse=args.sparse, stream_params=sp,
+    )
+    x = np.asarray(x)
+    dt = time.perf_counter() - t0
+    print(f"Solved {nrows}x{ncols} (streamed sketch-and-solve, "
+          f"{info['batches']} batches) in {dt:.3f}s")
     np.save(args.solution, x)
     print(f"Solution -> {args.solution}")
     return 0

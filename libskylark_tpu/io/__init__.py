@@ -2,7 +2,7 @@
 byte-source seam ≙ the HDFS reader variants at ``libsvm_io.hpp:1495-1638``)."""
 
 from .hdf5 import read_hdf5, stream_hdf5, write_hdf5
-from .libsvm import read_libsvm, stream_libsvm, write_libsvm
+from .libsvm import read_libsvm, scan_libsvm_dims, stream_libsvm, write_libsvm
 from .source import (
     ByteSource,
     FsspecSource,
@@ -16,6 +16,7 @@ __all__ = [
     "read_libsvm",
     "write_libsvm",
     "stream_libsvm",
+    "scan_libsvm_dims",
     "read_hdf5",
     "write_hdf5",
     "stream_hdf5",

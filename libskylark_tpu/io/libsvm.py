@@ -16,7 +16,46 @@ import io
 
 import numpy as np
 
-__all__ = ["read_libsvm", "write_libsvm", "stream_libsvm"]
+__all__ = ["read_libsvm", "write_libsvm", "stream_libsvm", "scan_libsvm_dims"]
+
+
+def scan_libsvm_dims(path, chunk_bytes: int = 8 << 20) -> tuple[int, int]:
+    """One cheap pass over a LIBSVM source → ``(n_examples, n_features)``.
+
+    Streaming consumers must know the global shape up front (the row
+    count addresses a columnwise sketch's counter stream; the feature
+    count sizes the batches), and an out-of-core file cannot be read
+    whole to find out.  This scan only tokenizes — no float parsing, no
+    arrays — so it is bounded-memory and IO-dominated.
+    """
+    from .source import open_source
+
+    n = 0
+    d = 0
+    with open_source(path).open() as f:
+        carry = b""
+        eof = False
+        while not eof:
+            data = f.read(chunk_bytes)
+            eof = not data
+            block = carry + data
+            carry = b""
+            if not eof:
+                cut = block.rfind(b"\n")
+                if cut < 0:
+                    carry = block
+                    continue
+                carry, block = block[cut + 1 :], block[: cut + 1]
+            for line in block.decode().splitlines():
+                line = line.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                n += 1
+                for tok in line.split()[1:]:
+                    idx = int(tok.split(":", 1)[0])
+                    if idx > d:
+                        d = idx
+    return n, d
 
 
 def read_libsvm(

@@ -20,6 +20,7 @@ from .least_squares import (
     LeastSquaresParams,
     approximate_least_squares,
     exact_least_squares,
+    streaming_least_squares,
 )
 from .svd import (
     SVDParams,
@@ -42,6 +43,7 @@ __all__ = [
     "LeastSquaresParams",
     "approximate_least_squares",
     "exact_least_squares",
+    "streaming_least_squares",
     "FasterLeastSquaresParams",
     "faster_least_squares",
     "lsrn_least_squares",

@@ -30,6 +30,7 @@ __all__ = [
     "LeastSquaresParams",
     "exact_least_squares",
     "approximate_least_squares",
+    "streaming_least_squares",
 ]
 
 
@@ -106,3 +107,41 @@ def approximate_least_squares(
     SB = S.apply(B, Dimension.COLUMNWISE)
     X = exact_least_squares(SA, SB, alg=alg)
     return X[:, 0] if squeeze else X
+
+
+def streaming_least_squares(
+    source,
+    nrows: int,
+    ncols: int,
+    context: SketchContext,
+    params: LeastSquaresParams | None = None,
+    alg: str = "qr",
+    *,
+    targets: int = 1,
+    sparse: bool = False,
+    stream_params=None,
+    fault_plan=None,
+):
+    """Out-of-core sketch-and-solve LS over ``(A_block, b_block)`` batches.
+
+    The streaming face of :func:`approximate_least_squares`: same sketch
+    selection (``sketch_type``/``sketch_size`` from ``params``, defaults
+    CWT for sparse streams else JLT — FJLT has no columnwise partial-
+    sketch rule), but ``S·A`` / ``S·b`` accumulate per batch through
+    ``streaming.sketch_least_squares`` so A never needs to be resident.
+    ``nrows``/``ncols`` are A's global shape (rows must be known up front
+    to address the sketch's counter stream; ``io.scan_libsvm_dims`` scans
+    them in one cheap pass).  ``stream_params`` is a
+    :class:`~libskylark_tpu.streaming.StreamParams` (prefetch depth,
+    checkpoint/resume).  Returns ``(x, info)``.
+    """
+    from .. import streaming
+
+    params = params or LeastSquaresParams()
+    s = params.sketch_size or min(4 * ncols, nrows)
+    stype = params.sketch_type or ("CWT" if sparse else "JLT")
+    S = create_sketch(stype, nrows, s, context)
+    return streaming.sketch_least_squares(
+        source, S, ncols=ncols, targets=targets, alg=alg,
+        params=stream_params, fault_plan=fault_plan,
+    )

@@ -27,6 +27,7 @@ from .krr import (
     faster_kernel_ridge,
     kernel_ridge,
     large_scale_kernel_ridge,
+    streaming_approximate_kernel_ridge,
     streaming_kernel_ridge,
     sketched_approximate_kernel_ridge,
 )
@@ -54,6 +55,7 @@ __all__ = [
     "faster_kernel_ridge",
     "large_scale_kernel_ridge",
     "streaming_kernel_ridge",
+    "streaming_approximate_kernel_ridge",
     "kernel_rlsc",
     "approximate_kernel_rlsc",
     "sketched_approximate_kernel_rlsc",

@@ -47,6 +47,7 @@ __all__ = [
     "faster_kernel_ridge",
     "large_scale_kernel_ridge",
     "streaming_kernel_ridge",
+    "streaming_approximate_kernel_ridge",
 ]
 
 
@@ -340,6 +341,41 @@ def large_scale_kernel_ridge(
 
     W = jnp.concatenate(Ws, axis=0)
     return FeatureMapModel(maps, W)
+
+
+def streaming_approximate_kernel_ridge(
+    kernel: Kernel,
+    source,
+    lam: float,
+    s: int,
+    context: SketchContext,
+    params: KrrParams | None = None,
+    *,
+    targets: int = 1,
+    stream_params=None,
+    fault_plan=None,
+):
+    """One-pass :func:`approximate_kernel_ridge` over ``(X_block,
+    y_block)`` batches — X never resident.
+
+    The normal equations accumulate per batch (``G += Z_bᵀZ_b``,
+    ``c += Z_bᵀy_b`` with ``Z_b = S(X_b)`` rowwise) through the
+    ``streaming`` engine, which brings the prefetch pipeline and
+    checkpoint/resume (``stream_params`` — a
+    :class:`~libskylark_tpu.streaming.StreamParams`) along.  Trained on
+    the same ``context`` seed, the model is allclose-interchangeable
+    with the in-core solver's, modulo per-batch summation order.
+    ``source`` is an iterable of batches or a re-openable factory
+    ``f(start_batch) -> iterator`` (``io.stream_libsvm`` /
+    ``io.stream_hdf5`` wrapped in a lambda both qualify).
+    """
+    from .. import streaming
+
+    return streaming.kernel_ridge(
+        source, kernel, lam, s, context,
+        targets=targets, krr_params=params, params=stream_params,
+        fault_plan=fault_plan,
+    )
 
 
 def streaming_kernel_ridge(

@@ -605,9 +605,22 @@ def suggest_sparse_out_capacity(S, A, mesh: Mesh) -> int:
     global rows once with the same counter-derived buckets the schedule
     uses.  Worth calling when the default (every entry of one source on
     one destination) over-allocates badly — e.g. near-uniform hashes,
-    where the true max is ≈ entries/p + O(√entries)."""
+    where the true max is ≈ entries/p + O(√entries).
+
+    1-D meshes only: the row block (n/p) and destination routing here
+    assume every device sits on one axis.  On a 2-D grid rows split over
+    the ROW axis only (block n/pr, exchange over pr peers), so this
+    count would be wrong for :func:`columnwise_sharded_sparse_out_2d` —
+    rejected rather than silently under/over-sized."""
     import numpy as np
 
+    if len(mesh.axis_names) > 1:
+        raise ValueError(
+            "suggest_sparse_out_capacity is 1-D only: mesh has axes "
+            f"{tuple(mesh.axis_names)}; its n/p row blocks and p-way "
+            "destination counts do not match the 2-D grid's row-axis "
+            "exchange (see columnwise_sharded_sparse_out_2d)"
+        )
     p = mesh.size
     n = A.shape[0]
     block, out_block = n // p, S.s // p
@@ -690,7 +703,10 @@ def columnwise_sharded_sparse_out_2d(S, A, mesh: Mesh,
 
     ``capacity`` as in :func:`columnwise_sharded_sparse_out`: per-
     (source, destination) REAL-entry buffer length; the default cannot
-    drop.
+    drop.  NOTE: :func:`suggest_sparse_out_capacity` is the 1-D helper
+    and refuses 2-D meshes — here entries route over the ROW axis only
+    (pr peers, row block n/pr), so a tight 2-D capacity must count
+    per-(row-block, destination) maxima on that axis instead.
     """
     pr, pc, rblock, cblock, d, lr, lc = _validate_grid_2d(
         S, A, mesh, "columnwise_sharded_sparse_out_2d"

@@ -116,6 +116,74 @@ class SketchTransform(abc.ABC):
     def __call__(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
         return self.apply(A, dim)
 
+    # -- partial-sketch protocol (streaming / out-of-core) -------------------
+    #
+    # Every transform here is a linear map (or linear-then-pointwise feature
+    # map) whose randomness is counter-addressable, so ``S·A`` decomposes
+    # exactly into per-block contributions that never need the full A (or
+    # the full Omega) resident:
+    #
+    # - COLUMNWISE (A is (N, m), sketched axis = rows): the block of rows
+    #   [start, start+k) contributes ``Omega[:, start:start+k] @ A_block``;
+    #   block contributions MERGE BY SUM, then :meth:`finalize_slices`
+    #   (identity for linear sketches; the cos epilogue for RFT).
+    # - ROWWISE (A is (m, N), sketched axis = columns): a block of rows
+    #   (examples) carries the full feature axis, so its contribution is
+    #   the finished sketch of the block; contributions MERGE BY CONCAT
+    #   along axis 0 in stream order.
+    #
+    # ``streaming.sketch`` drives this over ``io`` batch sources with a
+    # prefetch pipeline and resilient checkpoints (docs/streaming.md).
+
+    def apply_slice(self, A_block, start: int, dim: Dimension | str = Dimension.COLUMNWISE):
+        """Exact contribution of the block of A starting at row ``start``
+        of the sketched axis (``start`` must be a host int — it addresses
+        the counter stream, not a traced value).
+
+        COLUMNWISE: ``A_block`` is rows [start, start+k) of the (N, m)
+        input; returns the (S, m) partial ``Omega[:, start:start+k] @
+        A_block``.  Sum the results over a disjoint cover of [0, N) and
+        pass the total through :meth:`finalize_slices` to get ``apply(A)``
+        (bit-equal modulo floating-point summation order).
+
+        ROWWISE: ``A_block`` is any row block of the (m, N) input; returns
+        the finished (k, S) sketch of those rows (``start`` only records
+        stream position).  Concatenate in stream order.
+        """
+        dim = Dimension.of(dim)
+        if dim is Dimension.ROWWISE:
+            return self.apply(A_block, dim)
+        start = int(start)
+        k = A_block.shape[0]
+        if start < 0 or start + k > self.n:
+            raise ValueError(
+                f"slice [{start}, {start + k}) outside the sketch domain "
+                f"[0, {self.n})"
+            )
+        squeeze = getattr(A_block, "ndim", 2) == 1
+        if squeeze:
+            A_block = A_block[:, None]
+        out = self._apply_slice_columnwise(A_block, start)
+        return out[:, 0] if squeeze else out
+
+    def _apply_slice_columnwise(self, A_block, start: int):
+        """Subclass hook for the COLUMNWISE partial product; ``A_block``
+        is 2-D and bounds-checked."""
+        from ..utils.exceptions import UnsupportedError
+
+        raise UnsupportedError(
+            f"{self.sketch_type} has no columnwise partial-sketch rule; "
+            "stream ROWWISE, or use a dense (JLT/CT), hash "
+            "(CWT/SJLT/MMT/WZT), or RFT transform"
+        )
+
+    def finalize_slices(self, acc, dim: Dimension | str = Dimension.COLUMNWISE):
+        """Turn the merged COLUMNWISE slice-sum into the final sketch
+        (identity for linear transforms; feature maps apply their
+        pointwise epilogue here).  ROWWISE concatenations are already
+        final and pass through unchanged."""
+        return acc
+
     # -- loop-invariant operand hoisting ------------------------------------
 
     def hoistable_operands(self, dtype):

@@ -94,6 +94,20 @@ class DenseSketch(SketchTransform):
     def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
         return self._apply_impl(A, Dimension.of(dim), omega=None)
 
+    def _apply_slice_columnwise(self, A_block, start: int):
+        """Partial product of the Omega column window [start, start+k):
+        realized directly from the counter stream (P5 — any window is
+        bit-identical to the same slice of the full matrix), so streaming
+        over row blocks never materializes more than one (S, k) window."""
+        k = A_block.shape[0]
+        dtype = A_block.dtype
+        if not jnp.issubdtype(dtype, jnp.floating):
+            dtype = jnp.float32
+        w = self.realize(dtype, offset=(0, start), shape=(self.s, k))
+        if hasattr(A_block, "todense"):
+            return _matmul(w, A_block)
+        return _matmul(w, A_block.astype(dtype))
+
     def hoistable_operands(self, dtype):
         """The realized (S, N) Omega, for streaming consumers to hoist
         out of panel loops (see SketchTransform.hoistable_operands);

@@ -233,6 +233,39 @@ class HashSketch(SketchTransform):
             return self._apply_sparse(A, dim)
         return self._apply_dense(A, dim)
 
+    def _apply_slice_columnwise(self, A_block, start: int):
+        """Partial scatter-add over the hash windows of coordinates
+        [start, start+k): each hash function's (bucket, value) slice is a
+        counter window (flat index ``h·N + i``), so a streaming pass
+        regenerates exactly the k-coordinate slice per block — never the
+        full N-length hash arrays.  BCOO blocks take the same per-hash
+        ``segment_sum`` keyed through their local row indices."""
+        k = A_block.shape[0]
+        sparse_in = isinstance(A_block, jsparse.BCOO)
+        in_dtype = A_block.data.dtype if sparse_in else A_block.dtype
+        dtype = in_dtype if jnp.issubdtype(in_dtype, jnp.floating) else jnp.float32
+        out = jnp.zeros((self.s, A_block.shape[1]), dtype)
+        if sparse_in:
+            rows, cols = A_block.indices[:, 0], A_block.indices[:, 1]
+            data = A_block.data.astype(dtype)
+            m = A_block.shape[1]
+            for h in range(self.nnz):
+                b = self.buckets(h * self.n + start, k)
+                v = self.values(dtype, h * self.n + start, k)
+                key = b[rows] * jnp.int32(m) + cols
+                out = out + _segment_sum(
+                    data * v[rows], key, self.s * m
+                ).astype(dtype).reshape(self.s, m)
+            return out
+        A_block = A_block.astype(dtype)
+        for h in range(self.nnz):
+            b = self.buckets(h * self.n + start, k)
+            v = self.values(dtype, h * self.n + start, k)
+            out = out + jax.ops.segment_sum(
+                v[:, None] * A_block, b, num_segments=self.s
+            )
+        return out
+
     # Above this many (S·N) entries the materialized one-hot hashing
     # matrix no longer pays for itself; fall back to scatter-add.
     _ONEHOT_LIMIT = 1 << 27

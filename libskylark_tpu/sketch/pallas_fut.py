@@ -158,6 +158,11 @@ def rfut_rowwise_sampled(x, diag, nb: int, idx, interpret: bool = False):
     s = int(idx.shape[0])
     m, n = x.shape
     tm = _tile_rows(m, nb)
+    if tm is None:
+        raise ValueError(
+            f"shape unsupported; check supported_sampled: no VMEM-fitting "
+            f"row tile divides m={m} at nb={nb}"
+        )
     dtype = x.dtype
     H2 = jnp.asarray(_hadamard(_F2.bit_length() - 1), jnp.float32)
     d2 = diag.astype(dtype).reshape(1, n)
@@ -195,6 +200,11 @@ def rfut_rowwise(x, diag, nb: int, interpret: bool = False):
 
     m, n = x.shape
     tm = _tile_rows(m, nb)
+    if tm is None:
+        raise ValueError(
+            f"shape unsupported; check supported: no VMEM-fitting row "
+            f"tile divides m={m} at nb={nb}"
+        )
     dtype = x.dtype
     H2 = jnp.asarray(_hadamard(_F2.bit_length() - 1), jnp.float32)
     d2 = diag.astype(dtype).reshape(1, n)

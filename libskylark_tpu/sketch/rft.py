@@ -101,6 +101,22 @@ class RFT(SketchTransform):
             WX = WX + shifts
         return jnp.asarray(self.outscale, dtype) * jnp.cos(WX)
 
+    def _apply_slice_columnwise(self, A_block, start: int):
+        """Partial W·A over the coordinate block: the LINEAR half of the
+        feature map decomposes over row blocks exactly like the dense
+        engine; the nonlinear cos epilogue must wait for the full sum and
+        runs in :meth:`finalize_slices`."""
+        return self._underlying._apply_slice_columnwise(A_block, start)
+
+    def finalize_slices(self, acc, dim: Dimension | str = Dimension.COLUMNWISE):
+        """COLUMNWISE slice-sums hold the merged W·A — apply the
+        ``outscale·cos(scales ⊙ · + shifts)`` epilogue once here.
+        ROWWISE blocks were finished by :meth:`apply` already."""
+        dim = Dimension.of(dim)
+        if dim is Dimension.ROWWISE:
+            return acc
+        return self._epilogue(acc, dim)
+
     def hoistable_operands(self, dtype):
         """The realized (S, N) W — loop-invariant, and the expensive
         part of the apply to re-derive (Box-Muller per visit).

@@ -1,0 +1,35 @@
+"""Out-of-core streaming sketching engine (no single reference
+counterpart — this is the consumer half the reference's streaming IO
+layer implies: ``utility/io/libsvm_io.hpp:1495-1638`` reads bounded
+batches, and every counter-addressed sketch decomposes exactly over
+them).
+
+- ``pipeline``: double-buffered host→device prefetch (bounded queue,
+  backpressure, overlap proof counters)
+- ``engine``: the checkpointable accumulation fold, riding the
+  ``resilient`` runtime (resume is bit-for-bit)
+- ``drivers``: one-pass ``sketch`` (S·A / A·Ωᵀ), streaming
+  sketch-and-solve least squares, streaming KRR Gram accumulation
+
+See ``docs/streaming.md`` for the partial-sketch math and the merge
+rules; the transform-side protocol is ``SketchTransform.apply_slice`` /
+``finalize_slices`` (``sketch/base.py``).
+"""
+
+from .drivers import kernel_ridge, sketch, sketch_batches, sketch_least_squares
+from .engine import StreamParams, as_block_factory, run_stream, skip_batches
+from .pipeline import Prefetcher, PrefetchStats, device_placer
+
+__all__ = [
+    "sketch",
+    "sketch_batches",
+    "sketch_least_squares",
+    "kernel_ridge",
+    "StreamParams",
+    "run_stream",
+    "as_block_factory",
+    "skip_batches",
+    "Prefetcher",
+    "PrefetchStats",
+    "device_placer",
+]

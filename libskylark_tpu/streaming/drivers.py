@@ -1,0 +1,279 @@
+"""Streaming drivers: one-pass sketching and solvers over batch sources.
+
+The out-of-core face of the sketch layer (≙ the reference's reason for
+owning streaming LIBSVM/HDFS readers, ``utility/io/libsvm_io.hpp:1495-
+1638``): every sketch here is a counter-addressed linear (or linear-then-
+pointwise) map, so ``S·A`` decomposes exactly into per-batch partial
+sketches (``SketchTransform.apply_slice``) merged by sum (COLUMNWISE) or
+concat (ROWWISE) — datasets bigger than device memory stream through in
+bounded space, with the prefetch pipeline overlapping host parse +
+host→device transfer against the sketch compute of the previous batch.
+
+Batch conventions (matching ``io.stream_libsvm`` / ``io.stream_hdf5``):
+
+- :func:`sketch` consumes plain array blocks (rows of A);
+- :func:`sketch_least_squares` and :func:`kernel_ridge` consume
+  ``(X_block, y_block)`` pairs.
+
+All three accept either an iterable or a re-openable factory
+``f(start_batch) -> iterator`` (required for checkpoint/resume — see
+``engine.as_block_factory``).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..sketch.base import Dimension
+from .engine import StreamParams, run_stream
+
+__all__ = ["sketch", "sketch_batches", "sketch_least_squares", "kernel_ridge"]
+
+
+def _result_dtype(requested, default=None):
+    if requested is not None:
+        return jnp.dtype(requested)
+    if default is not None:
+        return jnp.dtype(default)
+    import jax
+
+    return jnp.dtype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+
+
+def sketch(
+    source,
+    S,
+    dim: Dimension | str = Dimension.COLUMNWISE,
+    *,
+    ncols: int | None = None,
+    dtype=None,
+    params: StreamParams | None = None,
+    fault_plan=None,
+):
+    """One-pass ``S·A`` (COLUMNWISE) or ``A·Ωᵀ`` (ROWWISE) over row
+    blocks of A, without ever materializing A.
+
+    COLUMNWISE: blocks are consecutive row slices of the (N, m) input
+    whose row counts sum to ``S.n``; ``ncols`` (= m) sizes the (S, m)
+    accumulator up front (required — it doubles as the resume prototype).
+    Partial sketches merge by sum, then ``S.finalize_slices`` (identity
+    for linear sketches, the cos epilogue for RFT).  This path supports
+    checkpoint/resume through ``StreamParams``: a killed pass resumed
+    from its newest checkpoint is bit-for-bit the uninterrupted run.
+
+    ROWWISE: blocks are row blocks of the (m, N) input (each carries the
+    full feature axis); finished per-block sketches concatenate in
+    stream order.  The output grows with the stream, so this path keeps
+    no checkpointable fixed-shape state — ``params.checkpoint_dir`` is
+    rejected; use :func:`sketch_batches` to keep the result out-of-core
+    too.
+    """
+    dim = Dimension.of(dim)
+    params = params or StreamParams()
+    if dim is Dimension.ROWWISE:
+        if params.checkpoint_dir:
+            raise ValueError(
+                "rowwise streaming concatenates (no fixed-shape "
+                "accumulator to checkpoint); stream columnwise or drop "
+                "checkpoint_dir"
+            )
+        blocks = [
+            Z for Z in sketch_batches(source, S, params=params)
+        ]
+        if not blocks:
+            raise ValueError("empty stream: no rows to sketch")
+        return jnp.concatenate(blocks, axis=0)
+
+    if ncols is None:
+        raise ValueError(
+            "columnwise streaming needs ncols (the width m of A) to "
+            "size the (S, m) accumulator"
+        )
+    dt = _result_dtype(dtype)
+    init = {
+        "sa": jnp.zeros((S.s, int(ncols)), dt),
+        "row": np.asarray(0, np.int64),
+    }
+
+    def step(acc, block, index):
+        row = int(acc["row"])
+        k = block.shape[0]
+        part = S.apply_slice(block, row, Dimension.COLUMNWISE)
+        return {
+            "sa": acc["sa"] + part.astype(dt),
+            "row": np.asarray(row + k, np.int64),
+        }
+
+    acc, nbatches = run_stream(
+        source, step, init, params, kind="streaming_sketch",
+        fault_plan=fault_plan,
+    )
+    rows = int(acc["row"])
+    if rows != S.n:
+        raise ValueError(
+            f"stream covered {rows} rows but the sketch domain is "
+            f"{S.n}; the source and transform disagree"
+        )
+    return S.finalize_slices(acc["sa"], Dimension.COLUMNWISE)
+
+
+def sketch_batches(source, S, *, params: StreamParams | None = None):
+    """Generator of finished ROWWISE sketches, one per input block —
+    the fully out-of-core form (input AND output streamed).  Hoists the
+    transform's counter-realized operands once (``hoistable_operands``)
+    instead of re-deriving them per batch."""
+    from .engine import as_block_factory
+    from .pipeline import Prefetcher
+
+    params = params or StreamParams()
+    it = iter(as_block_factory(source)(0))
+    pf = None
+    if params.prefetch > 0:
+        pf = Prefetcher(it, depth=params.prefetch, placer=params.placer)
+        it = pf
+    elif params.placer is not None:
+        it = (params.placer(b) for b in it)
+    ops = None
+    have_ops = False
+    try:
+        for block in it:
+            if not have_ops:
+                bd = block.data.dtype if hasattr(block, "todense") else block.dtype
+                if not jnp.issubdtype(bd, jnp.floating):
+                    bd = jnp.float32
+                ops = S.hoistable_operands(bd)
+                have_ops = True
+            yield S.apply_with_operands(ops, block, Dimension.ROWWISE)
+    finally:
+        if pf is not None:
+            pf.close()
+
+
+def sketch_least_squares(
+    source,
+    S,
+    *,
+    ncols: int,
+    targets: int = 1,
+    alg: str = "qr",
+    dtype=None,
+    params: StreamParams | None = None,
+    fault_plan=None,
+):
+    """Streaming sketch-and-solve least squares: accumulate the sketched
+    system ``(S·A, S·b)`` over ``(A_block, b_block)`` batches in one
+    pass, then solve the small (s, n) problem exactly.
+
+    ≙ ``ApproximateLeastSquares`` (``nla/least_squares.hpp:42-184``) with
+    the sketch applies decomposed over row blocks — A never resident.
+    ``S`` must be a LINEAR sketch (JLT/CT/CWT/SJLT/MMT/WZT/FJLT-free
+    slices...); a feature map (RFT) would not preserve the LS geometry.
+    Returns ``(x, info)`` with ``info = {"rows", "batches"}``.
+    """
+    from ..linalg.least_squares import exact_least_squares
+
+    params = params or StreamParams()
+    dt = _result_dtype(dtype)
+    init = {
+        "sa": jnp.zeros((S.s, int(ncols)), dt),
+        "sb": jnp.zeros((S.s, int(targets)), dt),
+        "row": np.asarray(0, np.int64),
+    }
+
+    def step(acc, batch, index):
+        A_b, b_b = batch
+        row = int(acc["row"])
+        b2 = b_b[:, None] if getattr(b_b, "ndim", 1) == 1 else b_b
+        return {
+            "sa": acc["sa"]
+            + S.apply_slice(A_b, row, Dimension.COLUMNWISE).astype(dt),
+            "sb": acc["sb"]
+            + S.apply_slice(b2, row, Dimension.COLUMNWISE).astype(dt),
+            "row": np.asarray(row + A_b.shape[0], np.int64),
+        }
+
+    acc, nbatches = run_stream(
+        source, step, init, params, kind="streaming_lsq",
+        fault_plan=fault_plan,
+    )
+    rows = int(acc["row"])
+    if rows != S.n:
+        raise ValueError(
+            f"stream covered {rows} rows but the sketch domain is {S.n}"
+        )
+    SA = S.finalize_slices(acc["sa"], Dimension.COLUMNWISE)
+    SB = S.finalize_slices(acc["sb"], Dimension.COLUMNWISE)
+    X = exact_least_squares(SA, SB, alg=alg)
+    x = X[:, 0] if targets == 1 else X
+    return x, {"rows": rows, "batches": nbatches}
+
+
+def kernel_ridge(
+    source,
+    kernel,
+    lam: float,
+    s: int,
+    context,
+    *,
+    targets: int = 1,
+    krr_params=None,
+    params: StreamParams | None = None,
+    fault_plan=None,
+    dtype=None,
+):
+    """Streaming approximate KRR: per-batch feature Gram accumulation.
+
+    One pass over ``(X_block, y_block)`` batches maintains the (s, s)
+    normal equations of ``approximate_kernel_ridge``:
+
+        G += Z_bᵀ Z_b,   c += Z_bᵀ y_b,      Z_b = S(X_block)  rowwise
+
+    then solves ``(G + λI) W = c`` once.  X is never resident; the
+    feature map's counter-realized operands are hoisted once per pass.
+    Returns the same ``FeatureMapModel`` as the in-core solver (trained
+    on the same ``context`` seed it is allclose-interchangeable, modulo
+    per-batch summation order).
+    """
+    from jax.scipy.linalg import cho_factor, cho_solve
+
+    from ..ml.krr import KrrParams, _psd_gram, _tag
+    from ..ml.model import FeatureMapModel
+    from ..parallel.mesh import fully_replicated
+    from ..sketch.base import Dimension as Dim
+
+    params = params or StreamParams()
+    krr_params = krr_params or KrrParams()
+    S = kernel.create_rft(s, _tag(krr_params), context)
+    dt = _result_dtype(dtype)
+    acc_dt = jnp.promote_types(dt, jnp.float32)
+    init = {
+        "g": jnp.zeros((s, s), acc_dt),
+        "c": jnp.zeros((s, int(targets)), acc_dt),
+        "rows": np.asarray(0, np.int64),
+    }
+    ops_box = {}
+
+    def step(acc, batch, index):
+        X_b, y_b = batch
+        if "ops" not in ops_box:
+            ops_box["ops"] = S.hoistable_operands(dt)
+        Z = S.apply_with_operands(ops_box["ops"], X_b, Dim.ROWWISE)
+        y2 = y_b[:, None] if getattr(y_b, "ndim", 1) == 1 else y_b
+        return {
+            "g": acc["g"] + _psd_gram(Z.T, Z).astype(acc_dt),
+            "c": acc["c"] + (Z.T @ y2.astype(Z.dtype)).astype(acc_dt),
+            "rows": np.asarray(int(acc["rows"]) + X_b.shape[0], np.int64),
+        }
+
+    acc, nbatches = run_stream(
+        source, step, init, params, kind="streaming_krr",
+        fault_plan=fault_plan,
+    )
+    G = fully_replicated(
+        acc["g"] + jnp.asarray(lam, acc_dt) * jnp.eye(s, dtype=acc_dt)
+    )
+    W = cho_solve(cho_factor(G, lower=True), acc["c"]).astype(dt)
+    model = FeatureMapModel([S], W)
+    model.info = {"rows": int(acc["rows"]), "batches": nbatches}
+    return model

@@ -1,0 +1,189 @@
+"""The streaming accumulation engine: batches → checkpointable reduction.
+
+One engine behind every streaming driver (``sketch``, sketch-and-solve
+least squares, KRR feature accumulation): an order-preserving left fold
+
+    acc ← step_fn(acc, batch, index)        index = 0, 1, 2, ...
+
+over a batch source, adapted to the :class:`~libskylark_tpu.resilient.
+chunked.ChunkedSolver` contract so the existing ``ResilientRunner`` /
+``CheckpointStore`` machinery provides checkpoint/resume, IO retries,
+fault injection, and divergence guards unchanged.  The state pytree is
+``{"batch": int64 scalar, "acc": <driver pytree>}``; a killed pass
+resumed from its newest checkpoint re-folds the remaining batches in the
+same order, so the final accumulator is BIT-FOR-BIT identical to the
+uninterrupted run (same floating-point summation order — the counter
+contract's streaming analogue).
+
+Sources are *re-openable*: a source is either a plain iterable (single
+pass, no resume) or a callable ``factory(start_batch) -> iterator`` that
+yields batches from ``start_batch`` onward.  Factories over seekable
+storage (HDF5 row slices) can skip cheaply; line-parsed sources
+(``stream_libsvm``) re-parse and drop the prefix — resume cost is
+bounded by the skipped bytes, not by recomputation of the sketch.
+"""
+
+from __future__ import annotations
+
+from itertools import islice
+
+import numpy as np
+
+from ..resilient import ChunkedSolver, ResilientParams, ResilientRunner
+from .pipeline import Prefetcher, device_placer
+
+__all__ = ["StreamParams", "as_block_factory", "run_stream"]
+
+
+class StreamParams(ResilientParams):
+    """Runtime knobs of a streaming pass — the resilient runner's params
+    (checkpointing, retries, divergence) plus the pipeline's:
+    ``prefetch`` staged batches (0 disables the pipeline thread) and the
+    staging ``placer`` (host→device by default).
+
+    ``checkpoint_every`` counts BATCHES per checkpoint round here.
+    """
+
+    def __init__(self, *, prefetch: int = 2, placer=device_placer, **kw):
+        super().__init__(**kw)
+        self.prefetch = int(prefetch)
+        self.placer = placer
+
+
+def as_block_factory(source):
+    """Normalize a batch source to ``factory(start_batch) -> iterator``.
+
+    Callables pass through (they own the skip); iterables become a
+    single-use factory that can only start at batch 0 — fine for a fresh
+    pass, but resume needs a real factory.
+    """
+    if callable(source):
+        return source
+    state = {"used": False}
+
+    def factory(start: int):
+        if state["used"] or start:
+            raise ValueError(
+                "this source is a one-shot iterable and cannot be "
+                f"re-opened (requested start batch {start}); pass a "
+                "factory `lambda start: ...` for resumable streams"
+            )
+        state["used"] = True
+        return iter(source)
+
+    return factory
+
+
+class _Cursor:
+    """Lazily-opened, position-tracked view over the batch stream with a
+    one-item lookahead (so ``is_done`` needs no side channel) and the
+    prefetch pipeline wrapped around the remaining tail."""
+
+    def __init__(self, factory, prefetch: int, placer):
+        self._factory = factory
+        self._prefetch = prefetch
+        self._placer = placer
+        self._it = None
+        self._prefetcher = None
+        self.pos = -1  # batch index of the lookahead item
+        self.pending = None
+
+    def ensure(self, at: int):
+        if self._it is not None:
+            if self.pos != at:
+                raise RuntimeError(
+                    f"stream cursor at batch {self.pos}, state wants {at}; "
+                    "streaming passes must be driven sequentially"
+                )
+            return
+        raw = iter(self._factory(at))
+        if self._prefetch > 0:
+            self._prefetcher = Prefetcher(
+                raw, depth=self._prefetch, placer=self._placer
+            )
+            self._it = self._prefetcher
+        elif self._placer is not None:
+            self._it = (self._placer(b) for b in raw)
+        else:
+            self._it = raw
+        self.pos = at - 1
+        self.advance()
+
+    def advance(self):
+        try:
+            self.pending = next(self._it)
+        except StopIteration:
+            self.pending = None
+        self.pos += 1
+
+    @property
+    def stats(self):
+        return self._prefetcher.stats if self._prefetcher is not None else None
+
+    def close(self):
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+
+
+def skip_batches(it, k: int):
+    """Drop the first ``k`` items — the generic (re-parse) skip for
+    factories over non-seekable sources."""
+    return islice(it, k, None)
+
+
+def run_stream(
+    source,
+    step_fn,
+    init_acc,
+    params: StreamParams | None = None,
+    *,
+    kind: str = "streaming_pass",
+    metadata: dict | None = None,
+    fault_plan=None,
+):
+    """Fold ``step_fn`` over ``source`` with resilient checkpoints.
+
+    Returns ``(acc, batches)``.  ``init_acc`` must be buildable without
+    consuming the stream (fixed-shape reductions — the streaming drivers
+    know their output shapes up front), because it doubles as the resume
+    prototype the checkpoint is validated against.
+    """
+    params = params or StreamParams()
+    cursor = _Cursor(
+        as_block_factory(source), params.prefetch, params.placer
+    )
+
+    def init_state():
+        return {"batch": np.asarray(0, np.int64), "acc": init_acc}
+
+    def step_chunk(state, k):
+        b = int(state["batch"])
+        cursor.ensure(b)
+        acc = state["acc"]
+        for _ in range(k):
+            if cursor.pending is None:
+                break
+            acc = step_fn(acc, cursor.pending, b)
+            b += 1
+            cursor.advance()
+        return {"batch": np.asarray(b, np.int64), "acc": acc}
+
+    def is_done(state):
+        cursor.ensure(int(state["batch"]))
+        return cursor.pending is None
+
+    solver = ChunkedSolver(
+        init_state=init_state,
+        step_chunk=step_chunk,
+        extract_result=lambda state: (state["acc"], int(state["batch"])),
+        is_done=is_done,
+        iteration=lambda state: int(state["batch"]),
+        kind=kind,
+    )
+    meta = dict(metadata or {})
+    try:
+        return ResilientRunner(
+            solver, params, metadata=meta, fault_plan=fault_plan
+        ).run()
+    finally:
+        cursor.close()

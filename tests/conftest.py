@@ -31,8 +31,16 @@ import pytest  # noqa: E402
 # path could loop forever waiting on a checkpoint that never appears), so
 # every ``faults``-marked test gets a hard per-test alarm.  They stay
 # inside the ``-m 'not slow'`` selection on purpose: the recovery paths
-# run on every PR.
+# run on every PR.  ``streaming`` tests get the same guard for the same
+# reason — a stuck prefetch queue or an unfinished producer thread would
+# otherwise block the run forever.
 FAULTS_TIMEOUT_S = 120
+STREAMING_TIMEOUT_S = 120
+
+_TIMEOUT_MARKS = {
+    "faults": FAULTS_TIMEOUT_S,
+    "streaming": STREAMING_TIMEOUT_S,
+}
 
 
 def pytest_configure(config):
@@ -42,21 +50,33 @@ def pytest_configure(config):
         "checkpoints, transient IO); tier-1, guarded by a per-test "
         f"{FAULTS_TIMEOUT_S}s timeout",
     )
+    config.addinivalue_line(
+        "markers",
+        "streaming: out-of-core streaming engine tests (partial sketches, "
+        "prefetch pipeline, resumable passes) on small synthetic data; "
+        f"tier-1, guarded by a per-test {STREAMING_TIMEOUT_S}s timeout",
+    )
 
 
 @pytest.fixture(autouse=True)
-def _faults_timeout(request):
-    if request.node.get_closest_marker("faults") is None:
+def _marked_timeout(request):
+    limits = [
+        (name, seconds)
+        for name, seconds in _TIMEOUT_MARKS.items()
+        if request.node.get_closest_marker(name) is not None
+    ]
+    if not limits:
         yield
         return
+    name, seconds = min(limits, key=lambda kv: kv[1])
 
     def _alarm(signum, frame):
         raise TimeoutError(
-            f"faults test exceeded {FAULTS_TIMEOUT_S}s hard timeout"
+            f"{name} test exceeded {seconds}s hard timeout"
         )
 
     old = signal.signal(signal.SIGALRM, _alarm)
-    signal.alarm(FAULTS_TIMEOUT_S)
+    signal.alarm(seconds)
     try:
         yield
     finally:

@@ -70,6 +70,26 @@ def _random_bcoo(rng, shape, density=0.1):
     return jsparse.BCOO.fromdense(jnp.asarray(M)), M
 
 
+def test_capacity_suggestion_rejects_2d_mesh(rng):
+    """The 1-D capacity helper's n/p row blocks don't match the 2-D
+    grid's row-axis exchange — a silently wrong capacity would drop
+    entries, so multi-axis meshes must be refused loudly.  Meshes are
+    built directly (no make_mesh) so this runs tier-1 regardless of the
+    installed JAX's AxisType support."""
+    from jax.sharding import Mesh
+
+    from libskylark_tpu.parallel import suggest_sparse_out_capacity
+
+    S = CWT(32, 16, SketchContext(seed=43))
+    A, _ = _random_bcoo(rng, (32, 6), density=0.3)
+    devs = np.array(jax.devices())
+    with pytest.raises(ValueError, match="1-D only"):
+        suggest_sparse_out_capacity(
+            S, A, Mesh(devs.reshape(4, 2), ("r", "c"))
+        )
+    assert suggest_sparse_out_capacity(S, A, Mesh(devs, (ROWS,))) >= 1
+
+
 @pytest.mark.slow
 class TestSparseShardedSchedules:
     """P6: sharded sparse hash sketches must equal the single-device BCOO
@@ -181,6 +201,7 @@ class TestSparseOutSchedules:
         # ≤ one output entry per input nonzero (dedup can only shrink)
         assert bc.nse <= S.nnz * A.nse + 1
 
+
     def test_rowwise_matches_local(self, rng):
         from libskylark_tpu.parallel import rowwise_sharded_sparse_out
 
@@ -242,6 +263,10 @@ class TestSparseOutSchedules:
 
         mesh = default_mesh()
         p = mesh.size
+        # The capacity helper is strictly 1-D (it refuses multi-axis
+        # meshes); the 1-D schedule flattens the 2-D default mesh to p
+        # devices, so a flat p-device mesh gives the matching count.
+        flat = make_mesh((p,), (ROWS,))
         for trial in range(6):
             n = p * int(rng.integers(2, 9))
             m = int(rng.integers(1, 14))
@@ -261,7 +286,7 @@ class TestSparseOutSchedules:
                 )
             cap = (
                 None if trial % 2
-                else suggest_sparse_out_capacity(S, A, mesh)
+                else suggest_sparse_out_capacity(S, A, flat)
             )
             out = columnwise_sharded_sparse_out(S, A, mesh, capacity=cap)
             ref = S.apply(A, "columnwise")
@@ -389,7 +414,8 @@ class TestSparseOutSchedules:
         S = CWT(n, s, SketchContext(seed=47))
         from libskylark_tpu.parallel import suggest_sparse_out_capacity
 
-        need = suggest_sparse_out_capacity(S, A, mesh)
+        # helper is 1-D only; the flat mesh matches the flattened schedule
+        need = suggest_sparse_out_capacity(S, A, make_mesh((p,), (ROWS,)))
         # Tight: with one hot source block and a near-uniform hash over
         # p destinations, the exact count sits near nse/p — far under
         # the drop-proof default of nnz*nse.

@@ -82,6 +82,92 @@ def test_stream_from_source(rng):
     np.testing.assert_allclose(got, np.arange(10.0))
 
 
+def test_stream_no_trailing_newline():
+    """The last example must not be lost when the file ends mid-line
+    (the EOF chunk carries no terminator — common with hand-edited
+    files)."""
+    from libskylark_tpu.io import stream_libsvm
+
+    data = b"1 1:1.0\n2 1:2.0\n3 1:3.0"  # note: no final \n
+    batches = list(stream_libsvm(data, n_features=1, batch=2))
+    assert [len(b[1]) for b in batches] == [2, 1]
+    got = np.concatenate([np.asarray(b[1]) for b in batches])
+    np.testing.assert_allclose(got, [1, 2, 3])
+
+
+def test_stream_example_spans_chunk_boundary():
+    """chunk_bytes smaller than one line: the carry logic must stitch
+    the split line back together, never yielding a half-parsed example."""
+    from libskylark_tpu.io import stream_libsvm
+
+    lines = [
+        f"{i} 1:{i}.5 2:{i * 10}.25 3:{i * 100}.125" for i in range(7)
+    ]
+    data = ("\n".join(lines) + "\n").encode()
+    assert max(len(l) for l in lines) > 8
+    batches = list(
+        stream_libsvm(data, n_features=3, batch=3, chunk_bytes=8)
+    )
+    assert [len(b[1]) for b in batches] == [3, 3, 1]
+    X = np.concatenate([np.asarray(b[0]) for b in batches])
+    y = np.concatenate([np.asarray(b[1]) for b in batches])
+    np.testing.assert_allclose(y, np.arange(7.0))
+    np.testing.assert_allclose(X[:, 0], np.arange(7) + 0.5)
+    np.testing.assert_allclose(X[:, 2], np.arange(7) * 100 + 0.125)
+
+
+def test_stream_empty_source():
+    """An empty byte stream yields no batches (and no crash) — the
+    streaming drivers turn that into their own 'empty stream' errors."""
+    from libskylark_tpu.io import MemorySource, stream_libsvm
+
+    assert list(stream_libsvm(b"", n_features=4)) == []
+    assert list(stream_libsvm(MemorySource(b""), n_features=4)) == []
+    # whitespace/comment-only content parses to zero examples too
+    assert list(stream_libsvm(b"\n# nothing\n\n", n_features=4)) == []
+
+
+def test_stream_raw_bytes_and_memory_source_agree(tmp_path, rng):
+    """Raw bytes and an explicit MemorySource take the same path as a
+    file: identical batches from all three spellings."""
+    from libskylark_tpu.io import MemorySource, stream_libsvm
+
+    X = rng.standard_normal((9, 4))
+    X[rng.random((9, 4)) < 0.5] = 0.0
+    y = rng.standard_normal(9)
+    path = str(tmp_path / "f.libsvm")
+    write_libsvm(path, X, y)
+    data = open(path, "rb").read()
+
+    def collect(src):
+        bs = list(stream_libsvm(src, n_features=4, batch=4))
+        return (
+            np.concatenate([np.asarray(b[0]) for b in bs]),
+            np.concatenate([np.asarray(b[1]) for b in bs]),
+        )
+
+    Xf, yf = collect(path)
+    Xb, yb = collect(data)
+    Xm, ym = collect(MemorySource(data))
+    np.testing.assert_array_equal(Xb, Xf)
+    np.testing.assert_array_equal(Xm, Xf)
+    np.testing.assert_array_equal(yb, yf)
+    np.testing.assert_array_equal(ym, yf)
+    np.testing.assert_allclose(Xf, X, rtol=1e-15)
+
+
+def test_scan_libsvm_dims(tmp_path):
+    from libskylark_tpu.io import scan_libsvm_dims
+
+    (tmp_path / "f").write_text(
+        "# header comment\n1 1:1.0 7:2.0\n\n-1 3:4.0  # trailing\n2 2:1.0"
+    )
+    assert scan_libsvm_dims(tmp_path / "f") == (3, 7)
+    assert scan_libsvm_dims(b"") == (0, 0)
+    # tiny chunks: counting must survive lines split across reads
+    assert scan_libsvm_dims(b"1 1:1.0\n2 12:3.0\n", chunk_bytes=4) == (2, 12)
+
+
 def test_file_url_and_scheme_registry(tmp_path):
     from libskylark_tpu.io import (
         MemorySource,

@@ -100,6 +100,19 @@ class TestPallasSampledFJLT:
         assert not pallas_fut.supported_sampled(1024, 4096, 4096, 200)
         assert not pallas_fut.supported_sampled(7, 4096, 4096, 256)
 
+    def test_unsupported_shape_raises_value_error(self, rng):
+        """A shape the gate rejects must fail with a pointer to the
+        predicate, not an opaque TypeError from `m // None`."""
+        m, nb, s = 7, 512, 128  # no tile divides m=7
+        assert pallas_fut._tile_rows(m, nb) is None
+        x = jnp.asarray(rng.standard_normal((m, nb)).astype(np.float32))
+        d = jnp.asarray(np.sign(rng.standard_normal(nb)).astype(np.float32))
+        idx = rng.integers(0, nb, s).astype(np.int32)
+        with pytest.raises(ValueError, match="check supported_sampled"):
+            pallas_fut.rfut_rowwise_sampled(x, d, nb, idx, interpret=True)
+        with pytest.raises(ValueError, match="check supported"):
+            pallas_fut.rfut_rowwise(x, d, nb, interpret=True)
+
     def test_fused_disable_env(self, rng, monkeypatch):
         n, s, m = 512, 128, 16
         monkeypatch.setenv("SKYLARK_PALLAS_FJLT_SAMPLED", "0")
