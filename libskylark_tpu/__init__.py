@@ -17,6 +17,7 @@ from . import (
     linalg,
     ml,
     parallel,
+    plans,
     resilient,
     sketch,
     solvers,
@@ -32,6 +33,7 @@ __all__ = [
     "linalg",
     "ml",
     "parallel",
+    "plans",
     "resilient",
     "sketch",
     "solvers",

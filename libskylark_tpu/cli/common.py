@@ -6,12 +6,72 @@ import numpy as np
 
 __all__ = [
     "FILE_FORMATS",
+    "add_perf_args",
     "load_classes",
     "load_dataset",
+    "print_perf_report",
     "print_test_metrics",
     "scan_dims",
+    "setup_perf",
     "stream_dataset",
 ]
+
+
+def add_perf_args(p) -> None:
+    """The shared compilation/plan observability flags (every driver)."""
+    p.add_argument(
+        "--xla-cache-dir", default=None,
+        help="persistent XLA compilation cache directory: executables "
+             "compiled in one run (plans included) are reloaded in the "
+             "next instead of recompiled",
+    )
+    p.add_argument(
+        "--plan-stats", action="store_true",
+        help="print the sketch-plan cache counters "
+             "(hits/misses/traces/compile time) on exit",
+    )
+
+
+def setup_perf(args) -> None:
+    """Apply --xla-cache-dir before the first compilation.  Best-effort:
+    jax versions without the persistent-cache knobs just warn."""
+    if not getattr(args, "xla_cache_dir", None):
+        return
+    import warnings
+
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", args.xla_cache_dir)
+        # Cache everything: plans are often millisecond-compile but
+        # high-count, exactly what the default thresholds would skip.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization
+        warnings.warn(
+            f"--xla-cache-dir not applied ({e!r}); continuing without "
+            "the persistent compilation cache",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+
+
+def print_perf_report(args) -> None:
+    """Emit the plan-cache counter block when --plan-stats was given."""
+    if not getattr(args, "plan_stats", False):
+        return
+    from .. import plans
+
+    st = plans.stats()
+    print(
+        "plan cache: "
+        f"{st['hits']} hits / {st['misses']} misses, "
+        f"{st['traces']} traces, {st['compiles']} compiles "
+        f"({st['compile_seconds']:.3f}s), "
+        f"{st['bypasses']} bypasses, "
+        f"{st['size']}/{st['max_size']} plans resident"
+        + (f", {st['evictions']} evicted" if st["evictions"] else "")
+    )
 
 # ≙ the reference's --fileformat choices (ml/options.hpp:46-47,173-174):
 # libsvm covers LIBSVM_DENSE/LIBSVM_SPARSE (the --sparse flag picks the

@@ -13,7 +13,7 @@ import time
 
 import numpy as np
 
-from .common import FILE_FORMATS
+from .common import FILE_FORMATS, add_perf_args, print_perf_report, setup_perf
 
 _ALGS = {0: "exact", 1: "faster", 2: "approximate", 3: "sketched", 4: "largescale"}
 
@@ -74,12 +74,14 @@ def main(argv=None) -> int:
                         "KRR only; X is never resident)")
     p.add_argument("--batch-rows", type=int, default=4096,
                    help="rows per streamed batch (with --stream)")
+    add_perf_args(p)
     args = p.parse_args(argv)
 
     import jax
 
     if args.x64:
         jax.config.update("jax_enable_x64", True)
+    setup_perf(args)
     import jax.numpy as jnp
 
     from ..core.context import SketchContext
@@ -157,6 +159,7 @@ def main(argv=None) -> int:
         )
         Xtj = Xt if is_sparse else jnp.asarray(Xt)
         print_test_metrics(model, Xtj, yt, args.regression)
+    print_perf_report(args)
     return 0
 
 
@@ -215,6 +218,7 @@ def _stream_main(args, is_sparse: bool) -> int:
         )
         Xtj = Xt if is_sparse else jnp.asarray(Xt)
         print_test_metrics(model, Xtj, yt, args.regression)
+    print_perf_report(args)
     return 0
 
 

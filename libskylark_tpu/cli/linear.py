@@ -10,6 +10,8 @@ import time
 
 import numpy as np
 
+from .common import add_perf_args, print_perf_report, setup_perf
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="skylark-linear")
@@ -36,12 +38,14 @@ def main(argv=None) -> int:
     p.add_argument("--resume", action="store_true",
                    help="resume a streamed pass from the newest valid "
                         "checkpoint in --checkpoint-dir")
+    add_perf_args(p)
     args = p.parse_args(argv)
 
     import jax
 
     if args.x64:
         jax.config.update("jax_enable_x64", True)
+    setup_perf(args)
     import jax.numpy as jnp
 
     from ..core.context import SketchContext
@@ -79,6 +83,7 @@ def main(argv=None) -> int:
           f"residual {r:.6e}")
     np.save(args.solution, x)
     print(f"Solution -> {args.solution}")
+    print_perf_report(args)
     return 0
 
 
@@ -129,6 +134,7 @@ def _stream_main(args) -> int:
           f"{info['batches']} batches) in {dt:.3f}s")
     np.save(args.solution, x)
     print(f"Solution -> {args.solution}")
+    print_perf_report(args)
     return 0
 
 

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.linalg import cho_factor, cho_solve, solve_triangular
 
+from .. import plans
 from ..core.context import SketchContext
 from ..core.params import Params
 from ..sketch.base import Dimension, create_sketch
@@ -103,8 +104,10 @@ def approximate_least_squares(
     s = params.sketch_size or min(4 * n, m)
     stype = params.sketch_type or ("CWT" if is_sparse else "FJLT")
     S = create_sketch(stype, m, s, context)
-    SA = S.apply(A, Dimension.COLUMNWISE)
-    SB = S.apply(B, Dimension.COLUMNWISE)
+    # Plan-cached applies: repeated sketch-and-solve calls at the same
+    # shape (parameter sweeps, restarts) reuse one fused executable.
+    SA = plans.apply(S, A, Dimension.COLUMNWISE)
+    SB = plans.apply(S, B, Dimension.COLUMNWISE)
     X = exact_least_squares(SA, SB, alg=alg)
     return X[:, 0] if squeeze else X
 

@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.linalg import cho_factor, cho_solve, solve_triangular
 
+from .. import plans
 from ..core.context import SketchContext
 from ..core.params import Params
 from ..parallel.mesh import fully_replicated
@@ -133,7 +134,7 @@ def approximate_kernel_ridge(
     X = _maybe_sparse(X)
     Y2, _ = _as2d(Y)
     S = kernel.create_rft(s, _tag(params), context)
-    Z = S.apply(X, Dimension.ROWWISE)  # (n, s)
+    Z = plans.apply(S, X, Dimension.ROWWISE)  # (n, s)
     if params.sketched_rr:
         return _solve_sketched_ridge(S, Z, Y2, lam, s, context, params)
     G = fully_replicated(_psd_gram(Z.T, Z) + lam * jnp.eye(s, dtype=Z.dtype))
@@ -150,8 +151,8 @@ def _solve_sketched_ridge(S, Z, Y2, lam, s, context, params):
     t = params.sketch_size if params.sketch_size != -1 else min(4 * s, n)
     sk_type = "CWT" if params.fast_sketch else "FJLT"
     R = create_sketch(sk_type, n, t, context)
-    SZ = R.apply(Z, Dimension.COLUMNWISE)  # (t, s)
-    SY = R.apply(Y2, Dimension.COLUMNWISE)  # (t, k)
+    SZ = plans.apply(R, Z, Dimension.COLUMNWISE)  # (t, s)
+    SY = plans.apply(R, Y2, Dimension.COLUMNWISE)  # (t, k)
     G = fully_replicated(_psd_gram(SZ.T, SZ) + lam * jnp.eye(s, dtype=Z.dtype))
     W = cho_solve(cho_factor(G, lower=True), SZ.T @ SY).astype(Z.dtype)
     return FeatureMapModel([S], W)
@@ -174,7 +175,7 @@ class _FeatureMapPrecond:
 
     def __init__(self, kernel, lam, X, s, context, params):
         S = kernel.create_rft(s, _tag(params), context)
-        U = S.apply(jnp.asarray(X), Dimension.ROWWISE).T  # (s, n)
+        U = plans.apply(S, jnp.asarray(X), Dimension.ROWWISE).T  # (s, n)
         lam = jnp.asarray(lam, U.dtype)
         C = fully_replicated(
             jnp.eye(s, dtype=U.dtype) + _psd_gram(U, U.T) / lam
@@ -284,7 +285,9 @@ def large_scale_kernel_ridge(
     # only the small per-chunk Cholesky factors are cached,
     # krr.hpp:608-660).  Peak extra memory = one (n, max chunk) block.
     def chunk_Z(c):
-        return maps[c].apply(X, Dimension.ROWWISE).T  # (sz, n)
+        # Plan-cached: every sweep re-derives this chunk's features, so
+        # the fused executable compiled on sweep 1 serves all of them.
+        return plans.apply(maps[c], X, Dimension.ROWWISE).T  # (sz, n)
 
     # First sweep builds the cached factors (krr.hpp:608-660); the first
     # chunk also establishes the feature dtype for the state arrays.

@@ -1,0 +1,46 @@
+"""Compiled sketch-apply plans: fused executables, bucketing, donation.
+
+The perf layer between the sketch transforms and their consumers (see
+``docs/performance.md``):
+
+- :func:`apply` — plan-cached full apply, bitwise identical to eager;
+- :func:`accumulate_slice` / :func:`apply_rowwise_bucketed` — the
+  bucketed, donation-aware streaming steps;
+- :func:`stats` / :func:`reset_stats` / :func:`clear` — the process-wide
+  plan cache and its hit/miss/trace/compile counters;
+- ``SKYLARK_NO_PLANS=1`` turns the whole layer into a pass-through.
+"""
+
+from .bucketing import bucket_ladder, bucket_rows, pad_rows
+from .cache import PLAN_CACHE, clear, reset_stats, set_cache_size, stats
+from .plan import (
+    SketchPlan,
+    accumulate_slice,
+    apply,
+    apply_rowwise_bucketed,
+    copy_for_donation,
+    donating_jit,
+    donation_enabled,
+    enabled,
+    pad_rows_to_bucket,
+)
+
+__all__ = [
+    "apply",
+    "accumulate_slice",
+    "apply_rowwise_bucketed",
+    "bucket_ladder",
+    "bucket_rows",
+    "pad_rows",
+    "pad_rows_to_bucket",
+    "copy_for_donation",
+    "donating_jit",
+    "donation_enabled",
+    "enabled",
+    "SketchPlan",
+    "PLAN_CACHE",
+    "stats",
+    "reset_stats",
+    "clear",
+    "set_cache_size",
+]

@@ -1,0 +1,86 @@
+"""Batch bucketing: a small geometric ladder of row counts.
+
+Streaming sources yield ragged batches (a short final block, IO-sized
+reads, resumed tails), and ``jax.jit`` keys its executables on concrete
+shapes — so the naive planned streaming pass compiles one executable per
+distinct batch size.  Padding every batch's row count up to a small
+geometric ladder caps the executable count at ``len(ladder)`` while
+bounding the padding waste by the ladder's step ratio.
+
+The ladder interleaves ``8·2^i`` and ``12·2^i`` (8, 12, 16, 24, 32, 48,
+64, 96, ...): consecutive rungs are within 1.5x, so padded work is at
+most 50% (usually ~25%) over the true row count, and the rung set for
+any realistic batch range stays below ~20 entries.
+
+Padding is exact for the plan kernels that consume it: COLUMNWISE slice
+kernels zero out-of-domain operand windows (see
+``SketchTransform.apply_slice_kernel``) and padded input rows are zero,
+so padded contributions are exactly 0; ROWWISE applies are row-
+independent maps whose padded output rows are sliced (or masked) away.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["bucket_ladder", "bucket_rows", "pad_rows"]
+
+_BASES = (8, 12)
+_MAX_RUNG = 1 << 30
+
+
+def bucket_ladder(max_rows: int | None = None) -> tuple[int, ...]:
+    """The rung set, ascending; truncated to the first rung >= ``max_rows``
+    when given (the rungs a stream of batches up to that size can use)."""
+    rungs = []
+    scale = 1
+    while scale * _BASES[0] <= _MAX_RUNG:
+        for b in _BASES:
+            rungs.append(b * scale)
+        scale *= 2
+    rungs = tuple(sorted(rungs))
+    if max_rows is None:
+        return rungs
+    out = []
+    for r in rungs:
+        out.append(r)
+        if r >= max_rows:
+            break
+    return tuple(out)
+
+
+def bucket_rows(k: int, gates: tuple[int, ...] = ()) -> int:
+    """Smallest rung >= ``k``.
+
+    ``gates`` are batch-size thresholds at which a transform switches
+    algorithms (e.g. the hash sketches' one-hot-vs-scatter gate at 16
+    rows): when padding ``k`` up to the rung would cross a gate, the
+    batch is left unpadded so the planned batch takes the same algorithm
+    — and produces the same bits — as the eager ragged apply.  The few
+    in-between sizes cost one extra executable each, bounded by the gate
+    count.
+    """
+    k = int(k)
+    if k <= 0:
+        raise ValueError(f"bucket_rows needs a positive row count, got {k}")
+    kb = k if k > _MAX_RUNG else min(r for r in bucket_ladder() if r >= k)
+    for g in gates:
+        if k < g <= kb:
+            return k
+    return kb
+
+
+def pad_rows(block, kb: int):
+    """Zero-pad ``block``'s leading axis up to ``kb`` rows (host-side
+    ``np.pad`` for numpy inputs so the device transfer is already
+    bucket-shaped; ``jnp.pad`` for device arrays)."""
+    k = block.shape[0]
+    if k == kb:
+        return block
+    if k > kb:
+        raise ValueError(f"block has {k} rows, bucket only {kb}")
+    if isinstance(block, np.ndarray):
+        return np.pad(block, ((0, kb - k),) + ((0, 0),) * (block.ndim - 1))
+    import jax.numpy as jnp
+
+    return jnp.pad(block, ((0, kb - k),) + ((0, 0),) * (block.ndim - 1))

@@ -1,0 +1,138 @@
+"""The process-wide LRU plan cache and its observability counters.
+
+One cache for the whole process (≙ the reference's per-transform apply
+specializations being compiled once per binary): plans are keyed on the
+*serialized* sketch — ``SketchTransform.to_json()`` is ~100 bytes and
+fully determines the counter streams — plus the abstract input signature
+``(dim, shape, dtype, sharding)`` and the donation flag, so two sketch
+objects reconstructed from the same JSON (a solver re-run, a model
+reload, every sweep of a sketch-and-solve loop) share one executable.
+
+Counters (``stats()``):
+
+- ``hits`` / ``misses``: cache lookups by outcome (a miss builds + traces
+  a new plan);
+- ``evictions``: plans dropped by the LRU bound
+  (``SKYLARK_PLAN_CACHE_SIZE``, default 128);
+- ``traces``: total jit traces executed by plan functions — the
+  retrace-guard metric (a healthy streaming pass traces once per bucket,
+  never once per batch);
+- ``compiles`` / ``compile_seconds``: first-call executions per plan and
+  the wall clock they took (trace + XLA compile + first run);
+- ``bypasses``: planned entry points that fell back to the eager apply
+  (plans disabled, tracer inputs, sparse blocks, ...).
+
+All counters are monotone non-decreasing for the life of the process
+(``reset_stats()`` zeroes them; ``clear()`` also drops the plans).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+__all__ = ["stats", "reset_stats", "clear", "set_cache_size", "PlanCache"]
+
+
+def _default_size() -> int:
+    try:
+        return max(1, int(os.environ.get("SKYLARK_PLAN_CACHE_SIZE", "128")))
+    except ValueError:
+        return 128
+
+
+class PlanCache:
+    """OrderedDict-backed LRU of compiled plans + the counter block."""
+
+    def __init__(self, max_size: int | None = None):
+        self._lock = threading.RLock()
+        self._plans: OrderedDict = OrderedDict()
+        self.max_size = max_size if max_size is not None else _default_size()
+        self._counters = self._zero()
+
+    @staticmethod
+    def _zero() -> dict:
+        return {
+            "hits": 0,
+            "misses": 0,
+            "evictions": 0,
+            "traces": 0,
+            "compiles": 0,
+            "compile_seconds": 0.0,
+            "bypasses": 0,
+        }
+
+    def bump(self, counter: str, amount=1) -> None:
+        with self._lock:
+            self._counters[counter] += amount
+
+    def get_or_build(self, key, builder):
+        """Return the plan under ``key``, building (and LRU-inserting) it
+        with ``builder()`` on a miss."""
+        with self._lock:
+            plan = self._plans.get(key)
+            if plan is not None:
+                self._counters["hits"] += 1
+                self._plans.move_to_end(key)
+                return plan
+            self._counters["misses"] += 1
+        # Build outside the lock (builders may trip jax machinery);
+        # double-insert under contention just wastes one builder call.
+        plan = builder()
+        with self._lock:
+            existing = self._plans.get(key)
+            if existing is not None:
+                return existing
+            self._plans[key] = plan
+            while len(self._plans) > self.max_size:
+                self._plans.popitem(last=False)
+                self._counters["evictions"] += 1
+        return plan
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._counters)
+            out["compile_seconds"] = round(out["compile_seconds"], 6)
+            out["size"] = len(self._plans)
+            out["max_size"] = self.max_size
+            return out
+
+    def reset_stats(self) -> None:
+        with self._lock:
+            self._counters = self._zero()
+
+    def clear(self) -> None:
+        with self._lock:
+            self._plans.clear()
+            self._counters = self._zero()
+
+    def set_max_size(self, n: int) -> None:
+        with self._lock:
+            self.max_size = max(1, int(n))
+            while len(self._plans) > self.max_size:
+                self._plans.popitem(last=False)
+                self._counters["evictions"] += 1
+
+
+PLAN_CACHE = PlanCache()
+
+
+def stats() -> dict:
+    """Snapshot of the plan-cache counters (see module docstring)."""
+    return PLAN_CACHE.stats()
+
+
+def reset_stats() -> None:
+    """Zero the counters (the compiled plans stay cached)."""
+    PLAN_CACHE.reset_stats()
+
+
+def clear() -> None:
+    """Drop every cached plan and zero the counters."""
+    PLAN_CACHE.clear()
+
+
+def set_cache_size(n: int) -> None:
+    """Adjust the LRU bound (evicting oldest plans if shrinking)."""
+    PLAN_CACHE.set_max_size(n)

@@ -98,6 +98,15 @@ class SketchTransform(abc.ABC):
 
     sketch_type: ClassVar[str] = "Abstract"
 
+    # Batch sizes at which the apply switches algorithms (bucketed plans
+    # must not pad across one — the planned batch has to take the same
+    # code path, and produce the same bits, as the eager ragged apply).
+    batch_size_gates: ClassVar[tuple] = ()
+
+    # True when apply_slice_kernel is implemented (jit-safe traced-start
+    # COLUMNWISE partials — the enabler for bucketed streaming plans).
+    supports_slice_kernel: ClassVar[bool] = False
+
     def __init__(self, n: int, s: int, context: SketchContext):
         if n <= 0 or s <= 0:
             raise ValueError(f"sketch dims must be positive, got N={n}, S={s}")
@@ -115,6 +124,16 @@ class SketchTransform(abc.ABC):
 
     def __call__(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
         return self.apply(A, dim)
+
+    def apply_planned(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
+        """Plan-aware apply: route through the process-wide plan cache
+        (one fused jit executable per ``(sketch JSON, dim, shape, dtype,
+        sharding)`` — bitwise identical to :meth:`apply`; see
+        ``libskylark_tpu.plans``).  ``SKYLARK_NO_PLANS=1`` makes this a
+        plain eager :meth:`apply`."""
+        from .. import plans
+
+        return plans.apply(self, A, dim)
 
     # -- partial-sketch protocol (streaming / out-of-core) -------------------
     #
@@ -175,6 +194,24 @@ class SketchTransform(abc.ABC):
             f"{self.sketch_type} has no columnwise partial-sketch rule; "
             "stream ROWWISE, or use a dense (JLT/CT), hash "
             "(CWT/SJLT/MMT/WZT), or RFT transform"
+        )
+
+    def apply_slice_kernel(self, A_block, start):
+        """jit-safe COLUMNWISE partial: like the COLUMNWISE
+        :meth:`apply_slice` but ``start`` may be a TRACED scalar (< 2^32
+        — the counter-window offset contract) and the window may run
+        past the sketch domain: out-of-domain operand entries are zeroed
+        inside the kernel, so a zero-padded ``A_block`` contributes
+        exactly the in-domain partial.  This is what lets the plan layer
+        compile ONE executable per bucket that serves every ragged
+        streaming batch.  No host-side bounds check (start is traced);
+        implemented by the dense, hash, and RFT engines
+        (``supports_slice_kernel``)."""
+        from ..utils.exceptions import UnsupportedError
+
+        raise UnsupportedError(
+            f"{self.sketch_type} has no jit-safe slice kernel; planned "
+            "streaming falls back to the eager apply_slice path"
         )
 
     def finalize_slices(self, acc, dim: Dimension | str = Dimension.COLUMNWISE):

@@ -22,7 +22,10 @@ from __future__ import annotations
 
 from typing import Any
 
+import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.experimental import sparse as jsparse
 
 from ..core.context import SketchContext
 from ..core.random import sample_window
@@ -108,16 +111,44 @@ class DenseSketch(SketchTransform):
             return _matmul(w, A_block)
         return _matmul(w, A_block.astype(dtype))
 
+    supports_slice_kernel = True
+
+    def apply_slice_kernel(self, A_block, start):
+        """jit-safe COLUMNWISE partial with TRACED ``start`` (the P5
+        counter window addresses traced offsets exactly); columns past
+        the sketch domain are zeroed so a bucket-padded block overruns
+        N with contribution exactly 0 (the out-of-domain stream could
+        hold non-finite draws — inf·0 would poison the sum)."""
+        k = A_block.shape[0]
+        dtype = A_block.dtype
+        if not jnp.issubdtype(dtype, jnp.floating):
+            dtype = jnp.float32
+        w = self.realize(dtype, offset=(0, start), shape=(self.s, k))
+        valid = start + jnp.arange(k, dtype=jnp.int32) < self.n
+        w = jnp.where(valid[None, :], w, jnp.zeros((), dtype))
+        return _matmul(w, A_block.astype(dtype))
+
     def hoistable_operands(self, dtype):
         """The realized (S, N) Omega, for streaming consumers to hoist
         out of panel loops (see SketchTransform.hoistable_operands);
-        None on the panel-blocked path (no single realized Omega)."""
+        None on the panel-blocked path (no single realized Omega).
+        Memoized per dtype — sketches are immutable, so the realization
+        never invalidates.  Mid-trace calls (the streaming-KRR chunk
+        programs realize W inside their own jit) skip the cache both
+        ways: a cached concrete Omega returned into a trace would be
+        baked into the caller's executable as a constant."""
         if self.n * self.s > MAX_REALIZE_ELEMENTS:
             return None
         dtype = jnp.dtype(dtype)
         if not jnp.issubdtype(dtype, jnp.floating):
             dtype = jnp.dtype(jnp.float32)
-        return self.realize(dtype)
+        if not jax.core.trace_state_clean():
+            return self.realize(dtype)
+        cache = self.__dict__.setdefault("_hoist_cache", {})
+        hit = cache.get(dtype.name)
+        if hit is None:
+            hit = cache[dtype.name] = self.realize(dtype)
+        return hit
 
     def apply_with_operands(
         self, ops, A, dim: Dimension | str = Dimension.COLUMNWISE
@@ -168,9 +199,6 @@ class DenseSketch(SketchTransform):
         accumulate — peak extra memory is one (S, panel) window.  Equal
         panels run in a ``fori_loop`` (one traced body regardless of
         panel count); a ragged remainder panel is handled outside."""
-        import jax
-        from jax import lax
-
         panel = max(1, MAX_REALIZE_ELEMENTS // self.s)
         nfull = self.n // panel
         rem0 = nfull * panel
@@ -204,8 +232,6 @@ class DenseSketch(SketchTransform):
 
 def _matmul(x, y):
     """Dense@dense or mixed dense/BCOO matmul (≙ base::Gemm dispatch)."""
-    from jax.experimental import sparse as jsparse
-
     if isinstance(x, jsparse.BCOO) or isinstance(y, jsparse.BCOO):
         return x @ y
     return jnp.matmul(x, y)

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.context import SketchContext
+from ..core.precision import bf16_split3
 from .base import Dimension, SketchTransform, register_sketch
 from .fut import RFUT
 from .sampling import UST
@@ -251,8 +252,6 @@ class FJLT(SketchTransform):
         if dtype == jnp.bfloat16:
             out = mm(A2, G16 if G16 is not None else self._srht_matrix(dtype))
         elif dtype == jnp.float32:
-            from ..core.precision import bf16_split3
-
             if G16 is None:
                 G16 = self._srht_matrix(jnp.bfloat16)  # ±1: exact in bf16
             # Bit-mask split (NOT astype round-trips — XLA's excess-

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.context import SketchContext
+from ..core.precision import bf16_split3
 from ..core.random import chi2_lanes, sample
 from .base import Dimension, SketchTransform, register_sketch
 from .fut import next_pow2, wht
@@ -198,8 +199,6 @@ class FastRFT(SketchTransform):
         """V = W·X (or X·Wᵀ rowwise) on the MXU; bf16 inputs take one
         bf16 matmul, f32 a 4-pass bf16 split (A_hi/lo/lo2 × W_hi plus
         A_hi × W_lo — the W_lo·A_lo tail is ~2^-16-relative, dropped)."""
-        from ..core.precision import bf16_split3
-
         W, sh = ops if ops is not None else (self._realized_w(), None)
         # rowwise: X (m, n)·Wᵀ → contract X₁ with W₁; columnwise:
         # W (S, n)·X (n, m) → contract W₁ with X₀.

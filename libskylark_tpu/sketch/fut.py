@@ -21,6 +21,7 @@ from __future__ import annotations
 from functools import lru_cache
 
 import jax.numpy as jnp
+import jax.scipy.fft as jfft
 import numpy as np
 
 from ..core.context import SketchContext
@@ -105,8 +106,6 @@ def wht(x, axis: int = 0):
 def dct(x, axis: int = 0):
     """Orthonormal DCT-II (≙ FFTW ``REDFT10`` with ortho scaling,
     ``utility/fft/fftw_futs.h:118-126``)."""
-    import jax.scipy.fft as jfft
-
     return jfft.dct(x, type=2, norm="ortho", axis=axis)
 
 

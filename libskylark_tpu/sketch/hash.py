@@ -31,6 +31,7 @@ import numpy as np
 from jax.experimental import sparse as jsparse
 
 from ..core.context import SketchContext
+from ..core.precision import bf16_split3
 from ..core.random import sample
 from . import pallas_scatter
 from .base import Dimension, SketchTransform, register_sketch
@@ -140,6 +141,12 @@ class HashSketch(SketchTransform):
     """
 
     value_dist: str = "rademacher"
+
+    # _apply_dense switches algorithm (one-hot matmul vs scatter) at
+    # batch 16; plan bucketing must not pad a thin batch across it, or
+    # the planned result would take a different (non-bit-identical)
+    # code path than the eager apply of the same block.
+    batch_size_gates = (16,)
 
     def __init__(self, n: int, s: int, context: SketchContext, nnz: int = 1):
         if nnz < 1:
@@ -266,6 +273,31 @@ class HashSketch(SketchTransform):
             )
         return out
 
+    supports_slice_kernel = True
+
+    def apply_slice_kernel(self, A_block, start):
+        """jit-safe COLUMNWISE partial with TRACED ``start``: the same
+        per-hash windowed ``segment_sum`` as ``_apply_slice_columnwise``
+        (the ``(static, traced)`` window split keeps the 64-bit counter
+        base exact), with values past the sketch domain zeroed — an
+        out-of-domain counter stream can hold non-finite draws (WZT's
+        1/Exp), and inf·0 from a padded row would poison the sum."""
+        k = A_block.shape[0]
+        dtype = A_block.dtype
+        if not jnp.issubdtype(dtype, jnp.floating):
+            dtype = jnp.float32
+        valid = start + jnp.arange(k, dtype=jnp.int32) < self.n
+        out = jnp.zeros((self.s, A_block.shape[1]), dtype)
+        A_block = A_block.astype(dtype)
+        for h in range(self.nnz):
+            b = self.buckets((h * self.n, start), k)
+            v = self.values(dtype, (h * self.n, start), k)
+            v = jnp.where(valid, v, jnp.zeros((), dtype))
+            out = out + jax.ops.segment_sum(
+                v[:, None] * A_block, b, num_segments=self.s
+            )
+        return out
+
     # Above this many (S·N) entries the materialized one-hot hashing
     # matrix no longer pays for itself; fall back to scatter-add.
     _ONEHOT_LIMIT = 1 << 27
@@ -361,8 +393,6 @@ class HashSketch(SketchTransform):
         if dtype == jnp.bfloat16:
             out = mm(X.astype(jnp.bfloat16))
         else:
-            from ..core.precision import bf16_split3
-
             hi, lo, lo2 = bf16_split3(X.astype(jnp.float32))
             out = mm(hi) + mm(lo) + mm(lo2)
         return out.T if dim is Dimension.COLUMNWISE else out
@@ -388,16 +418,29 @@ class HashSketch(SketchTransform):
     def hoistable_operands(self, dtype):
         """The bf16-exact one-hot matrices (sign matrix for CWT/SJLT,
         per-hash (P01, v) pairs for MMT/WZT) — the O(N·S) build a
-        streaming consumer should not repeat per panel visit."""
+        streaming consumer should not repeat per panel visit.  Memoized
+        per dtype (sketches are immutable); mid-trace calls skip the
+        cache both ways — a cached concrete matrix returned into a trace
+        would be baked into the caller's executable as a constant."""
         dt = jnp.dtype(dtype)
         if dt.type not in (jnp.bfloat16, jnp.float32):
             return None
         if self.n * self.s > self._ONEHOT_LIMIT:
             return None
-        c = self._sign_scale()
-        if c is not None:
-            return ("sign", c, self._sign_matrix_bf16(c))
-        return ("scaled", self._scaled_pairs())
+
+        def build():
+            c = self._sign_scale()
+            if c is not None:
+                return ("sign", c, self._sign_matrix_bf16(c))
+            return ("scaled", self._scaled_pairs())
+
+        if not jax.core.trace_state_clean():
+            return build()
+        cache = self.__dict__.setdefault("_hoist_cache", {})
+        hit = cache.get(dt.name)
+        if hit is None:
+            hit = cache[dt.name] = build()
+        return hit
 
     def _scaled_pairs(self):
         """Per-hash (0/1 bucket matrix in bf16, value row) pairs — the

@@ -22,6 +22,8 @@ fallback — unnecessary on TPU, the VPU does cos at full throughput).
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -42,6 +44,23 @@ __all__ = [
 ]
 
 _TWO_PI = 2.0 * np.pi
+
+
+@partial(jax.jit, static_argnames=("outscale", "columnwise"))
+def _epilogue_kernel(WX, shifts, scales, *, outscale, columnwise):
+    """The feature-map epilogue as one compiled kernel (``scales`` may be
+    None — it drops out of the pytree).  Both the eager apply and the
+    plan layer's fused executables inline this same chain, keeping them
+    bit-identical."""
+    if columnwise:
+        if scales is not None:
+            WX = WX * (scales[:, None] if WX.ndim > 1 else scales)
+        WX = WX + (shifts[:, None] if WX.ndim > 1 else shifts)
+    else:
+        if scales is not None:
+            WX = WX * scales
+        WX = WX + shifts
+    return jnp.asarray(outscale, WX.dtype) * jnp.cos(WX)
 
 
 class RFT(SketchTransform):
@@ -66,15 +85,27 @@ class RFT(SketchTransform):
         self._shift_base = context.reserve(s)
 
     def shifts(self, dtype=jnp.float32):
-        return sample(
-            "uniform",
-            self._seed,
-            self._shift_base,
-            self.s,
-            dtype=dtype,
-            low=0.0,
-            high=_TWO_PI,
-        )
+        """The S phase shifts, memoized per dtype as a CONCRETE array
+        (computed eagerly even when called mid-trace, where it enters
+        the trace as a tiny (S,) constant).  Concreteness matters beyond
+        speed: regenerated inside a jit fusion, the uniform conversion's
+        ``bits·scale + low`` contracts with the epilogue's add into an
+        FMA, and the planned apply would drift a ulp from eager."""
+        dtype = jnp.dtype(dtype)
+        cache = self.__dict__.setdefault("_shift_cache", {})
+        hit = cache.get(dtype.name)
+        if hit is None:
+            with jax.ensure_compile_time_eval():
+                hit = cache[dtype.name] = sample(
+                    "uniform",
+                    self._seed,
+                    self._shift_base,
+                    self.s,
+                    dtype=dtype,
+                    low=0.0,
+                    high=_TWO_PI,
+                )
+        return hit
 
     def scales(self, dtype=jnp.float32):
         """Per-feature scaling; identity unless a subclass overrides
@@ -87,19 +118,19 @@ class RFT(SketchTransform):
         return self._epilogue(WX, dim)
 
     def _epilogue(self, WX, dim: Dimension):
-        """outscale · cos(scales ⊙ WX + shifts)."""
+        """outscale · cos(scales ⊙ WX + shifts) — via the shared jitted
+        kernel so the eager and planned paths run the SAME fused
+        elementwise chain (op-by-op eager dispatch skips the FMA
+        contraction a jit fusion applies to ``WX·scales + shifts``, and
+        the two would differ by a ulp)."""
         dtype = WX.dtype
-        shifts = self.shifts(dtype)
-        scales = self.scales(dtype)
-        if dim is Dimension.COLUMNWISE:
-            if scales is not None:
-                WX = WX * scales[:, None] if WX.ndim > 1 else WX * scales
-            WX = WX + (shifts[:, None] if WX.ndim > 1 else shifts)
-        else:
-            if scales is not None:
-                WX = WX * scales
-            WX = WX + shifts
-        return jnp.asarray(self.outscale, dtype) * jnp.cos(WX)
+        return _epilogue_kernel(
+            WX,
+            self.shifts(dtype),
+            self.scales(dtype),
+            outscale=self.outscale,
+            columnwise=dim is Dimension.COLUMNWISE,
+        )
 
     def _apply_slice_columnwise(self, A_block, start: int):
         """Partial W·A over the coordinate block: the LINEAR half of the
@@ -107,6 +138,14 @@ class RFT(SketchTransform):
         engine; the nonlinear cos epilogue must wait for the full sum and
         runs in :meth:`finalize_slices`."""
         return self._underlying._apply_slice_columnwise(A_block, start)
+
+    supports_slice_kernel = True
+
+    def apply_slice_kernel(self, A_block, start):
+        """jit-safe linear half with traced ``start`` — same delegation
+        as :meth:`_apply_slice_columnwise` (the cos epilogue still runs
+        in :meth:`finalize_slices` once the slice-sums are merged)."""
+        return self._underlying.apply_slice_kernel(A_block, start)
 
     def finalize_slices(self, acc, dim: Dimension | str = Dimension.COLUMNWISE):
         """COLUMNWISE slice-sums hold the merged W·A — apply the
@@ -206,10 +245,18 @@ class MaternRFT(RFT):
         self._scales_base = context.reserve(s)
 
     def scales(self, dtype=jnp.float32):
-        two_nu = int(round(2 * self.nu))
-        # χ²_{2ν} per feature row: sum over 2ν independent lanes.
-        chi2 = chi2_lanes(self._seed, self._scales_base, self.s, two_nu, dtype)
-        return jnp.sqrt(2.0 * self.nu / chi2)
+        dtype = jnp.dtype(dtype)
+        cache = self.__dict__.setdefault("_scale_cache", {})
+        hit = cache.get(dtype.name)
+        if hit is None:
+            with jax.ensure_compile_time_eval():
+                two_nu = int(round(2 * self.nu))
+                # χ²_{2ν} per feature row: sum over 2ν independent lanes.
+                chi2 = chi2_lanes(
+                    self._seed, self._scales_base, self.s, two_nu, dtype
+                )
+                hit = cache[dtype.name] = jnp.sqrt(2.0 * self.nu / chi2)
+        return hit
 
     def _param_dict(self):
         return {"nu": self.nu, "l": self.l}

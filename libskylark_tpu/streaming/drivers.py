@@ -25,10 +25,20 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from .. import plans
 from ..sketch.base import Dimension
 from .engine import StreamParams, run_stream
+from .pipeline import BucketedBatch
 
 __all__ = ["sketch", "sketch_batches", "sketch_least_squares", "kernel_ridge"]
+
+
+def _unwrap(block):
+    """(raw_block, true_rows) — transparent over ``bucketed_placer``'s
+    host-padded batches."""
+    if isinstance(block, BucketedBatch):
+        return block.block, int(block.true_rows)
+    return block, int(block.shape[0])
 
 
 def _result_dtype(requested, default=None):
@@ -98,10 +108,11 @@ def sketch(
 
     def step(acc, block, index):
         row = int(acc["row"])
-        k = block.shape[0]
-        part = S.apply_slice(block, row, Dimension.COLUMNWISE)
+        block, k = _unwrap(block)
         return {
-            "sa": acc["sa"] + part.astype(dt),
+            "sa": plans.accumulate_slice(
+                S, acc["sa"], block, row, true_rows=k
+            ),
             "row": np.asarray(row + k, np.int64),
         }
 
@@ -120,9 +131,11 @@ def sketch(
 
 def sketch_batches(source, S, *, params: StreamParams | None = None):
     """Generator of finished ROWWISE sketches, one per input block —
-    the fully out-of-core form (input AND output streamed).  Hoists the
-    transform's counter-realized operands once (``hoistable_operands``)
-    instead of re-deriving them per batch."""
+    the fully out-of-core form (input AND output streamed).  Each block
+    goes through a bucketed plan (``plans.apply_rowwise_bucketed``): the
+    counter-realized operands are hoisted once per process, ragged batch
+    sizes pad up to the bucket ladder, and one executable per bucket
+    serves the whole stream."""
     from .engine import as_block_factory
     from .pipeline import Prefetcher
 
@@ -134,17 +147,10 @@ def sketch_batches(source, S, *, params: StreamParams | None = None):
         it = pf
     elif params.placer is not None:
         it = (params.placer(b) for b in it)
-    ops = None
-    have_ops = False
     try:
         for block in it:
-            if not have_ops:
-                bd = block.data.dtype if hasattr(block, "todense") else block.dtype
-                if not jnp.issubdtype(bd, jnp.floating):
-                    bd = jnp.float32
-                ops = S.hoistable_operands(bd)
-                have_ops = True
-            yield S.apply_with_operands(ops, block, Dimension.ROWWISE)
+            block, k = _unwrap(block)
+            yield plans.apply_rowwise_bucketed(S, block, true_rows=k)
     finally:
         if pf is not None:
             pf.close()
@@ -186,10 +192,8 @@ def sketch_least_squares(
         row = int(acc["row"])
         b2 = b_b[:, None] if getattr(b_b, "ndim", 1) == 1 else b_b
         return {
-            "sa": acc["sa"]
-            + S.apply_slice(A_b, row, Dimension.COLUMNWISE).astype(dt),
-            "sb": acc["sb"]
-            + S.apply_slice(b2, row, Dimension.COLUMNWISE).astype(dt),
+            "sa": plans.accumulate_slice(S, acc["sa"], A_b, row),
+            "sb": plans.accumulate_slice(S, acc["sb"], b2, row),
             "row": np.asarray(row + A_b.shape[0], np.int64),
         }
 
@@ -240,7 +244,6 @@ def kernel_ridge(
     from ..ml.krr import KrrParams, _psd_gram, _tag
     from ..ml.model import FeatureMapModel
     from ..parallel.mesh import fully_replicated
-    from ..sketch.base import Dimension as Dim
 
     params = params or StreamParams()
     krr_params = krr_params or KrrParams()
@@ -252,17 +255,30 @@ def kernel_ridge(
         "c": jnp.zeros((s, int(targets)), acc_dt),
         "rows": np.asarray(0, np.int64),
     }
-    ops_box = {}
+
+    # One fixed-shape donated update per bucket: Z comes back padded with
+    # its dead rows zeroed (pad_out=True), so the Gram/moment matmuls see
+    # one shape per bucket and the (s, s) accumulators update in place
+    # where the backend honors donation.
+    def _update(g, c, Zp, y2p):
+        return (
+            g + _psd_gram(Zp.T, Zp).astype(acc_dt),
+            c + (Zp.T @ y2p.astype(Zp.dtype)).astype(acc_dt),
+        )
+
+    update = plans.donating_jit(_update, donate_argnums=(0, 1))
 
     def step(acc, batch, index):
         X_b, y_b = batch
-        if "ops" not in ops_box:
-            ops_box["ops"] = S.hoistable_operands(dt)
-        Z = S.apply_with_operands(ops_box["ops"], X_b, Dim.ROWWISE)
         y2 = y_b[:, None] if getattr(y_b, "ndim", 1) == 1 else y_b
+        Zp, k = plans.apply_rowwise_bucketed(S, X_b, pad_out=True)
+        y2 = jnp.asarray(y2)
+        if Zp.shape[0] != y2.shape[0]:
+            y2 = plans.pad_rows(y2, Zp.shape[0])
+        g, c = update(acc["g"], acc["c"], Zp, y2)
         return {
-            "g": acc["g"] + _psd_gram(Z.T, Z).astype(acc_dt),
-            "c": acc["c"] + (Z.T @ y2.astype(Z.dtype)).astype(acc_dt),
+            "g": g,
+            "c": c,
             "rows": np.asarray(int(acc["rows"]) + X_b.shape[0], np.int64),
         }
 

@@ -29,6 +29,7 @@ from itertools import islice
 
 import numpy as np
 
+from .. import plans
 from ..resilient import ChunkedSolver, ResilientParams, ResilientRunner
 from .pipeline import Prefetcher, device_placer
 
@@ -160,6 +161,12 @@ def run_stream(
         b = int(state["batch"])
         cursor.ensure(b)
         acc = state["acc"]
+        if plans.donation_enabled():
+            # Donating step plans consume the accumulator buffers; the
+            # runner still reads the chunk-entry state afterwards (the
+            # divergence guard re-runs chunks from it), so snapshot it
+            # once per chunk before the first donation can land.
+            acc = plans.copy_for_donation(acc)
         for _ in range(k):
             if cursor.pending is None:
                 break

@@ -25,8 +25,15 @@ from __future__ import annotations
 import queue
 import threading
 from dataclasses import dataclass, field
+from typing import Any, NamedTuple
 
-__all__ = ["Prefetcher", "PrefetchStats", "device_placer"]
+__all__ = [
+    "Prefetcher",
+    "PrefetchStats",
+    "device_placer",
+    "BucketedBatch",
+    "bucketed_placer",
+]
 
 
 def device_placer(batch):
@@ -35,6 +42,41 @@ def device_placer(batch):
     import jax
 
     return jax.device_put(batch)
+
+
+class BucketedBatch(NamedTuple):
+    """A staged batch padded up to the bucket ladder, with the real row
+    count riding alongside (the streaming drivers unwrap it for row
+    accounting; the plan layer treats the padded rows as exact zeros)."""
+
+    block: Any
+    true_rows: int
+
+
+def bucketed_placer(gates: tuple = ()):
+    """Staging function that pads 2-D host batches up to the bucket
+    ladder BEFORE the host→device transfer, so the copy itself — not
+    just the compute — settles into one shape per ladder rung (the
+    transfer of a ragged tail batch otherwise gets its own XLA transfer
+    program).  Pass the consuming transform's ``batch_size_gates`` as
+    ``gates`` so thin batches stay unpadded on the eager algorithm's
+    side of a gate.  Non-2-D and sparse batches stage unpadded."""
+    from .. import plans
+
+    def placer(batch):
+        import jax
+
+        if (
+            getattr(batch, "ndim", 0) == 2
+            and not hasattr(batch, "todense")
+            and plans.enabled()
+        ):
+            k = int(batch.shape[0])
+            padded = plans.pad_rows(batch, plans.bucket_rows(k, gates))
+            return BucketedBatch(jax.device_put(padded), k)
+        return jax.device_put(batch)
+
+    return placer
 
 
 @dataclass

@@ -56,6 +56,26 @@ def pytest_configure(config):
         "prefetch pipeline, resumable passes) on small synthetic data; "
         f"tier-1, guarded by a per-test {STREAMING_TIMEOUT_S}s timeout",
     )
+    config.addinivalue_line(
+        "markers",
+        "perf: performance/latency assertions (wall-clock thresholds, "
+        "machine-sensitive); NOT tier-1 — auto-skipped unless "
+        "SKYLARK_RUN_PERF=1",
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    # perf tests assert wall-clock behavior that flakes on loaded CI
+    # hosts; tier-1 selects with -m 'not slow', which would include
+    # them, so they gate on an explicit env opt-in instead.
+    if os.environ.get("SKYLARK_RUN_PERF") == "1":
+        return
+    skip = pytest.mark.skip(
+        reason="perf test: machine-sensitive timing; set SKYLARK_RUN_PERF=1"
+    )
+    for item in items:
+        if item.get_closest_marker("perf") is not None:
+            item.add_marker(skip)
 
 
 @pytest.fixture(autouse=True)
