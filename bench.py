@@ -142,6 +142,18 @@ def _rep_diff(build, A, r1=4, r2=16, rounds=25, max_bursts=4) -> float:
 
 _BACKEND_TAG: str | None = None
 
+# gRPC status tokens that mark a backend error as transient (tunnel flap,
+# slow boot, device contention) rather than deterministic misconfiguration.
+# Shared by the init retry loop and the mid-run rescue: both judge by
+# token, not exact text (PJRT messages embed varying addresses).
+_TRANSIENT_TOKENS = ("UNAVAILABLE", "DEADLINE", "RESOURCE_EXHAUSTED")
+
+
+def _backend_died(e: BaseException) -> bool:
+    """True when an exception looks like the accelerator backend dying
+    under us (as opposed to a bug in the config being benched)."""
+    return any(t in f"{type(e).__name__}: {e}" for t in _TRANSIENT_TOKENS)
+
 
 def _emit(metric, value, unit, vs_baseline, table, contention="auto"):
     row = {
@@ -764,6 +776,48 @@ def bench_plan_cache(on_tpu, table):
     )
 
 
+def bench_guard_overhead(on_tpu, table):
+    """What the numerical-health guard costs: guarded vs unguarded
+    sketch-and-solve LS on the same problem (docs/numerical_health.md's
+    overhead contract).  The guarded run pays one ``certify_sketch``
+    (short-budget cond_est on the replicated-small S·A) plus one
+    finiteness probe; the emitted value is the guarded/unguarded time
+    ratio (1.0 = free).  First capture: vs_baseline fixed at 1.0."""
+    from libskylark_tpu.linalg import approximate_least_squares
+
+    if on_tpu:
+        m, n = 262_144, 512
+    else:
+        m, n = 16_384, 128
+    A = jax.random.normal(jax.random.PRNGKey(12), (m, n), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(13), (m,), jnp.float32)
+
+    def run():
+        return approximate_least_squares(A, b, SketchContext(seed=99))
+
+    prev = os.environ.get("SKYLARK_GUARD")
+    try:
+        os.environ["SKYLARK_GUARD"] = "0"
+        _timed(run)  # compile the sketch+solve programs
+        unguarded = min(_timed(run) for _ in range(6))
+        os.environ["SKYLARK_GUARD"] = "1"
+        _timed(run)  # compile the certification (cond_est) program
+        guarded = min(_timed(run) for _ in range(6))
+    finally:
+        if prev is None:
+            os.environ.pop("SKYLARK_GUARD", None)
+        else:
+            os.environ["SKYLARK_GUARD"] = prev
+    _emit(
+        f"guard overhead sketch-and-solve LS {m}x{n} (guarded/unguarded)",
+        guarded / unguarded,
+        "x",
+        1.0,
+        table,
+        contention=None,  # ratio of two min-pooled timings
+    )
+
+
 _FINAL: dict | None = None
 _FINAL_PRINTED = False
 
@@ -817,8 +871,7 @@ def _init_backend():
             # message (UNAVAILABLE = tunnel flap, DEADLINE = slow
             # backend boot, RESOURCE_EXHAUSTED = device contention), not
             # exact text: PJRT messages embed varying addresses.
-            transient_tokens = ("UNAVAILABLE", "DEADLINE", "RESOURCE_EXHAUSTED")
-            hard_errors += 0 if any(t in last for t in transient_tokens) else 1
+            hard_errors += 0 if any(t in last for t in _TRANSIENT_TOKENS) else 1
             if hard_errors >= 2:
                 return _BackendUnavailable(last)
             print(
@@ -942,30 +995,61 @@ def main() -> None:
     peak = _peak_tflops(dev)
     table: list[dict] = []
 
+    def _mid_run_rescue(e: BaseException) -> bool:
+        """The accelerator died AFTER a healthy init (tunnel drop,
+        multichip backend revoked mid-list): drop to host CPU once so
+        every remaining config records a real (tagged) number instead of
+        a -1 FAILED row — the same contract _cpu_fallback gives the
+        init-exhausted branch.  Configs already measured keep their
+        accelerator rows; the backend tag marks the switch point."""
+        nonlocal on_tpu, peak
+        if _BACKEND_TAG is not None or not _backend_died(e):
+            return False
+        dev2 = _cpu_fallback(
+            _BackendUnavailable(f"mid-run: {type(e).__name__}: {e}")
+        )
+        if isinstance(dev2, _BackendUnavailable):
+            return False
+        on_tpu = False  # the config lambdas read this cell at call time
+        peak = _peak_tflops(dev2)
+        return True
+
     # -- flagships FIRST (round 4): a budget/timeout can no longer eat
     # the rows the driver exists to record.  The headline is firewalled
     # like every other config — a congested-tunnel RuntimeError from
-    # _rep_diff must degrade to a FAILED row, not abort the whole bench
-    # before anything printed.
-    try:
+    # _rep_diff must degrade to a FAILED row (after one CPU-rescue
+    # retry), not abort the whole bench before anything printed.
+    def _headline_row():
         tflops, _ = bench_jlt(on_tpu, table)
-        headline_row = {
+        row = {
             "metric": "JLT dense sketch-apply throughput",
             "value": round(float(tflops), 3),
             "unit": "TFLOP/s/chip",
             "vs_baseline": round(float(tflops) / peak, 4),
         }
         if _LAST_CONTENTION is not None:
-            headline_row["contention"] = _LAST_CONTENTION
+            row["contention"] = _LAST_CONTENTION
+        return row
+
+    try:
+        headline_row = _headline_row()
     except Exception as e:  # noqa: BLE001 — report, don't abort
-        headline_row = {
-            "metric": (
-                f"JLT dense sketch-apply throughput (FAILED: {type(e).__name__})"
-            ),
-            "value": -1,
-            "unit": "error",
-            "vs_baseline": 0,
-        }
+        err = e
+        headline_row = None
+        if _mid_run_rescue(e):
+            try:
+                headline_row = _headline_row()
+            except Exception as e2:  # noqa: BLE001
+                err = e2
+        if headline_row is None:
+            headline_row = {
+                "metric": (
+                    f"JLT dense sketch-apply throughput (FAILED: {type(err).__name__})"
+                ),
+                "value": -1,
+                "unit": "error",
+                "vs_baseline": 0,
+            }
     if _BACKEND_TAG is not None:
         headline_row["backend"] = _BACKEND_TAG
     table.append(dict(headline_row))
@@ -977,10 +1061,19 @@ def main() -> None:
     try:
         bench_streaming_krr(on_tpu, table)
     except Exception as e:  # noqa: BLE001 — report, don't abort
-        _emit(
-            f"streaming KRR (FAILED: {type(e).__name__})", -1, "error", 0,
-            table, contention=None,
-        )
+        if _mid_run_rescue(e):
+            try:
+                bench_streaming_krr(on_tpu, table)
+            except Exception as e:  # noqa: BLE001
+                _emit(
+                    f"streaming KRR (FAILED: {type(e).__name__})", -1,
+                    "error", 0, table, contention=None,
+                )
+        else:
+            _emit(
+                f"streaming KRR (FAILED: {type(e).__name__})", -1, "error",
+                0, table, contention=None,
+            )
 
     # -- secondaries, descending importance.  Each carries a rough cost
     # estimate (compile + pooled measurement, seconds on the tunnel);
@@ -994,6 +1087,9 @@ def main() -> None:
         # Plan-cache cold/warm first among the never-captured rows: it is
         # the round-6 perf-layer measurement and costs almost nothing.
         ("plan cache", 40, lambda: bench_plan_cache(on_tpu, table)),
+        # Guard overhead next among never-captured rows: the round-6
+        # robustness-layer measurement (docs/numerical_health.md).
+        ("guard overhead", 60, lambda: bench_guard_overhead(on_tpu, table)),
         ("streaming SVD", 150, lambda: bench_streaming_svd(on_tpu, table)),
         ("sparse CWT", 150, lambda: bench_sparse_cwt(on_tpu, table)),
         ("QRFT", 90, lambda: bench_qrft(on_tpu, table)),
@@ -1019,6 +1115,15 @@ def main() -> None:
         try:
             fn()
         except Exception as e:  # noqa: BLE001 — report, don't abort
+            if _mid_run_rescue(e):
+                # Backend died mid-list: retry THIS config on the CPU
+                # fallback (the lambda re-reads on_tpu), then continue
+                # down the list there.
+                try:
+                    fn()
+                    continue
+                except Exception as e2:  # noqa: BLE001
+                    e = e2
             _emit(
                 f"{name} (FAILED: {type(e).__name__})", -1, "error", 0, table,
                 contention=None,

@@ -13,6 +13,7 @@ __version__ = "0.3.0"
 from . import (
     core,
     graph,
+    guard,
     io,
     linalg,
     ml,
@@ -29,6 +30,7 @@ from .core import SketchContext
 __all__ = [
     "core",
     "graph",
+    "guard",
     "io",
     "linalg",
     "ml",

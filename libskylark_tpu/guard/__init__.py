@@ -1,0 +1,65 @@
+"""Numerical-health guard layer: sentinels, sketch certification, and
+the adaptive recovery ladder.
+
+The production discipline the reference bakes into Blendenpik (condition-
+estimate the sketch, re-sketch or fall back to LAPACK — SISC 2010) and
+LSRN (bound the preconditioned spectrum — SISC 2014), factored out as a
+subsystem the whole library wires through:
+
+- :mod:`~libskylark_tpu.guard.sentinels` — jitted all-finite probes at
+  chunk and solve boundaries (no extra host syncs), raising
+  :class:`NumericalHealthError` with the offending stage;
+- :mod:`~libskylark_tpu.guard.certify` — ``cond_est`` / posterior
+  residual certification of sketch outputs, verdicts
+  ``OK | RESKETCH | FALLBACK``;
+- :mod:`~libskylark_tpu.guard.ladder` — bounded recovery policy
+  (fresh-seed resketch → grow sketch dimension → exact dense solve),
+  every attempt recorded in a :class:`RecoveryReport` that solvers
+  attach as ``info["recovery"]``.
+
+Env knobs (read per call): ``SKYLARK_GUARD=0`` bypass,
+``SKYLARK_GUARD_MAX_RETRIES``, ``SKYLARK_GUARD_COND_MAX``.  See
+``docs/numerical_health.md``.
+"""
+
+from ..utils.exceptions import NumericalHealthError
+from .certify import (
+    FALLBACK,
+    OK,
+    RESKETCH,
+    Certificate,
+    certify_sketch,
+    certify_svd,
+    pinv_psd_solve,
+)
+from .config import GROWTH_FACTOR, cond_max, enabled, max_retries
+from .ladder import (
+    RecoveryAttempt,
+    RecoveryReport,
+    derived_context,
+    run_ladder,
+)
+from .sentinels import check_finite, finite_probe, is_traced, tree_all_finite
+
+__all__ = [
+    "NumericalHealthError",
+    "OK",
+    "RESKETCH",
+    "FALLBACK",
+    "Certificate",
+    "certify_sketch",
+    "certify_svd",
+    "pinv_psd_solve",
+    "enabled",
+    "max_retries",
+    "cond_max",
+    "GROWTH_FACTOR",
+    "RecoveryAttempt",
+    "RecoveryReport",
+    "derived_context",
+    "run_ladder",
+    "finite_probe",
+    "tree_all_finite",
+    "check_finite",
+    "is_traced",
+]

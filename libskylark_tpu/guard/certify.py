@@ -1,0 +1,173 @@
+"""Sketch certification: classify a sketch output OK | RESKETCH | FALLBACK.
+
+The reference ships exactly this idea inside Blendenpik — estimate the
+condition of the sketched factor and re-sketch / fall back to LAPACK when
+the randomness came out bad (``accelerated_...Elemental.hpp:241-257``).
+Here it is a reusable layer: after sketch-and-solve / sketch-and-
+precondition, run the ported ``cond_est`` estimator
+(:mod:`~libskylark_tpu.solvers.cond_est`, ≙ ``nla/CondEst.hpp``) on the
+small sketched matrix and classify:
+
+- ``OK`` — finite, certified cond below the ceiling: trust the sketch.
+- ``RESKETCH`` — non-finite output, numerically singular (flag ``-4``),
+  or cond above ``SKYLARK_GUARD_COND_MAX``: the randomness was unlucky
+  (or corrupted); a fresh-seed / larger sketch is worth trying.
+- ``FALLBACK`` — retrying cannot help (exhausted ladder, or a
+  deterministic factorization failed): go straight to the dense rung.
+
+:func:`certify_svd` is the randomized-SVD analogue: finiteness plus a
+posterior residual check on the leading singular triplet
+(``‖A v₀ − σ₀ u₀‖ ≤ tol·σ₀`` — cheap, one matvec).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..core.context import SketchContext
+from . import config
+
+__all__ = [
+    "OK",
+    "RESKETCH",
+    "FALLBACK",
+    "Certificate",
+    "certify_sketch",
+    "certify_svd",
+    "pinv_psd_solve",
+]
+
+OK = "OK"
+RESKETCH = "RESKETCH"
+FALLBACK = "FALLBACK"
+
+# The certification probe's own deterministic seed: cond_est draws its
+# start/probe vectors from a context, and using the caller's would
+# advance the caller's counter stream (breaking sketch reproducibility),
+# so certification runs on a private fixed-seed context instead.
+_PROBE_SEED = 0x5EED
+
+
+@dataclass
+class Certificate:
+    """Outcome of one certification: the verdict plus the evidence."""
+
+    verdict: str
+    stage: str
+    cond: float | None = None
+    sigma_max: float | None = None
+    sigma_min: float | None = None
+    flag: int | None = None
+    detail: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.verdict == OK
+
+
+def _upcast(M):
+    """cond_est wants a real f32+ matrix (bf16/f16 erfinv/SVD paths are
+    not worth exercising for a probe)."""
+    if M.dtype in (jnp.bfloat16, jnp.float16):
+        return M.astype(jnp.float32)
+    return M
+
+
+def certify_sketch(
+    SA,
+    *,
+    stage: str = "sketch",
+    cond_max: float | None = None,
+    condest_params=None,
+) -> Certificate:
+    """Certify a replicated-small sketch output ``S·A`` (s, n).
+
+    Finiteness first (a NaN/Inf sketch is RESKETCH without estimating
+    anything), then the ``cond_est`` port: numerically-singular flag
+    (``-4``) or estimated cond above the ceiling → RESKETCH; else OK.
+    Wide outputs certify through their transpose (same singular values).
+    """
+    from ..solvers.cond_est import CondEstParams, cond_est
+
+    SA = jnp.asarray(SA)
+    if not bool(jnp.all(jnp.isfinite(SA))):
+        return Certificate(
+            RESKETCH, stage, detail="non-finite sketch output"
+        )
+    M = _upcast(SA)
+    if M.shape[0] < M.shape[1]:
+        M = M.T
+    ceiling = cond_max if cond_max is not None else config.cond_max(M.dtype)
+    # A short LSQR sweep is plenty for an (s, n) replicated-small probe —
+    # the default 300-iteration budget is sized for full-scale A.
+    p = condest_params or CondEstParams(iter_lim=60, powerits=25)
+    r = cond_est(M, SketchContext(seed=_PROBE_SEED), p)
+    cond = float(r.cond)
+    smax = float(r.sigma_max)
+    smin = float(r.sigma_min)
+    flag = int(r.flag)
+    base = dict(
+        stage=stage, cond=cond, sigma_max=smax, sigma_min=smin, flag=flag
+    )
+    if flag == -4:
+        return Certificate(
+            RESKETCH, detail="numerically singular (cond_est C3)", **base
+        )
+    # NaN-propagating comparison on purpose: only a FINITE cond below the
+    # ceiling certifies OK.
+    if not (cond < ceiling):
+        return Certificate(
+            RESKETCH, detail=f"cond estimate {cond:.3e} >= {ceiling:.3e}",
+            **base,
+        )
+    return Certificate(OK, **base)
+
+
+def certify_svd(
+    A, U, s, V, *, stage: str = "randomized_svd", rtol: float | None = None
+) -> Certificate:
+    """Posterior check of a randomized SVD: finite factors and
+    ``‖A v₀ − σ₀ u₀‖ ≤ rtol·σ₀`` for the leading triplet."""
+    if not bool(
+        jnp.all(jnp.isfinite(s))
+        & jnp.all(jnp.isfinite(U))
+        & jnp.all(jnp.isfinite(V))
+    ):
+        return Certificate(RESKETCH, stage, detail="non-finite SVD factors")
+    s0 = float(s[0])
+    if s0 == 0.0:
+        # Zero leading singular value: either A ≈ 0 (fine) or a collapsed
+        # sketch.  ‖A‖_F is one cheap pass and separates the two.
+        normA = float(jnp.linalg.norm(A.todense() if hasattr(A, "todense") else A))
+        if normA == 0.0:
+            return Certificate(OK, stage, sigma_max=0.0)
+        return Certificate(
+            RESKETCH, stage, sigma_max=s0,
+            detail="sigma_0 = 0 on a nonzero matrix",
+        )
+    if rtol is None:
+        # Loose by design: randomized SVD's *approximation* error lives in
+        # the tail, but the LEADING triplet of a healthy run is accurate;
+        # only a corrupted/collapsed run misses by a large factor.
+        rtol = 0.5
+    res = float(jnp.linalg.norm(A @ V[:, 0] - s0 * U[:, 0]))
+    if not (res <= rtol * s0):
+        return Certificate(
+            RESKETCH, stage, sigma_max=s0,
+            detail=f"posterior residual {res:.3e} > {rtol}*sigma_0",
+        )
+    return Certificate(OK, stage, sigma_max=s0)
+
+
+def pinv_psd_solve(G, C):
+    """Eigh-based pseudoinverse solve of a symmetric PSD system ``G X = C``
+    — the dense rung under a Cholesky that came back non-finite (singular
+    or indefinite-by-rounding Gram)."""
+    G = jnp.asarray(G)
+    lam, Q = jnp.linalg.eigh(G)
+    eps = jnp.finfo(lam.dtype).eps
+    cutoff = jnp.maximum(lam[-1], 0) * eps * G.shape[0]
+    inv = jnp.where(lam > cutoff, 1.0 / jnp.maximum(lam, cutoff), 0.0)
+    return Q @ (inv[:, None] * (Q.T @ jnp.asarray(C)))

@@ -1,0 +1,53 @@
+"""Runtime knobs of the numerical-health guard layer.
+
+All three are environment variables read PER CALL (not cached at import)
+so tests and operators can flip them at runtime, matching the precedent
+of ``SKYLARK_NO_PLANS`` / ``SKYLARK_PLAN_DONATE``:
+
+- ``SKYLARK_GUARD`` — ``0``/``false`` disables the guard layer entirely:
+  no sentinels, no certification, no ladder; solvers behave exactly like
+  the pre-guard library (silent NaNs included — the bypass exists for
+  benchmarking the overhead and for callers that guard externally).
+- ``SKYLARK_GUARD_MAX_RETRIES`` — ladder length beyond the initial
+  attempt (default 2: one fresh-seed resketch + one grown resketch)
+  before the dense fallback rung.
+- ``SKYLARK_GUARD_COND_MAX`` — certification threshold on the estimated
+  condition number of a sketch output.  Default is the Blendenpik retry
+  threshold ``0.1/sqrt(eps)`` for the certified dtype
+  (``accelerated_...Elemental.hpp:241-252``): beyond it a sketched
+  system is too ill-conditioned to trust the small solve.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+
+__all__ = ["enabled", "max_retries", "cond_max", "GROWTH_FACTOR"]
+
+# Geometric sketch-dimension growth per ladder rung (the Blendenpik
+# retry loop doubles gamma; the ladder keeps the same factor).
+GROWTH_FACTOR = 2.0
+
+
+def enabled() -> bool:
+    """Guarding is on unless ``SKYLARK_GUARD=0`` (checked per call)."""
+    return os.environ.get("SKYLARK_GUARD", "").lower() not in ("0", "false")
+
+
+def max_retries(default: int = 2) -> int:
+    """Ladder retries after the initial attempt (≥ 0)."""
+    raw = os.environ.get("SKYLARK_GUARD_MAX_RETRIES")
+    if raw is None:
+        return default
+    return max(0, int(raw))
+
+
+def cond_max(dtype=None) -> float:
+    """Certification ceiling for cond(sketch output)."""
+    raw = os.environ.get("SKYLARK_GUARD_COND_MAX")
+    if raw is not None:
+        return float(raw)
+    eps = float(jnp.finfo(jnp.dtype(dtype or jnp.float64)).eps)
+    return 0.1 / eps**0.5

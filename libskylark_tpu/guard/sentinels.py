@@ -1,0 +1,87 @@
+"""NaN/Inf sentinels: cheap finiteness probes at chunk and solve
+boundaries.
+
+Design constraint (ISSUE 4): sentinels must add NO extra host syncs.
+Two properties make that possible:
+
+- :func:`finite_probe` is pure graph: it reduces a pytree to ONE scalar
+  bool on device (a bandwidth-cheap ``all(isfinite)`` per float leaf,
+  AND-ed), so it can ride inside an existing jitted step and costs
+  nothing at the host boundary until somebody reads it.
+- Sum-style accumulators ABSORB NaNs: once a poisoned contribution is
+  folded in, the accumulator stays non-finite forever.  A probe at the
+  chunk boundary — where the resilient runner already syncs for
+  checkpoint/``is_done`` bookkeeping — therefore observes any fault from
+  anywhere inside the chunk, without per-batch readbacks.
+
+:func:`tree_all_finite` is the host-side read (one sync);
+:func:`check_finite` turns a failed read into a structured
+:class:`~libskylark_tpu.utils.exceptions.NumericalHealthError` carrying
+the offending stage.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.exceptions import NumericalHealthError
+
+__all__ = ["finite_probe", "tree_all_finite", "check_finite", "is_traced"]
+
+
+def is_traced(*xs) -> bool:
+    """True when any input is (or wraps) a JAX tracer — i.e. the caller
+    is being traced into a larger jit.  Host-side guarding (certificate
+    ``bool()`` reads, ladder control flow) cannot run there; guarded
+    entrypoints use this to fall back to their unguarded graph instead
+    of raising ``ConcretizationTypeError`` mid-trace."""
+    return any(
+        isinstance(x, jax.core.Tracer)
+        or isinstance(getattr(x, "data", None), jax.core.Tracer)
+        for x in xs
+    )
+
+
+def _float_leaves(tree):
+    out = []
+    for leaf in jax.tree.leaves(tree):
+        a = leaf if isinstance(leaf, jax.Array) else jnp.asarray(leaf)
+        if jnp.issubdtype(a.dtype, jnp.floating) or jnp.issubdtype(
+            a.dtype, jnp.complexfloating
+        ):
+            out.append(a)
+    return out
+
+
+def finite_probe(tree):
+    """Scalar bool array: every float/complex leaf of ``tree`` is finite.
+
+    Stays on device (jit-compatible; no host sync) — batch it into an
+    existing step graph and read it where a sync already happens.
+    """
+    leaves = _float_leaves(tree)
+    if not leaves:
+        return jnp.asarray(True)
+    return functools.reduce(
+        jnp.logical_and, [jnp.all(jnp.isfinite(a)) for a in leaves]
+    )
+
+
+def tree_all_finite(tree) -> bool:
+    """Host-side finiteness verdict — exactly one device→host sync."""
+    return bool(finite_probe(tree))
+
+
+def check_finite(tree, stage: str, report=None):
+    """Raise :class:`NumericalHealthError` if ``tree`` has a non-finite
+    float leaf; otherwise return ``tree`` unchanged."""
+    if not tree_all_finite(tree):
+        raise NumericalHealthError(
+            f"non-finite values detected at stage {stage!r}",
+            stage=stage,
+            report=report,
+        )
+    return tree

@@ -16,13 +16,13 @@ MXU-heavy op.  All functions are jit-compatible.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 import jax
 import jax.numpy as jnp
 from jax.scipy.linalg import cho_factor, cho_solve, solve_triangular
 
-from .. import plans
+from .. import guard, plans
 from ..core.context import SketchContext
 from ..core.params import Params
 from ..sketch.base import Dimension, create_sketch
@@ -43,11 +43,27 @@ class LeastSquaresParams(Params):
     sketch_size: int | None = None  # default 4 * width (least_squares.hpp:60)
 
 
+def _svd_lstsq(A, B):
+    """Pseudoinverse path shared by ``alg="svd"`` and the guarded ``ne``
+    fallback (rank-deficiency-proof)."""
+    U, s, Vt = jnp.linalg.svd(A, full_matrices=False)
+    cutoff = jnp.finfo(A.dtype).eps * max(A.shape) * s[0]
+    sinv = jnp.where(s > cutoff, 1.0 / s, 0.0)
+    return Vt.T @ (sinv[:, None] * (U.T @ B))
+
+
 def exact_least_squares(A, B, alg: str = "qr"):
     """Solve ``min_X ||A X - B||_F`` for tall A; returns X (n, k).
 
     ``alg`` ∈ {"qr", "sne", "ne", "svd"} ≙ the reference's
     ``qr/sne/ne/svd_l2_solver_tag`` solver tags.
+
+    ``ne`` note: ``cho_factor`` on a singular/indefinite Gram matrix
+    returns NaNs WITHOUT error.  Under the guard layer (default) a
+    non-finite factor reroutes to the ``svd`` pseudoinverse path (inside
+    jit: a ``lax.cond`` branch, so the function stays jit-compatible);
+    with ``SKYLARK_GUARD=0`` the eager path raises
+    ``NumericalHealthError`` instead of returning silent NaNs.
     """
     A = jnp.asarray(A)
     B = jnp.asarray(B)
@@ -67,13 +83,34 @@ def exact_least_squares(A, B, alg: str = "qr"):
     elif alg == "ne":
         # Normal equations via Cholesky (≙ ne_l2_solver_tag).
         G = A.T @ A
-        X = cho_solve(cho_factor(G), A.T @ B)
+        c, low = cho_factor(G)
+        AtB = A.T @ B
+        finite = jnp.all(jnp.isfinite(c))
+        guarded = guard.enabled()
+        if isinstance(finite, jax.core.Tracer):
+            if guarded:
+                X = jax.lax.cond(
+                    finite,
+                    lambda: cho_solve((c, low), AtB),
+                    lambda: _svd_lstsq(A, B),
+                )
+            else:
+                X = cho_solve((c, low), AtB)
+        elif bool(finite):
+            X = cho_solve((c, low), AtB)
+        elif guarded:
+            X = _svd_lstsq(A, B)
+        else:
+            from ..utils.exceptions import NumericalHealthError
+
+            raise NumericalHealthError(
+                "cho_factor returned non-finite factors (singular or "
+                "indefinite Gram matrix) in exact_least_squares(alg='ne')",
+                stage="exact_ls_ne",
+            )
     elif alg == "svd":
         # Pseudoinverse through the SVD (≙ svd_l2_solver_tag).
-        U, s, Vt = jnp.linalg.svd(A, full_matrices=False)
-        cutoff = jnp.finfo(A.dtype).eps * max(A.shape) * s[0]
-        sinv = jnp.where(s > cutoff, 1.0 / s, 0.0)
-        X = Vt.T @ (sinv[:, None] * (U.T @ B))
+        X = _svd_lstsq(A, B)
     else:
         raise ValueError(f"unknown exact LS alg {alg!r}")
     return X[:, 0] if squeeze else X
@@ -85,12 +122,26 @@ def approximate_least_squares(
     context: SketchContext,
     params: LeastSquaresParams | None = None,
     alg: str = "qr",
+    *,
+    fault_plan=None,
+    return_info: bool = False,
 ):
     """Sketch-and-solve LS: sketch the rows of (A, B), solve exactly.
 
     ≙ ``ApproximateLeastSquares`` (``nla/least_squares.hpp:42-184``):
     construct S once (columnwise, size s×m), apply to A at build and to B at
     solve (``sketched_regression_solver_Elemental.hpp:60-104``).
+
+    Guarding (``SKYLARK_GUARD``, on by default): each sketch is certified
+    (``guard.certify_sketch`` — finiteness + ``cond_est``) and a bad draw
+    climbs the recovery ladder (fresh-seed resketch → grow sketch size →
+    exact dense ``svd`` solve).  Attempt 0 reuses the caller's context and
+    sketch order, so a healthy run returns bit-identical results to the
+    unguarded path.  ``fault_plan`` exposes the ladder's injection point
+    (``FaultPlan.corrupt_sketch`` — ``nan_at``/``bad_sketch_at`` keyed by
+    attempt index).  With ``return_info=True`` returns ``(x, info)`` where
+    ``info["recovery"]`` is the :class:`~libskylark_tpu.guard.
+    RecoveryReport` dict (``guarded=False`` under ``SKYLARK_GUARD=0``).
     """
     params = params or LeastSquaresParams()
     is_sparse = hasattr(A, "todense")
@@ -103,13 +154,54 @@ def approximate_least_squares(
     m, n = A.shape
     s = params.sketch_size or min(4 * n, m)
     stype = params.sketch_type or ("CWT" if is_sparse else "FJLT")
-    S = create_sketch(stype, m, s, context)
-    # Plan-cached applies: repeated sketch-and-solve calls at the same
-    # shape (parameter sweeps, restarts) reuse one fused executable.
-    SA = plans.apply(S, A, Dimension.COLUMNWISE)
-    SB = plans.apply(S, B, Dimension.COLUMNWISE)
-    X = exact_least_squares(SA, SB, alg=alg)
-    return X[:, 0] if squeeze else X
+
+    # Under an enclosing jit trace the host-side certificate reads and
+    # ladder control flow cannot run — emit the plain unguarded graph.
+    if not guard.enabled() or guard.is_traced(A, B):
+        S = create_sketch(stype, m, s, context)
+        # Plan-cached applies: repeated sketch-and-solve calls at the same
+        # shape (parameter sweeps, restarts) reuse one fused executable.
+        SA = plans.apply(S, A, Dimension.COLUMNWISE)
+        SB = plans.apply(S, B, Dimension.COLUMNWISE)
+        if fault_plan is not None:
+            SA = fault_plan.corrupt_sketch(0, SA)
+        X = exact_least_squares(SA, SB, alg=alg)
+        out = X[:, 0] if squeeze else X
+        if return_info:
+            report = guard.RecoveryReport.disabled("sketch_and_solve_ls")
+            return out, {"recovery": report.to_dict()}
+        return out
+
+    def attempt(ctx, s_i, i):
+        S = create_sketch(stype, m, s_i, ctx)
+        SA = plans.apply(S, A, Dimension.COLUMNWISE)
+        SB = plans.apply(S, B, Dimension.COLUMNWISE)
+        if fault_plan is not None:
+            SA = fault_plan.corrupt_sketch(i, SA)
+        cert = guard.certify_sketch(SA, stage="sketch_and_solve_ls")
+        if not cert.ok:
+            return None, cert
+        X = exact_least_squares(SA, SB, alg=alg)
+        if not guard.tree_all_finite(X):
+            cert = replace(
+                cert,
+                verdict=guard.RESKETCH,
+                detail="non-finite small-problem solution",
+            )
+            return None, cert
+        return X, cert
+
+    def fallback():
+        A_dense = A.todense() if is_sparse else A
+        return exact_least_squares(A_dense, B, alg="svd")
+
+    X, report = guard.run_ladder(
+        "sketch_and_solve_ls", context, s, m, attempt, fallback
+    )
+    out = X[:, 0] if squeeze else X
+    if return_info:
+        return out, {"recovery": report.to_dict()}
+    return out
 
 
 def streaming_least_squares(
@@ -136,7 +228,12 @@ def streaming_least_squares(
     to address the sketch's counter stream; ``io.scan_libsvm_dims`` scans
     them in one cheap pass).  ``stream_params`` is a
     :class:`~libskylark_tpu.streaming.StreamParams` (prefetch depth,
-    checkpoint/resume).  Returns ``(x, info)``.
+    checkpoint/resume).  Returns ``(x, info)``; when guarding is on
+    (``SKYLARK_GUARD`` unset or truthy) ``info["recovery"]`` carries the
+    guard's :class:`~libskylark_tpu.guard.RecoveryReport` dict — chunk
+    replays of NaN-poisoned batches and small-solve fallbacks — and
+    ``fault_plan`` (``nan_at``/``bad_sketch_at`` keyed by batch index)
+    injects the faults the guard recovers from.
     """
     from .. import streaming
 

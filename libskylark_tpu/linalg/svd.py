@@ -16,13 +16,14 @@ TPU design notes:
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import guard
 from ..core.context import SketchContext
 from ..core.matrices import gaussian_matrix
 from ..core.params import Params
@@ -197,6 +198,8 @@ def approximate_svd(
     rank: int,
     context: SketchContext,
     params: SVDParams | None = None,
+    *,
+    return_info: bool = False,
 ):
     """Randomized truncated SVD: returns ``(U, s, V)`` with
     ``A ≈ U @ diag(s) @ V.T``, U: (m, rank), V: (n, rank).
@@ -204,11 +207,71 @@ def approximate_svd(
     ≙ ``ApproximateSVD`` (``nla/svd.hpp:222-318``): JLT sketch of the row
     space → power iteration → QR → small SVD → truncate.  One chunk of the
     full sweep budget through :func:`approximate_svd_chunked`.
+
+    Guarding (``SKYLARK_GUARD``, on by default): the factors are certified
+    posteriorly (``guard.certify_svd`` — finiteness + one-matvec residual
+    check on the leading triplet); a failed certificate climbs the ladder
+    (fresh-seed resketch → grown oversampling → dense ``jnp.linalg.svd``
+    fallback).  Attempt 0 reuses the caller's context, so healthy runs are
+    bit-identical to the unguarded path.  ``return_info=True`` returns
+    ``((U, s, V), info)`` with the attempts in ``info["recovery"]``.
     """
     params = params or SVDParams()
-    sol = approximate_svd_chunked(A, rank, context, params)
-    st = sol.step_chunk(sol.init_state(), max(params.num_iterations, 1))
-    return sol.extract_result(st)
+
+    def run(ctx, p):
+        sol = approximate_svd_chunked(A, rank, ctx, p)
+        st = sol.step_chunk(sol.init_state(), max(p.num_iterations, 1))
+        return sol.extract_result(st)
+
+    # Under an enclosing jit trace the host-side certificate reads and
+    # ladder control flow cannot run — emit the plain unguarded graph.
+    if not guard.enabled() or guard.is_traced(A):
+        out = run(context, params)
+        if return_info:
+            report = guard.RecoveryReport.disabled("randomized_svd")
+            return out, {"recovery": report.to_dict()}
+        return out
+
+    m, n = A.shape
+    report = guard.RecoveryReport(stage="randomized_svd")
+    retries = guard.max_retries()
+    out = None
+    for i in range(retries + 1):
+        if i == 0:
+            action, ctx, p = "initial", context, params
+        elif i == 1:
+            action, ctx, p = "resketch", guard.derived_context(context, i), params
+        else:
+            # Grow the sketch width geometrically through the additive
+            # oversampling term (clamped to n by _sketch_size).
+            action, ctx = "grow", guard.derived_context(context, i)
+            p = replace(
+                params,
+                oversampling_additive=params.oversampling_additive
+                + rank * (2 ** (i - 1)),
+            )
+        U, sv, V = run(ctx, p)
+        cert = guard.certify_svd(A, U, sv, V)
+        _, width = _sketch_size(rank, p, n, m)
+        report.record(
+            action, verdict=cert.verdict, detail=cert.detail,
+            sketch_size=width,
+        )
+        if cert.ok:
+            report.recovered = i > 0
+            out = (U, sv, V)
+            break
+    if out is None:
+        Ad = A.todense() if hasattr(A, "todense") else A
+        Uf, svf, Vtf = jnp.linalg.svd(jnp.asarray(Ad), full_matrices=False)
+        out = (Uf[:, :rank], svf[:rank], Vtf[:rank].T)
+        report.record(
+            "fallback", verdict=guard.FALLBACK, detail="dense jnp.linalg.svd"
+        )
+        report.recovered = True
+    if return_info:
+        return out, {"recovery": report.to_dict()}
+    return out
 
 
 def approximate_symmetric_svd(

@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.linalg import cho_factor, cho_solve, solve_triangular
 
-from .. import plans
+from .. import guard, plans
 from ..core.context import SketchContext
 from ..core.params import Params
 from ..parallel.mesh import fully_replicated
@@ -128,7 +128,11 @@ def approximate_kernel_ridge(
     """Feature map Z = S(X) (n, s), then ridge: (ZᵀZ + λI)W = ZᵀY.
 
     ≙ ``ApproximateKernelRidge`` (krr.hpp:94-197; its ``El::Ridge`` is the
-    same normal-equations solve).  Returns a ``FeatureMapModel``.
+    same normal-equations solve).  Returns a ``FeatureMapModel``; under
+    guarding (``SKYLARK_GUARD``, default on) a non-finite Cholesky factor
+    (singular/indefinite-by-rounding regularized Gram) falls back to the
+    eigh pseudoinverse solve, the coefficients pass a finiteness
+    sentinel, and ``model.info["recovery"]`` records the attempts.
     """
     params = params or KrrParams()
     X = _maybe_sparse(X)
@@ -137,12 +141,32 @@ def approximate_kernel_ridge(
     Z = plans.apply(S, X, Dimension.ROWWISE)  # (n, s)
     if params.sketched_rr:
         return _solve_sketched_ridge(S, Z, Y2, lam, s, context, params)
+    # Host-side sentinel reads cannot run under an enclosing jit trace.
+    guarded = guard.enabled() and not guard.is_traced(Z, Y2)
+    report = (
+        guard.RecoveryReport(stage="approximate_krr")
+        if guarded
+        else guard.RecoveryReport.disabled("approximate_krr")
+    )
     G = fully_replicated(_psd_gram(Z.T, Z) + lam * jnp.eye(s, dtype=Z.dtype))
     # Factor/solve in _psd_gram's ≥f32 accumulator dtype; the model's
     # coefficient dtype stays the feature dtype (API contract — bf16
     # features must not silently return an f32 model).
-    W = cho_solve(cho_factor(G, lower=True), Z.T @ Y2).astype(Z.dtype)
-    return FeatureMapModel([S], W)
+    c, low = cho_factor(G, lower=True)
+    if guarded and not guard.tree_all_finite(c):
+        W = guard.pinv_psd_solve(G, Z.T @ Y2).astype(Z.dtype)
+        report.record(
+            "fallback", verdict=guard.FALLBACK,
+            detail="non-finite Cholesky factor; eigh pseudoinverse solve",
+        )
+        report.recovered = True
+    else:
+        W = cho_solve((c, low), Z.T @ Y2).astype(Z.dtype)
+    if guarded:
+        guard.check_finite(W, "approximate_krr", report=report)
+    model = FeatureMapModel([S], W)
+    model.info = {"recovery": report.to_dict()}
+    return model
 
 
 def _solve_sketched_ridge(S, Z, Y2, lam, s, context, params):
@@ -371,6 +395,15 @@ def streaming_approximate_kernel_ridge(
     ``source`` is an iterable of batches or a re-openable factory
     ``f(start_batch) -> iterator`` (``io.stream_libsvm`` /
     ``io.stream_hdf5`` wrapped in a lambda both qualify).
+
+    Guarding (``SKYLARK_GUARD``, on by default): a batch that NaN-poisons
+    the accumulators is replayed at the chunk boundary and a non-finite
+    Cholesky factor reroutes to the eigh pseudoinverse solve; the guard's
+    :class:`~libskylark_tpu.guard.RecoveryReport` ledger lands in
+    ``model.info["recovery"]``.  ``fault_plan``
+    (:class:`~libskylark_tpu.resilient.FaultPlan` with
+    ``nan_at``/``bad_sketch_at`` keyed by batch index) injects the
+    faults the guard recovers from.
     """
     from .. import streaming
 

@@ -88,12 +88,29 @@ class FaultPlan:
     - ``nan_after_chunk``: make the *solver* diverge by poisoning the state
       the runner hands to the next chunk (the runner consults
       :meth:`poison` — used to exercise the divergence guard end-to-end).
+
+    Numerical-fault kinds (consumed by the ``guard`` layer, ONE-SHOT —
+    injected on the live pass only, so a guard replay/resketch of the
+    same index sees clean data, modeling a transient fault):
+
+    - ``nan_at``: NaN-poison the payload at that index — for streaming
+      passes the index is the BATCH index (the block is NaN-filled before
+      the fold); for in-core sketch-and-solve it is the ladder ATTEMPT
+      index (the sketched ``S·A`` comes back all-NaN).
+    - ``bad_sketch_at``: corrupt the sketch at that index into a rank-
+      collapsed one — in-core, every row of ``S·A`` past the first is
+      zeroed (certification sees a numerically singular sketch); for
+      streaming, the block at that batch index is Inf-scaled (the chunk
+      sentinel trips and the accumulation replays).
     """
 
     preempt_after_chunk: int | None = None
     io_errors_on_save: dict = field(default_factory=dict)
     nan_after_chunk: int | None = None
+    nan_at: int | None = None
+    bad_sketch_at: int | None = None
     _save_attempts: dict = field(default_factory=dict, repr=False)
+    _consumed: set = field(default_factory=set, repr=False)
 
     def before_save(self, chunk: int) -> None:
         budget = self.io_errors_on_save.get(chunk, 0)
@@ -118,3 +135,50 @@ class FaultPlan:
             else l,
             state,
         )
+
+    def _fire(self, kind: str, scheduled, index: int) -> bool:
+        """One-shot trigger: True the FIRST time ``index`` matches."""
+        if scheduled is None or index != scheduled:
+            return False
+        key = (kind, index)
+        if key in self._consumed:
+            return False
+        self._consumed.add(key)
+        return True
+
+    @staticmethod
+    def _map_floats(tree, fn):
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree.map(
+            lambda l: fn(l)
+            if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+            else l,
+            tree,
+        )
+
+    def corrupt_block(self, index: int, block):
+        """Streaming injection point: corrupt the batch at ``index``
+        (one-shot — the guard's replay of the same batch gets the clean
+        block)."""
+        import jax.numpy as jnp
+
+        if self._fire("nan_block", self.nan_at, index):
+            return self._map_floats(block, lambda l: jnp.full_like(l, jnp.nan))
+        if self._fire("bad_block", self.bad_sketch_at, index):
+            return self._map_floats(block, lambda l: jnp.full_like(l, jnp.inf))
+        return block
+
+    def corrupt_sketch(self, attempt: int, SA):
+        """In-core injection point: corrupt the sketched ``S·A`` of ladder
+        attempt ``attempt`` (one-shot per attempt index)."""
+        import jax.numpy as jnp
+
+        if self._fire("nan_sketch", self.nan_at, attempt):
+            return jnp.full_like(SA, jnp.nan)
+        if self._fire("bad_sketch", self.bad_sketch_at, attempt):
+            # Rank collapse, not NaN: the finiteness sentinel passes and
+            # the CERTIFICATION path has to catch it.
+            return SA.at[1:].set(0.0) if SA.shape[0] > 1 else SA * 0.0
+        return SA

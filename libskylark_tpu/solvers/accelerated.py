@@ -21,6 +21,7 @@ from dataclasses import dataclass
 import jax.numpy as jnp
 import numpy as np
 
+from .. import guard
 from ..core.context import SketchContext
 from ..core.params import Params
 from ..sketch.base import Dimension, create_sketch
@@ -73,7 +74,12 @@ def faster_least_squares(
 ):
     """Blendenpik: near machine-precision LS at sketch-and-solve speed.
 
-    Returns ``(X, info)``; ``info["attempts"]`` counts re-sketches.
+    Returns ``(X, info)``; ``info["attempts"]`` counts re-sketches and
+    ``info["recovery"]`` is the guard-layer ledger of the retry loop
+    (every re-sketch / SVD fallback as a :class:`~libskylark_tpu.guard.
+    RecoveryAttempt`; ``guarded=False`` under ``SKYLARK_GUARD=0``, in
+    which case the Blendenpik-native retry loop still runs — it predates
+    the guard and is the paper's own robustness mechanism).
     """
     params = params or FasterLeastSquaresParams()
     m, n = A.shape
@@ -82,6 +88,12 @@ def faster_least_squares(
     eps = float(jnp.finfo(jnp.asarray(A).dtype if not hasattr(A, "todense") else A.data.dtype).eps)
     threshold = params.cond_threshold or 0.1 / np.sqrt(eps)
 
+    guarded = guard.enabled()
+    report = (
+        guard.RecoveryReport(stage="blendenpik")
+        if guarded
+        else guard.RecoveryReport.disabled("blendenpik")
+    )
     stype = params.sketch_type or (
         "CWT" if hasattr(A, "todense") else "FJLT"
     )
@@ -96,7 +108,16 @@ def faster_least_squares(
         # ``build_precond``, accelerated_...Elemental.hpp:68-77, 225-246).
         cond = _tri_condest(R_try)
         R = R_try
-        if np.isfinite(cond) and cond < threshold:
+        good = np.isfinite(cond) and cond < threshold
+        report.record(
+            "initial" if attempt == 1 else "grow",
+            verdict=guard.OK if good else guard.RESKETCH,
+            cond=cond,
+            sketch_size=s,
+            detail="" if good else f"utcondest {cond:.3e} >= {threshold:.3e}",
+        )
+        if good:
+            report.recovered = attempt > 1
             break
         gamma *= 2  # re-sketch larger (accelerated_...hpp:241-252)
     if not (np.isfinite(cond) and cond < threshold):
@@ -107,16 +128,24 @@ def faster_least_squares(
 
         A_d = A.todense() if hasattr(A, "todense") else A
         X = exact_least_squares(A_d, B, alg="svd")
+        report.record(
+            "fallback", verdict=guard.FALLBACK, detail="exact svd solve"
+        )
+        report.recovered = True
         return X, {
             "attempts": attempt,
             "condest": cond,
             "fallback": "svd",
             "iterations": 0,
+            "recovery": report.to_dict(),
         }
     precond = TriInversePrecond(R, lower=False)
     X, info = lsqr(A, B, precond=precond, params=params.krylov)
+    if guarded:
+        guard.check_finite(X, "blendenpik_lsqr", report=report)
     info["attempts"] = attempt
     info["condest"] = cond
+    info["recovery"] = report.to_dict()
     return X, info
 
 
@@ -127,7 +156,13 @@ def lsrn_least_squares(
     params: FasterLeastSquaresParams | None = None,
 ):
     """LSRN: SVD-based preconditioning — robust for rank-deficient A
-    (≙ ``lsrn_tag`` branch, ``accelerated_...Elemental.hpp:96-160``)."""
+    (≙ ``lsrn_tag`` branch, ``accelerated_...Elemental.hpp:96-160``).
+
+    Returns ``(X, info)``; under guarding (``SKYLARK_GUARD``, default on)
+    a non-finite sketch climbs one fresh-seed resketch rung before the
+    solve, the solution passes a finiteness sentinel, and
+    ``info["recovery"]`` records the attempts.
+    """
     params = params or FasterLeastSquaresParams()
     m, n = A.shape
     s = min(int(params.gamma * n), m)
@@ -135,11 +170,33 @@ def lsrn_least_squares(
     stype = params.sketch_type or (
         "CWT" if hasattr(A, "todense") else "JLT"
     )
+    guarded = guard.enabled()
+    report = (
+        guard.RecoveryReport(stage="lsrn")
+        if guarded
+        else guard.RecoveryReport.disabled("lsrn")
+    )
     SA = _sketch_once(A, s, stype, context)
+    if guarded and not guard.tree_all_finite(SA):
+        # LSRN's SVD preconditioner absorbs ill conditioning by design, so
+        # the only sketch pathology worth guarding here is non-finiteness.
+        report.record(
+            "initial", verdict=guard.RESKETCH, sketch_size=s,
+            detail="non-finite sketch output",
+        )
+        SA = _sketch_once(A, s, stype, guard.derived_context(context, 1))
+        report.record("resketch", verdict=guard.OK, sketch_size=s)
+        guard.check_finite(SA, "lsrn_sketch", report=report)
+        report.recovered = True
+    elif guarded:
+        report.record("initial", verdict=guard.OK, sketch_size=s)
     _, sv, Vt = jnp.linalg.svd(SA, full_matrices=False)
     eps = jnp.finfo(sv.dtype).eps
     cutoff = sv[0] * eps * max(SA.shape)
     sinv = jnp.where(sv > cutoff, 1.0 / sv, 0.0)
     N = Vt.T * sinv[None, :]  # V·Σ⁻¹
     X, info = lsqr(A, B, precond=MatPrecond(N), params=params.krylov)
+    if guarded:
+        guard.check_finite(X, "lsrn_lsqr", report=report)
+    info["recovery"] = report.to_dict()
     return X, info

@@ -83,16 +83,37 @@ class CondEstResult(NamedTuple):
 
 def _power_sigma_max(matvec, rmatvec, v0, powerits):
     """Dominant singular triplet by power iteration on AᵀA
-    (≙ ``PowerIteration`` call, ``CondEst.hpp:92-97``)."""
+    (≙ ``PowerIteration`` call, ``CondEst.hpp:92-97``).
+
+    Every normalization is zero-guarded (``x/max(‖x‖,·)`` with a
+    ``where``): a zero start vector falls back to a uniform one, and a
+    zero A (or an iterate that lands in the null space) yields σ=0 with a
+    finite certificate instead of NaN-ing the whole estimate.  The guards
+    are bitwise no-ops on the generic (positive-norm) path.
+    """
+
+    def _unit(x):
+        nrm = jnp.linalg.norm(x)
+        return jnp.where(nrm > 0, x / jnp.where(nrm > 0, nrm, 1), x)
+
+    n = v0.shape[0]
+    nrm0 = jnp.linalg.norm(v0)
+    v0 = jnp.where(
+        nrm0 > 0,
+        v0 / jnp.where(nrm0 > 0, nrm0, 1),
+        jnp.full_like(v0, 1.0 / jnp.sqrt(jnp.asarray(n, v0.dtype))),
+    )
 
     def body(_, v):
         w = rmatvec(matvec(v))
-        return w / jnp.linalg.norm(w)
+        nrm = jnp.linalg.norm(w)
+        # A null-space iterate (w = 0) stays put instead of dividing by 0.
+        return jnp.where(nrm > 0, w / jnp.where(nrm > 0, nrm, 1), v)
 
-    v = lax.fori_loop(0, powerits, body, v0 / jnp.linalg.norm(v0))
+    v = lax.fori_loop(0, powerits, body, v0)
     u = matvec(v)
     sigma = jnp.linalg.norm(u)
-    return sigma, u / sigma, v
+    return sigma, _unit(u), v
 
 
 def cond_est(
@@ -163,14 +184,20 @@ def _cond_est_impl(A, v0, xhat0, powerits, T_max, c1, c2, c3, c4, c1t):
         )
         xhat = xhat0 / nrm_xhat
 
-        # b and LSQR initialization (CondEst.hpp:119-152).
+        # b and LSQR initialization (CondEst.hpp:119-152).  The beta0 /
+        # alpha0 divisions are zero-guarded (bitwise identical whenever
+        # the norms are positive): rank-deficient or zero A can put xhat
+        # in the null space, and an unguarded 0/0 here NaNs every
+        # downstream certificate.
         b = matvec(xhat)
         nrm_b = jnp.linalg.norm(b)
         beta0 = nrm_b
-        u = b / beta0
+        u = jnp.where(beta0 > 0, b / jnp.where(beta0 > 0, beta0, 1), b)
         v_init = rmatvec(u)
         alpha0 = jnp.linalg.norm(v_init)
-        v = v_init / alpha0
+        v = jnp.where(
+            alpha0 > 0, v_init / jnp.where(alpha0 > 0, alpha0, 1), v_init
+        )
 
         Rdiag = jnp.zeros((T_max,), dtype)
         Rsub = jnp.zeros((T_max,), dtype)

@@ -25,7 +25,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from .. import plans
+from .. import guard, plans
 from ..sketch.base import Dimension
 from .engine import StreamParams, run_stream
 from .pipeline import BucketedBatch
@@ -116,9 +116,10 @@ def sketch(
             "row": np.asarray(row + k, np.int64),
         }
 
+    report = guard.RecoveryReport(stage="streaming_sketch")
     acc, nbatches = run_stream(
         source, step, init, params, kind="streaming_sketch",
-        fault_plan=fault_plan,
+        fault_plan=fault_plan, report=report,
     )
     rows = int(acc["row"])
     if rows != S.n:
@@ -126,7 +127,10 @@ def sketch(
             f"stream covered {rows} rows but the sketch domain is "
             f"{S.n}; the source and transform disagree"
         )
-    return S.finalize_slices(acc["sa"], Dimension.COLUMNWISE)
+    out = S.finalize_slices(acc["sa"], Dimension.COLUMNWISE)
+    if guard.enabled():
+        guard.check_finite(out, "streaming_sketch", report=report)
+    return out
 
 
 def sketch_batches(source, S, *, params: StreamParams | None = None):
@@ -175,7 +179,11 @@ def sketch_least_squares(
     the sketch applies decomposed over row blocks — A never resident.
     ``S`` must be a LINEAR sketch (JLT/CT/CWT/SJLT/MMT/WZT/FJLT-free
     slices...); a feature map (RFT) would not preserve the LS geometry.
-    Returns ``(x, info)`` with ``info = {"rows", "batches"}``.
+    Returns ``(x, info)`` with ``info = {"rows", "batches", "recovery"}``;
+    ``info["recovery"]`` is the guard layer's recovery report (chunk
+    replays, sketch certification, small-solve fallback — see
+    ``docs/numerical_health.md``), ``{"guarded": False}``-shaped when
+    ``SKYLARK_GUARD=0``.
     """
     from ..linalg.least_squares import exact_least_squares
 
@@ -197,9 +205,15 @@ def sketch_least_squares(
             "row": np.asarray(row + A_b.shape[0], np.int64),
         }
 
+    guarded = guard.enabled()
+    report = (
+        guard.RecoveryReport(stage="streaming_lsq")
+        if guarded
+        else guard.RecoveryReport.disabled("streaming_lsq")
+    )
     acc, nbatches = run_stream(
         source, step, init, params, kind="streaming_lsq",
-        fault_plan=fault_plan,
+        fault_plan=fault_plan, report=report,
     )
     rows = int(acc["row"])
     if rows != S.n:
@@ -208,9 +222,29 @@ def sketch_least_squares(
         )
     SA = S.finalize_slices(acc["sa"], Dimension.COLUMNWISE)
     SB = S.finalize_slices(acc["sb"], Dimension.COLUMNWISE)
+    if guarded:
+        # A streaming sketch is fixed after its one pass — no resketch
+        # rung exists here (that is the ladder's in-core privilege), so a
+        # failed certificate degrades the SMALL solve to the SVD
+        # pseudoinverse path, which is rank-deficiency-proof.
+        cert = guard.certify_sketch(SA, stage="streaming_lsq")
+        report.record(
+            "initial", verdict=cert.verdict, detail=cert.detail,
+            cond=cert.cond, sketch_size=int(SA.shape[0]),
+        )
+        if not cert.ok:
+            alg = "svd"
+            report.record(
+                "fallback", verdict=guard.FALLBACK,
+                detail="svd pseudoinverse small solve",
+            )
+            report.recovered = True
     X = exact_least_squares(SA, SB, alg=alg)
+    if guarded:
+        guard.check_finite(X, "streaming_lsq", report=report)
     x = X[:, 0] if targets == 1 else X
-    return x, {"rows": rows, "batches": nbatches}
+    return x, {"rows": rows, "batches": nbatches,
+               "recovery": report.to_dict()}
 
 
 def kernel_ridge(
@@ -237,7 +271,8 @@ def kernel_ridge(
     feature map's counter-realized operands are hoisted once per pass.
     Returns the same ``FeatureMapModel`` as the in-core solver (trained
     on the same ``context`` seed it is allclose-interchangeable, modulo
-    per-batch summation order).
+    per-batch summation order).  ``model.info["recovery"]`` carries the
+    guard layer's recovery report (chunk replays, Cholesky fallback).
     """
     from jax.scipy.linalg import cho_factor, cho_solve
 
@@ -282,14 +317,35 @@ def kernel_ridge(
             "rows": np.asarray(int(acc["rows"]) + X_b.shape[0], np.int64),
         }
 
+    guarded = guard.enabled()
+    report = (
+        guard.RecoveryReport(stage="streaming_krr")
+        if guarded
+        else guard.RecoveryReport.disabled("streaming_krr")
+    )
     acc, nbatches = run_stream(
         source, step, init, params, kind="streaming_krr",
-        fault_plan=fault_plan,
+        fault_plan=fault_plan, report=report,
     )
     G = fully_replicated(
         acc["g"] + jnp.asarray(lam, acc_dt) * jnp.eye(s, dtype=acc_dt)
     )
-    W = cho_solve(cho_factor(G, lower=True), acc["c"]).astype(dt)
+    c, low = cho_factor(G, lower=True)
+    if guarded and not guard.tree_all_finite(c):
+        # Singular/indefinite-by-rounding Gram: cho_factor NaNs silently;
+        # degrade to the eigh pseudoinverse rung instead of returning a
+        # poisoned model.
+        W = guard.pinv_psd_solve(G, acc["c"]).astype(dt)
+        report.record(
+            "fallback", verdict=guard.FALLBACK,
+            detail="non-finite Cholesky factor; eigh pseudoinverse solve",
+        )
+        report.recovered = True
+    else:
+        W = cho_solve((c, low), acc["c"]).astype(dt)
+    if guarded:
+        guard.check_finite(W, "streaming_krr", report=report)
     model = FeatureMapModel([S], W)
-    model.info = {"rows": int(acc["rows"]), "batches": nbatches}
+    model.info = {"rows": int(acc["rows"]), "batches": nbatches,
+                  "recovery": report.to_dict()}
     return model

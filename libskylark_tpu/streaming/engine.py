@@ -29,7 +29,7 @@ from itertools import islice
 
 import numpy as np
 
-from .. import plans
+from .. import guard, plans
 from ..resilient import ChunkedSolver, ResilientParams, ResilientRunner
 from .pipeline import Prefetcher, device_placer
 
@@ -141,6 +141,7 @@ def run_stream(
     kind: str = "streaming_pass",
     metadata: dict | None = None,
     fault_plan=None,
+    report=None,
 ):
     """Fold ``step_fn`` over ``source`` with resilient checkpoints.
 
@@ -148,6 +149,18 @@ def run_stream(
     consuming the stream (fixed-shape reductions — the streaming drivers
     know their output shapes up front), because it doubles as the resume
     prototype the checkpoint is validated against.
+
+    Guarding (``SKYLARK_GUARD``, on by default): sum-style accumulators
+    absorb NaNs, so ONE finiteness probe per chunk — read at the chunk
+    boundary, where the runner syncs anyway — observes a poisoned batch
+    from anywhere inside the chunk.  When it trips, the chunk's
+    accumulation REPLAYS from the chunk-entry accumulator over the
+    buffered (clean) blocks instead of restarting the whole pass; a
+    replay that stays non-finite raises ``NumericalHealthError``.  The
+    clean-block buffer holds at most ``checkpoint_every`` batches and
+    exists only while guarding is enabled.  ``report`` (a
+    ``guard.RecoveryReport``) collects replay attempts for the caller's
+    ``info["recovery"]``.
     """
     params = params or StreamParams()
     cursor = _Cursor(
@@ -157,9 +170,7 @@ def run_stream(
     def init_state():
         return {"batch": np.asarray(0, np.int64), "acc": init_acc}
 
-    def step_chunk(state, k):
-        b = int(state["batch"])
-        cursor.ensure(b)
+    def _entry_acc(state):
         acc = state["acc"]
         if plans.donation_enabled():
             # Donating step plans consume the accumulator buffers; the
@@ -167,12 +178,51 @@ def run_stream(
             # divergence guard re-runs chunks from it), so snapshot it
             # once per chunk before the first donation can land.
             acc = plans.copy_for_donation(acc)
+        return acc
+
+    def step_chunk(state, k):
+        guarded = guard.enabled()
+        b0 = int(state["batch"])
+        cursor.ensure(b0)
+        acc = _entry_acc(state)
+        blocks = [] if guarded else None
+        b = b0
         for _ in range(k):
             if cursor.pending is None:
                 break
-            acc = step_fn(acc, cursor.pending, b)
+            block = cursor.pending
+            if blocks is not None:
+                blocks.append(block)
+            if fault_plan is not None:
+                block = fault_plan.corrupt_block(b, block)
+            acc = step_fn(acc, block, b)
             b += 1
             cursor.advance()
+        if guarded and b > b0 and not guard.tree_all_finite(acc):
+            # Chunk sentinel tripped: replay this chunk's fold from the
+            # chunk-entry accumulator over the clean buffered blocks
+            # (the faults above are one-shot, so the replay folds clean
+            # data — same blocks, same order, bit-identical to an
+            # unfaulted chunk).
+            if report is not None:
+                report.record(
+                    "replay", chunk=b0,
+                    detail="non-finite accumulator; re-folding chunk",
+                )
+            acc = _entry_acc(state)
+            for j, block in enumerate(blocks):
+                if fault_plan is not None:
+                    block = fault_plan.corrupt_block(b0 + j, block)
+                acc = step_fn(acc, block, b0 + j)
+            if not guard.tree_all_finite(acc):
+                raise guard.NumericalHealthError(
+                    f"streaming accumulator non-finite after replay of "
+                    f"batches [{b0}, {b})",
+                    stage=kind,
+                    report=report,
+                )
+            if report is not None:
+                report.recovered = True
         return {"batch": np.asarray(b, np.int64), "acc": acc}
 
     def is_done(state):

@@ -15,6 +15,7 @@ __all__ = [
     "IOError_",
     "ConvergenceError",
     "CheckpointError",
+    "NumericalHealthError",
 ]
 
 
@@ -64,3 +65,20 @@ class CheckpointError(IOError_):
     so pre-existing IO error handling keeps working."""
 
     code = 107
+
+
+class NumericalHealthError(SkylarkError):
+    """A numerical-health guard fired and the recovery ladder could not
+    repair the computation (or guarding was disabled at a point where
+    the only safe continuation was a fallback).  ``stage`` names the
+    pipeline stage whose probe tripped (e.g. ``"sketch_ls"``,
+    ``"streaming_krr"``); ``report`` is the
+    :class:`~libskylark_tpu.guard.RecoveryReport` accumulated up to the
+    failure, so callers can inspect every attempt that was made."""
+
+    code = 108
+
+    def __init__(self, msg, stage=None, report=None):
+        super().__init__(msg)
+        self.stage = stage
+        self.report = report

@@ -36,10 +36,12 @@ import pytest  # noqa: E402
 # otherwise block the run forever.
 FAULTS_TIMEOUT_S = 120
 STREAMING_TIMEOUT_S = 120
+GUARD_TIMEOUT_S = 120
 
 _TIMEOUT_MARKS = {
     "faults": FAULTS_TIMEOUT_S,
     "streaming": STREAMING_TIMEOUT_S,
+    "guard": GUARD_TIMEOUT_S,
 }
 
 
@@ -61,6 +63,12 @@ def pytest_configure(config):
         "perf: performance/latency assertions (wall-clock thresholds, "
         "machine-sensitive); NOT tier-1 — auto-skipped unless "
         "SKYLARK_RUN_PERF=1",
+    )
+    config.addinivalue_line(
+        "markers",
+        "guard: numerical-health guard tests (sentinels, certification, "
+        "recovery ladder, fault-injected recovery); tier-1, guarded by a "
+        f"per-test {GUARD_TIMEOUT_S}s timeout",
     )
 
 

@@ -1,0 +1,484 @@
+"""Numerical-health guard layer: sentinels, certification, the recovery
+ladder, and fault-injected end-to-end recovery (ISSUE PR 4 acceptance).
+
+All tests run under the ``guard`` marker (tier-1, 120 s per-test alarm).
+x64 is on (conftest), so f64 is the default dtype throughout.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libskylark_tpu import guard
+from libskylark_tpu.core.context import SketchContext
+from libskylark_tpu.linalg.least_squares import (
+    approximate_least_squares,
+    exact_least_squares,
+    streaming_least_squares,
+)
+from libskylark_tpu.resilient import FaultPlan
+from libskylark_tpu.utils.exceptions import NumericalHealthError
+
+pytestmark = pytest.mark.guard
+
+
+def _ls_problem(rng, m=240, n=8, noise=1e-3):
+    """Tall LS problem with a planted solution, so recovered solutions
+    are comparable through their residuals."""
+    A = rng.normal(size=(m, n))
+    x_true = rng.normal(size=n)
+    b = A @ x_true + noise * rng.normal(size=m)
+    return jnp.asarray(A), jnp.asarray(b)
+
+
+def _residual(A, x, b):
+    return float(jnp.linalg.norm(A @ x - b))
+
+
+# ---------------------------------------------------------------------------
+# sentinels
+
+
+def test_finite_probe_trees(rng):
+    clean = {"a": jnp.ones((3, 2)), "n": jnp.arange(3)}
+    assert guard.tree_all_finite(clean)
+    poisoned = {"a": jnp.ones((3, 2)).at[1, 1].set(jnp.nan), "n": jnp.arange(3)}
+    assert not guard.tree_all_finite(poisoned)
+    # int-only trees are vacuously finite
+    assert guard.tree_all_finite({"n": jnp.arange(3)})
+
+
+def test_check_finite_raises_with_stage(rng):
+    with pytest.raises(NumericalHealthError) as ei:
+        guard.check_finite(jnp.asarray([1.0, jnp.inf]), "my_stage")
+    assert ei.value.stage == "my_stage"
+    assert ei.value.code == 108
+
+
+def test_finite_probe_is_jittable(rng):
+    f = jax.jit(lambda t: guard.finite_probe(t))
+    assert bool(f({"x": jnp.ones(4)}))
+    assert not bool(f({"x": jnp.asarray([1.0, jnp.nan])}))
+
+
+def test_guarded_entrypoints_work_under_enclosing_jit(rng):
+    """A caller may jit a whole pipeline around the guarded solvers (the
+    multichip dry run does exactly this); the host-side ladder cannot run
+    mid-trace, so the entrypoints must emit their plain unguarded graph
+    instead of raising ConcretizationTypeError."""
+    from libskylark_tpu.linalg.svd import approximate_svd
+
+    A, b = _ls_problem(rng, m=120, n=6)
+    assert not guard.is_traced(A, b)
+
+    @jax.jit
+    def step(A, b):
+        U, s, V = approximate_svd(A, 3, SketchContext(seed=7))
+        x = approximate_least_squares(A, b, SketchContext(seed=8))
+        return s, x
+
+    s, x = step(A, b)
+    assert np.isfinite(np.asarray(s)).all()
+    assert np.isfinite(np.asarray(x)).all()
+    x_eager = approximate_least_squares(A, b, SketchContext(seed=8))
+    np.testing.assert_allclose(
+        np.asarray(x), np.asarray(x_eager), rtol=1e-9, atol=1e-11
+    )
+
+
+# ---------------------------------------------------------------------------
+# certification
+
+
+def test_certify_sketch_ok_and_singular(rng):
+    M = jnp.asarray(rng.normal(size=(64, 8)))
+    cert = guard.certify_sketch(M)
+    assert cert.ok and cert.verdict == guard.OK
+    assert cert.cond is not None and cert.cond < 1e3
+    # rank collapse → RESKETCH (the bad_sketch_at injection shape)
+    bad = M.at[1:].set(0.0)
+    cert_bad = guard.certify_sketch(bad)
+    assert cert_bad.verdict == guard.RESKETCH
+
+
+def test_certify_sketch_nonfinite(rng):
+    M = jnp.full((16, 4), jnp.nan)
+    cert = guard.certify_sketch(M)
+    assert cert.verdict == guard.RESKETCH
+    assert "non-finite" in cert.detail
+
+
+def test_certify_svd_posterior(rng):
+    A = jnp.asarray(rng.normal(size=(40, 12)))
+    U, s, Vt = jnp.linalg.svd(A, full_matrices=False)
+    cert = guard.certify_svd(A, U, s, Vt.T)
+    assert cert.ok
+    # corrupt the leading left vector → posterior residual blows up
+    cert_bad = guard.certify_svd(A, -U, s, Vt.T)
+    assert cert_bad.verdict == guard.RESKETCH
+
+
+def test_pinv_psd_solve_matches_cholesky(rng):
+    Z = jnp.asarray(rng.normal(size=(50, 6)))
+    G = Z.T @ Z + 0.1 * jnp.eye(6)
+    C = jnp.asarray(rng.normal(size=(6, 2)))
+    X = guard.pinv_psd_solve(G, C)
+    np.testing.assert_allclose(
+        np.asarray(G @ X), np.asarray(C), rtol=1e-8, atol=1e-8
+    )
+
+
+# ---------------------------------------------------------------------------
+# ladder mechanics
+
+
+def test_derived_context_distinct_and_deterministic():
+    ctx = SketchContext(seed=42)
+    seeds = {guard.derived_context(ctx, i).seed for i in range(1, 5)}
+    assert len(seeds) == 4 and 42 not in seeds
+    assert (
+        guard.derived_context(ctx, 2).seed
+        == guard.derived_context(SketchContext(seed=42), 2).seed
+    )
+
+
+def test_run_ladder_growth_and_fallback():
+    calls = []
+
+    def attempt(ctx, s, i):
+        calls.append((int(ctx.seed), s, i))
+        return None, guard.Certificate(guard.RESKETCH, "t", detail="no")
+
+    result, report = guard.run_ladder(
+        "t", SketchContext(seed=1), 10, 100, attempt, lambda: "dense",
+        max_retries=3,
+    )
+    assert result == "dense"
+    # initial, resketch (same size), two grows (geometric), then fallback
+    assert [c[1] for c in calls] == [10, 10, 20, 40]
+    assert calls[0][0] == 1 and len({c[0] for c in calls}) == 4
+    d = report.to_dict()
+    assert d["recovered"] is True
+    assert [a["action"] for a in d["attempts"]] == [
+        "initial", "resketch", "grow", "grow", "fallback",
+    ]
+
+
+def test_run_ladder_exhaustion_raises_without_fallback():
+    def attempt(ctx, s, i):
+        return None, guard.Certificate(guard.RESKETCH, "t")
+
+    with pytest.raises(NumericalHealthError) as ei:
+        guard.run_ladder(
+            "t", SketchContext(seed=1), 4, 8, attempt, None, max_retries=1
+        )
+    assert ei.value.report is not None
+    assert len(ei.value.report.attempts) == 2
+
+
+# ---------------------------------------------------------------------------
+# end-to-end recovery: in-core sketch-and-solve (acceptance criteria)
+
+
+@pytest.mark.parametrize("fault", ["bad_sketch_at", "nan_at"])
+def test_approximate_ls_recovers_from_injected_fault(rng, fault):
+    A, b = _ls_problem(rng)
+    ctx = lambda: SketchContext(seed=11)
+    x_clean, info_clean = approximate_least_squares(
+        A, b, ctx(), return_info=True
+    )
+    assert info_clean["recovery"]["attempts"][0]["verdict"] == guard.OK
+    assert info_clean["recovery"]["recovered"] is False
+
+    plan = FaultPlan(**{fault: 0})
+    x_rec, info = approximate_least_squares(
+        A, b, ctx(), fault_plan=plan, return_info=True
+    )
+    rec = info["recovery"]
+    assert rec["recovered"] is True
+    assert rec["attempts"][0]["verdict"] == guard.RESKETCH
+    assert rec["attempts"][1]["action"] == "resketch"
+    assert rec["attempts"][1]["verdict"] == guard.OK
+    # Solution quality matches the fault-free run: both are sketch-and-
+    # solve answers to the same planted problem, compare residuals.
+    assert np.isfinite(np.asarray(x_rec)).all()
+    assert _residual(A, x_rec, b) <= 1.5 * _residual(A, x_clean, b) + 1e-9
+
+
+def test_approximate_ls_ladder_reaches_dense_fallback(rng):
+    A, b = _ls_problem(rng)
+    # Exhaust every sketch attempt (0 retries + a faulted attempt 0) so
+    # the dense rung answers; it must match the exact solution.
+    x, info = approximate_least_squares(
+        A, b, SketchContext(seed=13),
+        fault_plan=FaultPlan(nan_at=0), return_info=True,
+    )
+    x_exact = exact_least_squares(A, b, alg="svd")
+    rec = info["recovery"]
+    if rec["attempts"][-1]["action"] == "fallback":
+        np.testing.assert_allclose(
+            np.asarray(x), np.asarray(x_exact), rtol=1e-8, atol=1e-8
+        )
+    else:  # recovered earlier on the ladder — still a valid solve
+        assert _residual(A, x, b) <= 1.5 * _residual(A, x_exact, b) + 1e-9
+
+
+def test_approximate_ls_fallback_when_retries_zero(rng, monkeypatch):
+    monkeypatch.setenv("SKYLARK_GUARD_MAX_RETRIES", "0")
+    A, b = _ls_problem(rng)
+    x, info = approximate_least_squares(
+        A, b, SketchContext(seed=13),
+        fault_plan=FaultPlan(nan_at=0), return_info=True,
+    )
+    rec = info["recovery"]
+    assert [a["action"] for a in rec["attempts"]] == ["initial", "fallback"]
+    np.testing.assert_allclose(
+        np.asarray(x), np.asarray(exact_least_squares(A, b, alg="svd")),
+        rtol=1e-8, atol=1e-8,
+    )
+
+
+def test_guard_bypass_env(rng, monkeypatch):
+    A, b = _ls_problem(rng)
+    ctx = lambda: SketchContext(seed=17)
+    x_on = approximate_least_squares(A, b, ctx())
+    monkeypatch.setenv("SKYLARK_GUARD", "0")
+    x_off, info = approximate_least_squares(A, b, ctx(), return_info=True)
+    assert info["recovery"]["guarded"] is False
+    assert info["recovery"]["attempts"] == []
+    # guarding is bit-transparent on healthy runs
+    np.testing.assert_array_equal(np.asarray(x_on), np.asarray(x_off))
+    # bypassed + faulted = the silent-NaN behavior the guard exists for
+    x_bad = approximate_least_squares(
+        A, b, ctx(), fault_plan=FaultPlan(nan_at=0)
+    )
+    assert not np.isfinite(np.asarray(x_bad)).all()
+
+
+def test_guard_parity_healthy_run(rng, monkeypatch):
+    """Attempt 0 must reuse the caller's context: guarded == unguarded
+    bit-for-bit when the certificate passes."""
+    A, b = _ls_problem(rng)
+    x_on = approximate_least_squares(A, b, SketchContext(seed=23))
+    monkeypatch.setenv("SKYLARK_GUARD", "false")
+    x_off = approximate_least_squares(A, b, SketchContext(seed=23))
+    np.testing.assert_array_equal(np.asarray(x_on), np.asarray(x_off))
+
+
+# ---------------------------------------------------------------------------
+# end-to-end recovery: streaming (acceptance criteria)
+
+
+def _stream_factory(A, b, nbatches):
+    rows = A.shape[0] // nbatches
+
+    def factory(start):
+        return iter(
+            [
+                (
+                    jnp.asarray(A[i * rows : (i + 1) * rows]),
+                    jnp.asarray(b[i * rows : (i + 1) * rows]),
+                )
+                for i in range(start, nbatches)
+            ]
+        )
+
+    return factory
+
+
+@pytest.mark.streaming
+@pytest.mark.parametrize("fault", ["bad_sketch_at", "nan_at"])
+def test_streaming_ls_replays_poisoned_batch(rng, fault):
+    m, n, nb = 240, 6, 8
+    A = rng.normal(size=(m, n))
+    b = A @ rng.normal(size=n) + 1e-3 * rng.normal(size=m)
+    factory = _stream_factory(A, b, nb)
+    x0, info0 = streaming_least_squares(
+        factory, m, n, SketchContext(seed=3)
+    )
+    assert info0["recovery"]["recovered"] is False
+    plan = FaultPlan(**{fault: 3})
+    x1, info1 = streaming_least_squares(
+        factory, m, n, SketchContext(seed=3), fault_plan=plan
+    )
+    rec = info1["recovery"]
+    assert rec["recovered"] is True
+    assert any(a["action"] == "replay" for a in rec["attempts"])
+    # One-shot fault + chunk replay = bit-identical to the clean pass.
+    np.testing.assert_array_equal(np.asarray(x0), np.asarray(x1))
+
+
+@pytest.mark.streaming
+def test_streaming_ls_unrecoverable_raises(rng):
+    """A fault that is NOT one-shot (poison re-applied on replay) must
+    surface as NumericalHealthError, not silent NaNs."""
+    m, n, nb = 120, 4, 4
+
+    class StickyPlan(FaultPlan):
+        def _fire(self, kind, scheduled, index):
+            return scheduled is not None and index == scheduled
+
+    A = rng.normal(size=(m, n))
+    b = rng.normal(size=m)
+    with pytest.raises(NumericalHealthError):
+        streaming_least_squares(
+            _stream_factory(A, b, nb), m, n, SketchContext(seed=3),
+            fault_plan=StickyPlan(nan_at=1),
+        )
+
+
+@pytest.mark.streaming
+def test_streaming_krr_replays_poisoned_batch(rng):
+    from libskylark_tpu.ml.kernels import GaussianKernel
+    from libskylark_tpu.ml.krr import streaming_approximate_kernel_ridge
+
+    n, d, nb = 160, 4, 8
+    X = rng.normal(size=(n, d))
+    y = rng.normal(size=n)
+    kernel = GaussianKernel(d, 1.0)
+    rows = n // nb
+
+    def factory(start):
+        return iter(
+            [
+                (
+                    jnp.asarray(X[i * rows : (i + 1) * rows]),
+                    jnp.asarray(y[i * rows : (i + 1) * rows]),
+                )
+                for i in range(start, nb)
+            ]
+        )
+
+    m0 = streaming_approximate_kernel_ridge(
+        kernel, factory, 0.1, 32, SketchContext(seed=5)
+    )
+    m1 = streaming_approximate_kernel_ridge(
+        kernel, factory, 0.1, 32, SketchContext(seed=5),
+        fault_plan=FaultPlan(nan_at=2),
+    )
+    assert m1.info["recovery"]["recovered"] is True
+    assert any(
+        a["action"] == "replay" for a in m1.info["recovery"]["attempts"]
+    )
+    np.testing.assert_array_equal(np.asarray(m0.W), np.asarray(m1.W))
+
+
+# ---------------------------------------------------------------------------
+# satellite: ne silent-NaN fix
+
+
+def test_exact_ls_ne_rank_deficient_no_silent_nans(rng):
+    A4 = rng.normal(size=(60, 4))
+    A = jnp.asarray(np.concatenate([A4, A4], axis=1))  # rank 4 of 8
+    b = jnp.asarray(rng.normal(size=60))
+    x = exact_least_squares(A, b, alg="ne")
+    assert np.isfinite(np.asarray(x)).all()
+    # and it solves the problem as well as the pseudoinverse path
+    x_svd = exact_least_squares(A, b, alg="svd")
+    assert _residual(A, x, b) <= _residual(A, x_svd, b) * (1 + 1e-8) + 1e-9
+
+
+def test_exact_ls_ne_rank_deficient_under_jit(rng):
+    A4 = rng.normal(size=(60, 4))
+    A = jnp.asarray(np.concatenate([A4, A4], axis=1))
+    b = jnp.asarray(rng.normal(size=60))
+    x = jax.jit(lambda A, b: exact_least_squares(A, b, alg="ne"))(A, b)
+    assert np.isfinite(np.asarray(x)).all()
+
+
+def test_exact_ls_ne_raises_when_guard_off(rng, monkeypatch):
+    monkeypatch.setenv("SKYLARK_GUARD", "0")
+    A4 = rng.normal(size=(60, 4))
+    A = jnp.asarray(np.concatenate([A4, A4], axis=1))
+    b = jnp.asarray(rng.normal(size=60))
+    with pytest.raises(NumericalHealthError) as ei:
+        exact_least_squares(A, b, alg="ne")
+    assert ei.value.stage == "exact_ls_ne"
+
+
+def test_exact_ls_ne_well_conditioned_unchanged(rng):
+    A = jnp.asarray(rng.normal(size=(60, 5)))
+    b = jnp.asarray(rng.normal(size=60))
+    x_ne = exact_least_squares(A, b, alg="ne")
+    x_qr = exact_least_squares(A, b, alg="qr")
+    np.testing.assert_allclose(
+        np.asarray(x_ne), np.asarray(x_qr), rtol=1e-8, atol=1e-10
+    )
+
+
+# ---------------------------------------------------------------------------
+# randomized SVD certification
+
+
+def test_approximate_svd_healthy_certifies_ok(rng):
+    from libskylark_tpu.linalg.svd import approximate_svd
+
+    A = jnp.asarray(rng.normal(size=(80, 20)))
+    (U, s, V), info = approximate_svd(
+        A, 4, SketchContext(seed=9), return_info=True
+    )
+    rec = info["recovery"]
+    assert rec["attempts"][0]["verdict"] == guard.OK
+    assert rec["recovered"] is False
+    assert np.isfinite(np.asarray(s)).all()
+
+
+def test_approximate_svd_guard_off_parity(rng, monkeypatch):
+    from libskylark_tpu.linalg.svd import approximate_svd
+
+    A = jnp.asarray(rng.normal(size=(80, 20)))
+    U1, s1, V1 = approximate_svd(A, 4, SketchContext(seed=9))
+    monkeypatch.setenv("SKYLARK_GUARD", "0")
+    U2, s2, V2 = approximate_svd(A, 4, SketchContext(seed=9))
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))
+    np.testing.assert_array_equal(np.asarray(U1), np.asarray(U2))
+
+
+# ---------------------------------------------------------------------------
+# solver info plumbing
+
+
+def test_blendenpik_info_recovery(rng):
+    from libskylark_tpu.solvers.accelerated import faster_least_squares
+
+    A, b = _ls_problem(rng)
+    X, info = faster_least_squares(A, b, SketchContext(seed=19))
+    rec = info["recovery"]
+    assert rec["guarded"] is True
+    assert rec["attempts"][0]["action"] == "initial"
+    assert rec["attempts"][0]["verdict"] in (guard.OK, guard.RESKETCH)
+
+
+def test_lsrn_info_recovery(rng):
+    from libskylark_tpu.solvers.accelerated import lsrn_least_squares
+
+    A, b = _ls_problem(rng)
+    X, info = lsrn_least_squares(A, b, SketchContext(seed=19))
+    assert info["recovery"]["guarded"] is True
+    assert np.isfinite(np.asarray(X)).all()
+
+
+def test_approximate_krr_info_recovery(rng):
+    from libskylark_tpu.ml.kernels import GaussianKernel
+    from libskylark_tpu.ml.krr import approximate_kernel_ridge
+
+    X = jnp.asarray(rng.normal(size=(80, 4)))
+    y = jnp.asarray(rng.normal(size=80))
+    model = approximate_kernel_ridge(
+        GaussianKernel(4, 1.0), X, y, 0.1, 16, SketchContext(seed=29)
+    )
+    assert model.info["recovery"]["guarded"] is True
+    assert np.isfinite(np.asarray(model.W)).all()
+
+
+def test_guard_config_knobs(monkeypatch):
+    assert guard.enabled()
+    monkeypatch.setenv("SKYLARK_GUARD", "0")
+    assert not guard.enabled()
+    monkeypatch.setenv("SKYLARK_GUARD", "1")
+    assert guard.enabled()
+    monkeypatch.setenv("SKYLARK_GUARD_MAX_RETRIES", "7")
+    assert guard.max_retries() == 7
+    monkeypatch.setenv("SKYLARK_GUARD_COND_MAX", "123.5")
+    assert guard.cond_max() == 123.5

@@ -133,3 +133,51 @@ def test_halton_window_zero_dims():
 
     out = LeapedHaltonSequence(0, leap=7).window(0, 4)
     assert out.shape == (4, 0)
+
+
+@pytest.mark.guard
+def test_solver_entrypoints_document_and_populate_recovery():
+    """Static contract check (ISSUE PR 4): every public solver entrypoint
+    that returns ``(x, info)`` must document ``info["recovery"]`` in its
+    docstring AND populate it in source, so the guard ledger can never be
+    silently dropped from one solver's info dict."""
+    import inspect
+
+    from libskylark_tpu.linalg.least_squares import (
+        approximate_least_squares,
+        streaming_least_squares,
+    )
+    from libskylark_tpu.ml.krr import (
+        approximate_kernel_ridge,
+        streaming_approximate_kernel_ridge,
+    )
+    from libskylark_tpu.solvers.accelerated import (
+        faster_least_squares,
+        lsrn_least_squares,
+    )
+    from libskylark_tpu.streaming.drivers import sketch_least_squares
+
+    entrypoints = [
+        approximate_least_squares,
+        streaming_least_squares,
+        faster_least_squares,
+        lsrn_least_squares,
+        sketch_least_squares,
+        approximate_kernel_ridge,  # ledger rides on model.info
+        streaming_approximate_kernel_ridge,
+    ]
+    for fn in entrypoints:
+        doc = inspect.getdoc(fn) or ""
+        assert '"recovery"' in doc or "recovery" in doc, (
+            f"{fn.__module__}.{fn.__name__} returns an info dict but its "
+            f'docstring does not document info["recovery"]'
+        )
+        src = inspect.getsource(fn)
+        assert '"recovery"' in src or "report.to_dict()" in src or (
+            # thin wrappers may delegate the ledger to the layer below —
+            # but then the delegate must populate it
+            "sketch_least_squares" in src
+        ), (
+            f"{fn.__module__}.{fn.__name__} does not populate "
+            f'info["recovery"] (or delegate to a layer that does)'
+        )

@@ -233,6 +233,67 @@ class TestCondEst:
         r = cond_est(A_pre, SketchContext(seed=34))
         assert float(r.cond) < 3.0
 
+    # -- adversarial inputs: estimates must come back as certificates, --
+    # -- never as crashes or NaNs (guard layer depends on this)        --
+
+    @pytest.mark.guard
+    def test_rank_deficient_certifies_not_crashes(self, rng):
+        """Exactly rank-deficient A: xhat has a null-space component LSQR
+        can never resolve, so the certified σ_min collapses toward 0 —
+        the result must stay finite with a huge cond (or the -4 singular
+        flag), not NaN-poison downstream guards."""
+        B = rng.standard_normal((120, 6))
+        A = jnp.asarray(np.concatenate([B, B], axis=1))  # rank 6 of 12
+        r = cond_est(A, SketchContext(seed=41))
+        for field in r:
+            assert np.isfinite(np.asarray(field)).all()
+        assert float(r.cond) > 1e6 or int(r.flag) == -4
+        # certificates still honor the contract A v ≈ σ u
+        res_min = float(
+            jnp.linalg.norm(A @ r.v_min - r.sigma_min_c * r.u_min)
+        )
+        assert res_min < 1e-4 * float(r.sigma_max)
+
+    @pytest.mark.guard
+    def test_orthogonal_cond_one_early_exit(self, rng):
+        """cond(Q) = 1 exactly: the sweep must terminate via an early-exit
+        flag (cond≈1 / C1 / C2), reporting cond ≈ 1 — not run to the -6
+        iteration limit."""
+        Q = jnp.asarray(np.linalg.qr(rng.standard_normal((80, 16)))[0])
+        r = cond_est(Q, SketchContext(seed=43))
+        assert float(r.cond) < 1.2
+        assert int(r.flag) in (-1, -2, -3)
+
+    @pytest.mark.guard
+    def test_power_iteration_zero_start_vector(self, rng):
+        """A zero v0 must fall back to a uniform start inside
+        _power_sigma_max and still certify the dominant triplet — the
+        unguarded 0/0 normalization would NaN every downstream field."""
+        from libskylark_tpu.solvers.cond_est import _power_sigma_max
+
+        A = jnp.asarray(rng.standard_normal((60, 8)))
+        sigma, u, v = _power_sigma_max(
+            lambda x: A @ x, lambda y: A.T @ y, jnp.zeros(8), 100
+        )
+        for field in (sigma, u, v):
+            assert np.isfinite(np.asarray(field)).all()
+        want = float(jnp.linalg.norm(A, ord=2))
+        assert abs(float(sigma) - want) < 1e-6 * want
+        assert float(jnp.linalg.norm(A @ v - sigma * u)) < 1e-8 * want
+
+    @pytest.mark.guard
+    def test_power_iteration_near_zero_start_vector(self, rng):
+        """A denormal-scale v0 normalizes through the guard unchanged."""
+        from libskylark_tpu.solvers.cond_est import _power_sigma_max
+
+        A = jnp.asarray(rng.standard_normal((60, 8)))
+        v0 = jnp.asarray(rng.standard_normal(8)) * 1e-300
+        sigma, u, v = _power_sigma_max(
+            lambda x: A @ x, lambda y: A.T @ y, v0, 100
+        )
+        assert np.isfinite(np.asarray(sigma)) and float(sigma) > 0
+        assert abs(float(jnp.linalg.norm(v)) - 1.0) < 1e-8
+
 
 class TestBlockGaussSeidel:
     @pytest.mark.slow
