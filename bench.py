@@ -47,6 +47,14 @@ from libskylark_tpu.core.context import SketchContext
 _T0 = time.monotonic()
 _BUDGET_S = float(os.environ.get("SKYLARK_BENCH_BUDGET_S", "1500"))
 
+# Global-budget slice the accelerator init loop must LEAVE for the CPU
+# fallback (init + the CPU-sized config list).  BENCH_r05: the init loop
+# burned the whole 1500 s budget on a hung tunnel and the fallback never
+# got to run, so the round recorded -1 rows despite the fallback existing.
+_FALLBACK_MARGIN_S = float(
+    os.environ.get("SKYLARK_BENCH_FALLBACK_MARGIN_S", "120")
+)
+
 
 def _remaining() -> float:
     """Seconds left in the global bench budget."""
@@ -818,6 +826,60 @@ def bench_guard_overhead(on_tpu, table):
     )
 
 
+def bench_telemetry(on_tpu, table):
+    """Telemetry-layer submetric: one streamed sketch-and-solve LS pass
+    under ``SKYLARK_TELEMETRY=1``, reporting the two derived ratios of
+    ``telemetry.snapshot()`` (docs/observability.md): the plan-cache hit
+    rate of the pass and the prefetch producer/consumer overlap.  First
+    capture: vs_baseline fixed at 1.0 (BASELINE.md records the values)."""
+    from libskylark_tpu import plans, telemetry
+    from libskylark_tpu.linalg import streaming_least_squares
+
+    if on_tpu:
+        n, d, br = 262_144, 512, 32_768
+    else:
+        n, d, br = 8192, 64, 1024
+
+    def batches(start):
+        rng = np.random.default_rng(21)
+        for i in range(n // br):
+            X = rng.standard_normal((br, d)).astype(np.float32)
+            y = rng.standard_normal(br).astype(np.float32)
+            if i >= start:
+                yield X, y
+
+    prev = os.environ.get("SKYLARK_TELEMETRY")
+    os.environ["SKYLARK_TELEMETRY"] = "1"
+    telemetry.reset()
+    plans.reset()
+    try:
+        streaming_least_squares(batches, n, d, SketchContext(seed=88))
+        snap = telemetry.snapshot()
+    finally:
+        if prev is None:
+            os.environ.pop("SKYLARK_TELEMETRY", None)
+        else:
+            os.environ["SKYLARK_TELEMETRY"] = prev
+    hit = snap["plan_cache_hit_rate"]
+    overlap = snap["prefetch_overlap"]
+    _emit(
+        f"telemetry plan-cache hit rate (streamed LS {n}x{d})",
+        hit if hit is not None else -1,
+        "ratio",
+        1.0,
+        table,
+        contention=None,  # counter ratio, not a timing
+    )
+    _emit(
+        f"telemetry prefetch overlap (streamed LS {n}x{d})",
+        overlap if overlap is not None else -1,
+        "ratio",
+        1.0,
+        table,
+        contention=None,  # counter ratio, not a timing
+    )
+
+
 _FINAL: dict | None = None
 _FINAL_PRINTED = False
 
@@ -881,13 +943,23 @@ def _init_backend():
                         "value": round(_remaining(), 1),
                         "unit": "s-remaining",
                         "vs_baseline": 0,
-                        "error": last[:200],
+                        "error": last[:500],
                     }
                 ),
                 file=sys.stderr,
                 flush=True,
             )
-        if time.monotonic() - _T0 > init_budget:
+        # Two budget checks (BENCH_r05: a single blocked jax.devices()
+        # attempt can eat many minutes, so an init-budget-only check can
+        # overshoot the GLOBAL budget and leave the CPU fallback no time
+        # to run — the round then records -1 backend-unavailable rows
+        # despite the fallback existing).  Stop retrying the accelerator
+        # while the remaining global budget can still fit the fallback
+        # plus the CPU-sized config list.
+        if (
+            time.monotonic() - _T0 > init_budget
+            or _remaining() < _FALLBACK_MARGIN_S
+        ):
             return _BackendUnavailable(last)
         try:  # un-stick the cached failure so the next attempt is real
             import jax.extend.backend as _eb
@@ -909,14 +981,31 @@ def _cpu_fallback(sentinel: _BackendUnavailable):
     if even local CPU init fails."""
     global _BACKEND_TAG
     os.environ["JAX_PLATFORMS"] = "cpu"
-    try:
-        jax.config.update("jax_platforms", "cpu")
-        import jax.extend.backend as _eb
+    # Multiple attempts, each step individually firewalled (BENCH_r05:
+    # the fallback was a single try block, so ONE failing sub-step — a
+    # clear_backends() quirk, a stale config — lost the whole rescue and
+    # the reason vanished into the truncated error field).
+    errors: list[str] = []
+    dev = None
+    for attempt in range(3):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception as e:  # noqa: BLE001 — best-effort; env var rules
+            errors.append(f"config: {type(e).__name__}: {e}")
+        try:
+            import jax.extend.backend as _eb
 
-        _eb.clear_backends()  # drop the cached accelerator-init failure
-        dev = jax.devices("cpu")[0]
-    except Exception as e:  # noqa: BLE001 — then the FAILED artifact stands
-        sentinel.error += f"; cpu-fallback failed: {type(e).__name__}: {e}"
+            _eb.clear_backends()  # drop the cached accelerator-init failure
+        except Exception as e:  # noqa: BLE001 — best-effort
+            errors.append(f"clear: {type(e).__name__}: {e}")
+        try:
+            dev = jax.devices("cpu")[0]
+            break
+        except Exception as e:  # noqa: BLE001 — retry; CPU init is local
+            errors.append(f"devices[{attempt}]: {type(e).__name__}: {e}")
+            time.sleep(2.0)
+    if dev is None:
+        sentinel.error += "; cpu-fallback failed: " + " | ".join(errors)
         return sentinel
     _BACKEND_TAG = "cpu-fallback"
     print(
@@ -927,7 +1016,7 @@ def _cpu_fallback(sentinel: _BackendUnavailable):
                 "unit": "info",
                 "vs_baseline": 0,
                 "backend": _BACKEND_TAG,
-                "error": sentinel.error[:200],
+                "error": sentinel.error[:500],
             }
         ),
         file=sys.stderr,
@@ -978,7 +1067,7 @@ def main() -> None:
             "value": -1,
             "unit": "error",
             "vs_baseline": 0,
-            "error": dev.error[:200],
+            "error": dev.error[:800],
         }
         print(json.dumps(row), flush=True)
         _FINAL = dict(row, submetrics=[dict(row)])
@@ -1090,6 +1179,9 @@ def main() -> None:
         # Guard overhead next among never-captured rows: the round-6
         # robustness-layer measurement (docs/numerical_health.md).
         ("guard overhead", 60, lambda: bench_guard_overhead(on_tpu, table)),
+        # Telemetry ratios ride with the never-captured rows: cheap, and
+        # they certify the observability layer on real hardware.
+        ("telemetry", 60, lambda: bench_telemetry(on_tpu, table)),
         ("streaming SVD", 150, lambda: bench_streaming_svd(on_tpu, table)),
         ("sparse CWT", 150, lambda: bench_sparse_cwt(on_tpu, table)),
         ("QRFT", 90, lambda: bench_qrft(on_tpu, table)),

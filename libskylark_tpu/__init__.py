@@ -23,6 +23,7 @@ from . import (
     sketch,
     solvers,
     streaming,
+    telemetry,
     utils,
 )
 from .core import SketchContext
@@ -40,6 +41,7 @@ __all__ = [
     "sketch",
     "solvers",
     "streaming",
+    "telemetry",
     "utils",
     "SketchContext",
     "__version__",

@@ -7,12 +7,15 @@ import numpy as np
 __all__ = [
     "FILE_FORMATS",
     "add_perf_args",
+    "add_telemetry_args",
     "load_classes",
     "load_dataset",
     "print_perf_report",
+    "print_telemetry_report",
     "print_test_metrics",
     "scan_dims",
     "setup_perf",
+    "setup_telemetry",
     "stream_dataset",
 ]
 
@@ -72,6 +75,61 @@ def print_perf_report(args) -> None:
         f"{st['size']}/{st['max_size']} plans resident"
         + (f", {st['evictions']} evicted" if st["evictions"] else "")
     )
+
+def add_telemetry_args(p) -> None:
+    """The shared telemetry flags (every driver; docs/observability.md)."""
+    p.add_argument(
+        "--telemetry", action="store_true",
+        help="enable the telemetry layer (sets SKYLARK_TELEMETRY=1): "
+             "spans + counters in-process, and the JSONL run ledger "
+             "when --telemetry-dir is also given",
+    )
+    p.add_argument(
+        "--telemetry-dir", default=None,
+        help="directory for the JSONL run ledger "
+             "(ledger-<pid>.jsonl; implies --telemetry)",
+    )
+
+
+def _telemetry_requested(args) -> bool:
+    return bool(
+        getattr(args, "telemetry", False)
+        or getattr(args, "telemetry_dir", None)
+    )
+
+
+def setup_telemetry(args) -> None:
+    """Apply --telemetry/--telemetry-dir before the solve starts."""
+    if not _telemetry_requested(args):
+        return
+    import os
+
+    from .. import telemetry
+
+    os.environ["SKYLARK_TELEMETRY"] = "1"
+    if args.telemetry_dir:
+        telemetry.configure(args.telemetry_dir)
+
+
+def print_telemetry_report(args) -> None:
+    """Close out a --telemetry run: one summary line + the ledger path."""
+    if not _telemetry_requested(args):
+        return
+    from .. import telemetry
+
+    snap = telemetry.snapshot()
+    hit = snap["plan_cache_hit_rate"]
+    overlap = snap["prefetch_overlap"]
+    print(
+        "telemetry: "
+        f"plan-cache hit rate {hit if hit is not None else 'n/a'}, "
+        f"prefetch overlap {overlap if overlap is not None else 'n/a'}, "
+        f"guard {snap['guard'] or {}}, checkpoint {snap['checkpoint'] or {}}"
+    )
+    telemetry.flush()
+    if telemetry.ledger_path():
+        print(f"telemetry ledger -> {telemetry.ledger_path()}")
+
 
 # ≙ the reference's --fileformat choices (ml/options.hpp:46-47,173-174):
 # libsvm covers LIBSVM_DENSE/LIBSVM_SPARSE (the --sparse flag picks the

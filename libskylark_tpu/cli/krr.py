@@ -13,7 +13,15 @@ import time
 
 import numpy as np
 
-from .common import FILE_FORMATS, add_perf_args, print_perf_report, setup_perf
+from .common import (
+    FILE_FORMATS,
+    add_perf_args,
+    add_telemetry_args,
+    print_perf_report,
+    print_telemetry_report,
+    setup_perf,
+    setup_telemetry,
+)
 
 _ALGS = {0: "exact", 1: "faster", 2: "approximate", 3: "sketched", 4: "largescale"}
 
@@ -75,6 +83,7 @@ def main(argv=None) -> int:
     p.add_argument("--batch-rows", type=int, default=4096,
                    help="rows per streamed batch (with --stream)")
     add_perf_args(p)
+    add_telemetry_args(p)
     args = p.parse_args(argv)
 
     import jax
@@ -82,6 +91,7 @@ def main(argv=None) -> int:
     if args.x64:
         jax.config.update("jax_enable_x64", True)
     setup_perf(args)
+    setup_telemetry(args)
     import jax.numpy as jnp
 
     from ..core.context import SketchContext
@@ -160,6 +170,7 @@ def main(argv=None) -> int:
         Xtj = Xt if is_sparse else jnp.asarray(Xt)
         print_test_metrics(model, Xtj, yt, args.regression)
     print_perf_report(args)
+    print_telemetry_report(args)
     return 0
 
 
@@ -219,6 +230,7 @@ def _stream_main(args, is_sparse: bool) -> int:
         Xtj = Xt if is_sparse else jnp.asarray(Xt)
         print_test_metrics(model, Xtj, yt, args.regression)
     print_perf_report(args)
+    print_telemetry_report(args)
     return 0
 
 

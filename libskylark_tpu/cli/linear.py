@@ -10,7 +10,14 @@ import time
 
 import numpy as np
 
-from .common import add_perf_args, print_perf_report, setup_perf
+from .common import (
+    add_perf_args,
+    add_telemetry_args,
+    print_perf_report,
+    print_telemetry_report,
+    setup_perf,
+    setup_telemetry,
+)
 
 
 def main(argv=None) -> int:
@@ -39,6 +46,7 @@ def main(argv=None) -> int:
                    help="resume a streamed pass from the newest valid "
                         "checkpoint in --checkpoint-dir")
     add_perf_args(p)
+    add_telemetry_args(p)
     args = p.parse_args(argv)
 
     import jax
@@ -46,6 +54,7 @@ def main(argv=None) -> int:
     if args.x64:
         jax.config.update("jax_enable_x64", True)
     setup_perf(args)
+    setup_telemetry(args)
     import jax.numpy as jnp
 
     from ..core.context import SketchContext
@@ -84,6 +93,7 @@ def main(argv=None) -> int:
     np.save(args.solution, x)
     print(f"Solution -> {args.solution}")
     print_perf_report(args)
+    print_telemetry_report(args)
     return 0
 
 
@@ -135,6 +145,7 @@ def _stream_main(args) -> int:
     np.save(args.solution, x)
     print(f"Solution -> {args.solution}")
     print_perf_report(args)
+    print_telemetry_report(args)
     return 0
 
 

@@ -13,7 +13,12 @@ import time
 
 import numpy as np
 
-from .common import FILE_FORMATS
+from .common import (
+    FILE_FORMATS,
+    add_telemetry_args,
+    print_telemetry_report,
+    setup_telemetry,
+)
 
 
 def main(argv=None) -> int:
@@ -60,12 +65,14 @@ def main(argv=None) -> int:
     p.add_argument("--resume", action="store_true",
                    help="resume training from the newest valid checkpoint "
                         "in --checkpoint-dir")
+    add_telemetry_args(p)
     args = p.parse_args(argv)
 
     import jax
 
     if args.x64:
         jax.config.update("jax_enable_x64", True)
+    setup_telemetry(args)
     import jax.numpy as jnp
 
     from ..core.context import SketchContext
@@ -205,6 +212,7 @@ def main(argv=None) -> int:
             )
             Xtj = Xt if is_sparse else jnp.asarray(Xt)
             print_test_metrics(model, Xtj, yt, args.regression)
+    print_telemetry_report(args)
     return 0
 
 

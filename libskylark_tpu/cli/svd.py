@@ -20,6 +20,8 @@ import time
 
 import numpy as np
 
+from .common import add_telemetry_args, print_telemetry_report, setup_telemetry
+
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
@@ -80,12 +82,14 @@ def main(argv=None) -> int:
         help="with --profile: stream row panels of this size instead of "
         "materializing A (memory-bounded; any M divisible by BLOCK_ROWS)",
     )
+    add_telemetry_args(p)
     args = p.parse_args(argv)
 
     import jax
 
     if args.x64:
         jax.config.update("jax_enable_x64", True)
+    setup_telemetry(args)
     import jax.numpy as jnp
 
     from ..core.context import SketchContext
@@ -124,6 +128,7 @@ def main(argv=None) -> int:
         print(f"Rank-{args.rank} streaming SVD of {m}x{n} in {dt:.3f}s "
               f"({m // args.stream} panels; U factored, not saved)")
         print(f"Leading singular values: {np.asarray(s)[: min(5, len(s))]}")
+        print_telemetry_report(args)
         return 0
 
     if args.profile:
@@ -191,6 +196,7 @@ def main(argv=None) -> int:
         print(f"Rank-{args.rank} symmetric SVD of {Ad.shape[0]}"
               f"x{Ad.shape[1]} in {dt:.3f}s")
         print(f"Leading eigenvalues: {np.asarray(lam)[: min(5, len(lam))]}")
+        print_telemetry_report(args)
         return 0
 
     n_orig = None
@@ -214,6 +220,7 @@ def main(argv=None) -> int:
     write(".V", V)
     print(f"Rank-{args.rank} SVD of {U.shape[0]}x{V.shape[0]} in {dt:.3f}s")
     print(f"Leading singular values: {np.asarray(s)[: min(5, len(s))]}")
+    print_telemetry_report(args)
     return 0
 
 

@@ -75,6 +75,17 @@ class RecoveryReport:
     def record(self, action: str, **kw) -> RecoveryAttempt:
         a = RecoveryAttempt(action=action, **kw)
         self.attempts.append(a)
+        from .. import telemetry
+
+        if telemetry.enabled():
+            # One ledger event per rung taken, with the certificate's
+            # verdict riding along — the run ledger's view of the ladder.
+            attrs = a.to_dict()
+            attrs["stage"] = self.stage
+            attrs["rung"] = len(self.attempts) - 1
+            telemetry.event("guard", action, attrs)
+            telemetry.inc("guard.attempts")
+            telemetry.inc(f"guard.{action}")
         return a
 
     def to_dict(self) -> dict:

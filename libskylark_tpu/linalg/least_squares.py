@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.linalg import cho_factor, cho_solve, solve_triangular
 
-from .. import guard, plans
+from .. import guard, plans, telemetry
 from ..core.context import SketchContext
 from ..core.params import Params
 from ..sketch.base import Dimension, create_sketch
@@ -169,7 +169,9 @@ def approximate_least_squares(
         out = X[:, 0] if squeeze else X
         if return_info:
             report = guard.RecoveryReport.disabled("sketch_and_solve_ls")
-            return out, {"recovery": report.to_dict()}
+            info = {"recovery": report.to_dict()}
+            telemetry.run_summary("sketch_and_solve_ls", info)
+            return out, info
         return out
 
     def attempt(ctx, s_i, i):
@@ -199,8 +201,10 @@ def approximate_least_squares(
         "sketch_and_solve_ls", context, s, m, attempt, fallback
     )
     out = X[:, 0] if squeeze else X
+    info = {"recovery": report.to_dict()}
+    telemetry.run_summary("sketch_and_solve_ls", info)
     if return_info:
-        return out, {"recovery": report.to_dict()}
+        return out, info
     return out
 
 

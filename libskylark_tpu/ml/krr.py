@@ -31,7 +31,7 @@ import jax
 import jax.numpy as jnp
 from jax.scipy.linalg import cho_factor, cho_solve, solve_triangular
 
-from .. import guard, plans
+from .. import guard, plans, telemetry
 from ..core.context import SketchContext
 from ..core.params import Params
 from ..parallel.mesh import fully_replicated
@@ -166,6 +166,7 @@ def approximate_kernel_ridge(
         guard.check_finite(W, "approximate_krr", report=report)
     model = FeatureMapModel([S], W)
     model.info = {"recovery": report.to_dict()}
+    telemetry.run_summary("approximate_krr", model.info)
     return model
 
 

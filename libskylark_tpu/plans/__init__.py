@@ -12,7 +12,7 @@ The perf layer between the sketch transforms and their consumers (see
 """
 
 from .bucketing import bucket_ladder, bucket_rows, pad_rows
-from .cache import PLAN_CACHE, clear, reset_stats, set_cache_size, stats
+from .cache import PLAN_CACHE, clear, reset, reset_stats, set_cache_size, stats
 from .plan import (
     SketchPlan,
     accumulate_slice,
@@ -40,6 +40,7 @@ __all__ = [
     "SketchPlan",
     "PLAN_CACHE",
     "stats",
+    "reset",
     "reset_stats",
     "clear",
     "set_cache_size",

@@ -32,7 +32,11 @@ import os
 import threading
 from collections import OrderedDict
 
-__all__ = ["stats", "reset_stats", "clear", "set_cache_size", "PlanCache"]
+from .. import telemetry
+
+__all__ = [
+    "stats", "reset", "reset_stats", "clear", "set_cache_size", "PlanCache",
+]
 
 
 def _default_size() -> int:
@@ -75,8 +79,14 @@ class PlanCache:
             if plan is not None:
                 self._counters["hits"] += 1
                 self._plans.move_to_end(key)
-                return plan
-            self._counters["misses"] += 1
+            else:
+                self._counters["misses"] += 1
+        if telemetry.enabled():
+            telemetry.event(
+                "plan", "cache", {"hit": plan is not None, "plan": key[0]}
+            )
+        if plan is not None:
+            return plan
         # Build outside the lock (builders may trip jax machinery);
         # double-insert under contention just wastes one builder call.
         plan = builder()
@@ -123,9 +133,14 @@ def stats() -> dict:
     return PLAN_CACHE.stats()
 
 
-def reset_stats() -> None:
-    """Zero the counters (the compiled plans stay cached)."""
+def reset() -> None:
+    """Zero the counters (the compiled plans stay cached) — the canonical
+    test hook: poke this, not ``PLAN_CACHE._counters``."""
     PLAN_CACHE.reset_stats()
+
+
+# Back-compat name; ``reset()`` is the documented hook.
+reset_stats = reset
 
 
 def clear() -> None:

@@ -34,11 +34,13 @@ overrides the backend-based donation default.
 from __future__ import annotations
 
 import os
+import threading
 import time
 
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..sketch.base import Dimension
 from .bucketing import bucket_rows, pad_rows
 from .cache import PLAN_CACHE
@@ -118,6 +120,10 @@ class SketchPlan:
         self.calls = 0
         self.traces = 0
         self.compile_seconds = 0.0
+        # First-call accounting must be claimed atomically: two threads
+        # racing the same cold plan would otherwise both time the compile
+        # and double-bump the process counters.
+        self._lock = threading.Lock()
 
         def traced(*args):
             self.traces += 1
@@ -128,17 +134,23 @@ class SketchPlan:
         self._jit = jax.jit(traced, **kw)
 
     def __call__(self, *args):
-        first = self.calls == 0
+        with self._lock:
+            first = self.calls == 0
+            self.calls += 1
         if first:
             t0 = time.perf_counter()
         out = self._jit(*args)
-        self.calls += 1
         if first:
             jax.block_until_ready(out)
             dt = time.perf_counter() - t0
             self.compile_seconds = dt
             PLAN_CACHE.bump("compiles")
             PLAN_CACHE.bump("compile_seconds", dt)
+            if telemetry.enabled():
+                telemetry.event(
+                    "plan", "compile",
+                    {"plan": self.key[0], "seconds": round(dt, 6)},
+                )
         return out
 
 
@@ -213,6 +225,12 @@ def apply(S, A, dim: Dimension | str = Dimension.COLUMNWISE):
     plan = PLAN_CACHE.get_or_build(
         key, lambda: SketchPlan(key, lambda A_: S.apply(A_, dim))
     )
+    if telemetry.enabled():
+        with telemetry.span(
+            "sketch.apply", dim=dim.value, shape=list(A.shape)
+        ) as sp:
+            sp.result = plan(A)
+        return sp.result
     return plan(A)
 
 
@@ -273,6 +291,8 @@ def accumulate_slice(
         return SketchPlan(key, fn, donate_argnums=(0,) if donate else ())
 
     plan = PLAN_CACHE.get_or_build(key, build)
+    if telemetry.enabled():
+        telemetry.event("plan", "slice", {"bucket": kb, "rows": k})
     return plan(acc, block, jnp.asarray(int(start), jnp.int32))
 
 
@@ -346,6 +366,8 @@ def apply_rowwise_bucketed(
         return SketchPlan(key, fn)
 
     plan = PLAN_CACHE.get_or_build(key, build)
+    if telemetry.enabled():
+        telemetry.event("plan", "rowwise", {"bucket": kb, "rows": k})
     Z = plan(block, jnp.asarray(k, jnp.int32), *leaves)
     if pad_out:
         return Z, k

@@ -13,12 +13,14 @@ with the best iterate attached instead of returning garbage.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..core.params import Params
 from ..utils.checkpoint import CheckpointStore
 from ..utils.exceptions import CheckpointError, ConvergenceError
@@ -45,6 +47,23 @@ class ResilientParams(Params):
     io_backoff: float = 0.05
     check_divergence: bool = True
     max_chunks: int | None = None  # backstop against non-terminating solvers
+
+
+def _residual_of(state):
+    """Best-effort residual read at a chunk boundary: the chunked solver
+    states that track one keep it under a conventional key (LSQR's
+    ``phibar``, generic ``resid``/``rnorm``).  Returns a float (max over
+    targets) or None — never raises, never adds a sync for states that
+    carry no residual."""
+    if not isinstance(state, dict):
+        return None
+    for key in ("phibar", "resid", "rnorm", "residual"):
+        if key in state:
+            try:
+                return float(jnp.max(jnp.abs(jnp.asarray(state[key]))))
+            except (TypeError, ValueError):
+                return None
+    return None
 
 
 def _all_finite(state) -> bool:
@@ -114,6 +133,14 @@ class ResilientRunner:
                 f"state has {treedef.num_leaves}"
             )
         self.params.log(1, f"resumed from checkpoint step {step}")
+        if telemetry.enabled():
+            # crc_ok is True by construction here: load_latest only
+            # returns slots whose per-leaf CRC32 validated.
+            telemetry.event(
+                "checkpoint", "restore",
+                {"step": step, "crc_ok": True, "kind": kind},
+            )
+            telemetry.inc("checkpoint.restores")
         return jax.tree.unflatten(treedef, leaves)
 
     def _commit(self, state, chunk: int) -> None:
@@ -124,15 +151,34 @@ class ResilientRunner:
         def attempt():
             if self.fault_plan is not None:
                 self.fault_plan.before_save(chunk)
-            self.store.save(state, step=step, metadata=meta)
+            return self.store.save(state, step=step, metadata=meta)
 
-        with_retries(
+        t0 = time.perf_counter()
+        path = with_retries(
             attempt,
             retries=self.params.io_retries,
             backoff=self.params.io_backoff,
             sleep=self.sleep,
         )
         self.params.log(2, f"checkpoint committed at iteration {step}")
+        if telemetry.enabled():
+            try:
+                nbytes = os.path.getsize(path)
+            except OSError:
+                nbytes = None
+            telemetry.event(
+                "checkpoint", "save",
+                {
+                    "step": step,
+                    "chunk": chunk,
+                    "bytes": nbytes,
+                    "crc": "crc32-per-leaf",
+                    "seconds": round(time.perf_counter() - t0, 6),
+                },
+            )
+            telemetry.inc("checkpoint.saves")
+            if nbytes:
+                telemetry.inc("checkpoint.bytes", nbytes)
 
     def run(self):
         p = self.params
@@ -158,6 +204,17 @@ class ResilientRunner:
                     iteration=int(solver.iteration(state)),
                 )
             state = new_state
+            if telemetry.enabled():
+                attrs = {
+                    "chunk": chunk,
+                    "iteration": int(solver.iteration(state)),
+                }
+                resid = _residual_of(state)
+                if resid is not None:
+                    attrs["resid"] = resid
+                telemetry.event(
+                    "solver", getattr(solver, "kind", "chunked_solver"), attrs
+                )
             if self.store is not None:
                 self._commit(state, chunk)
             if self.fault_plan is not None:

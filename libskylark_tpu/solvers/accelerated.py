@@ -21,7 +21,7 @@ from dataclasses import dataclass
 import jax.numpy as jnp
 import numpy as np
 
-from .. import guard
+from .. import guard, telemetry
 from ..core.context import SketchContext
 from ..core.params import Params
 from ..sketch.base import Dimension, create_sketch
@@ -132,13 +132,15 @@ def faster_least_squares(
             "fallback", verdict=guard.FALLBACK, detail="exact svd solve"
         )
         report.recovered = True
-        return X, {
+        info = {
             "attempts": attempt,
             "condest": cond,
             "fallback": "svd",
             "iterations": 0,
             "recovery": report.to_dict(),
         }
+        telemetry.run_summary("blendenpik", info)
+        return X, info
     precond = TriInversePrecond(R, lower=False)
     X, info = lsqr(A, B, precond=precond, params=params.krylov)
     if guarded:
@@ -146,6 +148,7 @@ def faster_least_squares(
     info["attempts"] = attempt
     info["condest"] = cond
     info["recovery"] = report.to_dict()
+    telemetry.run_summary("blendenpik", info)
     return X, info
 
 
@@ -199,4 +202,5 @@ def lsrn_least_squares(
     if guarded:
         guard.check_finite(X, "lsrn_lsqr", report=report)
     info["recovery"] = report.to_dict()
+    telemetry.run_summary("lsrn", info)
     return X, info

@@ -25,7 +25,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from .. import guard, plans
+from .. import guard, plans, telemetry
 from ..sketch.base import Dimension
 from .engine import StreamParams, run_stream
 from .pipeline import BucketedBatch
@@ -243,8 +243,10 @@ def sketch_least_squares(
     if guarded:
         guard.check_finite(X, "streaming_lsq", report=report)
     x = X[:, 0] if targets == 1 else X
-    return x, {"rows": rows, "batches": nbatches,
-               "recovery": report.to_dict()}
+    info = {"rows": rows, "batches": nbatches,
+            "recovery": report.to_dict()}
+    telemetry.run_summary("streaming_lsq", info)
+    return x, info
 
 
 def kernel_ridge(
@@ -348,4 +350,5 @@ def kernel_ridge(
     model = FeatureMapModel([S], W)
     model.info = {"rows": int(acc["rows"]), "batches": nbatches,
                   "recovery": report.to_dict()}
+    telemetry.run_summary("streaming_krr", model.info)
     return model

@@ -29,7 +29,7 @@ from itertools import islice
 
 import numpy as np
 
-from .. import guard, plans
+from .. import guard, plans, telemetry
 from ..resilient import ChunkedSolver, ResilientParams, ResilientRunner
 from .pipeline import Prefetcher, device_placer
 
@@ -126,6 +126,19 @@ class _Cursor:
             self._prefetcher.close()
 
 
+def _rows_of(acc):
+    """Row count of a driver accumulator, when it carries one (the
+    streaming drivers keep a ``"row"``/``"rows"`` bookkeeping leaf)."""
+    if isinstance(acc, dict):
+        for key in ("row", "rows"):
+            if key in acc:
+                try:
+                    return int(acc[key])
+                except (TypeError, ValueError):
+                    return None
+    return None
+
+
 def skip_batches(it, k: int):
     """Drop the first ``k`` items — the generic (re-parse) skip for
     factories over non-seekable sources."""
@@ -180,7 +193,7 @@ def run_stream(
             acc = plans.copy_for_donation(acc)
         return acc
 
-    def step_chunk(state, k):
+    def _fold_chunk(state, k, sp):
         guarded = guard.enabled()
         b0 = int(state["batch"])
         cursor.ensure(b0)
@@ -198,6 +211,10 @@ def run_stream(
             acc = step_fn(acc, block, b)
             b += 1
             cursor.advance()
+        if sp is not None:
+            sp.attrs["batches"] = b - b0
+            if guarded and b > b0:
+                telemetry.inc("stream.sentinel_checks")
         if guarded and b > b0 and not guard.tree_all_finite(acc):
             # Chunk sentinel tripped: replay this chunk's fold from the
             # chunk-entry accumulator over the clean buffered blocks
@@ -209,6 +226,7 @@ def run_stream(
                     "replay", chunk=b0,
                     detail="non-finite accumulator; re-folding chunk",
                 )
+            telemetry.inc("stream.replays")
             acc = _entry_acc(state)
             for j, block in enumerate(blocks):
                 if fault_plan is not None:
@@ -224,6 +242,28 @@ def run_stream(
             if report is not None:
                 report.recovered = True
         return {"batch": np.asarray(b, np.int64), "acc": acc}
+
+    def step_chunk(state, k):
+        if not telemetry.enabled():
+            return _fold_chunk(state, k, None)
+        b0 = int(state["batch"])
+        with telemetry.span("stream.chunk", kind=kind, chunk=b0) as sp:
+            new_state = _fold_chunk(state, k, sp)
+            # PhaseTimer discipline: sync the folded accumulator so the
+            # span (and its derived rows/s) measures device time.
+            sp.result = new_state["acc"]
+            rows = _rows_of(new_state["acc"])
+            if rows is not None:
+                entry = _rows_of(state["acc"]) or 0
+                sp.attrs["rows"] = rows - entry
+        sp2 = sp.seconds
+        if sp2 and sp.attrs.get("rows"):
+            telemetry.inc("stream.rows", sp.attrs["rows"])
+            telemetry.set_gauge(
+                "stream.rows_per_s", round(sp.attrs["rows"] / sp2, 3)
+            )
+        telemetry.inc("stream.batches", sp.attrs.get("batches", 0))
+        return new_state
 
     def is_done(state):
         cursor.ensure(int(state["batch"]))
@@ -243,4 +283,26 @@ def run_stream(
             solver, params, metadata=meta, fault_plan=fault_plan
         ).run()
     finally:
+        st = cursor.stats
+        if st is not None and telemetry.enabled():
+            # Fold the pipeline's overlap evidence into the registry so
+            # snapshot()'s prefetch_overlap survives the cursor teardown;
+            # ``waits`` are the consumer-side backpressure stalls.
+            telemetry.inc("prefetch.produced", st.produced)
+            telemetry.inc("prefetch.consumed", st.consumed)
+            telemetry.inc("prefetch.hits", st.hits)
+            telemetry.inc("prefetch.waits", st.waits)
+            gets = st.hits + st.waits
+            telemetry.event(
+                "stream", "prefetch",
+                {
+                    "kind": kind,
+                    "produced": st.produced,
+                    "consumed": st.consumed,
+                    "hits": st.hits,
+                    "waits": st.waits,
+                    "producer_seconds": round(st.producer_seconds, 6),
+                    "overlap": round(st.hits / gets, 6) if gets else None,
+                },
+            )
         cursor.close()

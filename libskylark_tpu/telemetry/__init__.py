@@ -1,0 +1,58 @@
+"""Unified telemetry: structured spans, metrics registry, JSONL run ledger.
+
+The observability layer the reference never had (its ``utility/timer.hpp``
+macros reduce wall timers over MPI ranks and nothing else): one
+process-wide :class:`Registry` of counters/gauges/histograms, nestable
+:func:`span` contexts (wall time under the ``PhaseTimer`` sync
+discipline, device regions via ``utils.profiling.annotate``), and a
+monotonically sequenced JSONL event sink — the *run ledger* — with the
+schema ``{ts, seq, pid, kind, name, attrs}``.
+
+Wired through every hot seam: plan-cache hits/misses/compiles
+(``plans``), streaming chunk spans + prefetch overlap (``streaming``),
+recovery-ladder attempts (``guard``), checkpoint save/restore
+(``resilient``), and per-chunk solver progress; every ``(x, info)``
+solver entrypoint closes its run with a :func:`run_summary` event.
+
+Gated by ``SKYLARK_TELEMETRY`` (default OFF, read per call): disabled,
+every entry point returns before allocating — runs are bit-identical to
+a build without this package.  ``SKYLARK_TELEMETRY_DIR`` (or
+:func:`configure`, or the CLIs' ``--telemetry-dir``) points the ledger
+at a directory; without it events still count in the registry.
+
+End of run: :func:`snapshot` folds the registry with ``plans.stats()``,
+the prefetch overlap ratio, and the guard/checkpoint counter groups;
+:func:`report` reduces counters min/max/avg over ``jax.distributed``
+processes under the same ``process_allgather`` + CRC-signature contract
+as ``utils.timer.timer_report``.  See ``docs/observability.md``.
+"""
+
+from .config import enabled, ledger_dir
+from .ledger import close, configure, emit, event, flush, ledger_path
+from .registry import LOCK, REGISTRY, Registry, inc, observe, reset, set_gauge
+from .report import report, run_summary, snapshot
+from .spans import NOOP_SPAN, Span, span
+
+__all__ = [
+    "enabled",
+    "ledger_dir",
+    "configure",
+    "event",
+    "emit",
+    "ledger_path",
+    "flush",
+    "close",
+    "Registry",
+    "REGISTRY",
+    "LOCK",
+    "inc",
+    "set_gauge",
+    "observe",
+    "reset",
+    "span",
+    "Span",
+    "NOOP_SPAN",
+    "snapshot",
+    "run_summary",
+    "report",
+]

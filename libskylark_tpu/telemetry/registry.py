@@ -1,0 +1,97 @@
+"""The process-wide metrics registry: counters, gauges, histograms.
+
+ONE lock (module-level ``LOCK``, shared with the ledger's sequence
+counter) guards every mutation — the same single-lock discipline as
+``plans.PlanCache`` — and the module-level helpers (:func:`inc`,
+:func:`set_gauge`, :func:`observe`) check ``config.enabled()`` FIRST,
+so with ``SKYLARK_TELEMETRY=0`` a call returns before any allocation
+happens.
+
+Histograms keep streaming moments (count / sum / min / max), not
+buckets: enough for min/max/avg reporting without per-event lists.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from . import config
+
+__all__ = ["LOCK", "Registry", "REGISTRY", "inc", "set_gauge", "observe", "reset"]
+
+LOCK = threading.Lock()
+
+
+class Registry:
+    """Named counters / gauges / histograms behind the shared lock."""
+
+    def __init__(self):
+        self.counters: dict = {}
+        self.gauges: dict = {}
+        self.histograms: dict = {}
+
+    def inc(self, name: str, amount=1) -> None:
+        with LOCK:
+            self.counters[name] = self.counters.get(name, 0) + amount
+
+    def set_gauge(self, name: str, value) -> None:
+        with LOCK:
+            self.gauges[name] = value
+
+    def observe(self, name: str, value) -> None:
+        v = float(value)
+        with LOCK:
+            h = self.histograms.get(name)
+            if h is None:
+                self.histograms[name] = {
+                    "count": 1, "sum": v, "min": v, "max": v,
+                }
+            else:
+                h["count"] += 1
+                h["sum"] += v
+                h["min"] = min(h["min"], v)
+                h["max"] = max(h["max"], v)
+
+    def snapshot(self) -> dict:
+        """Point-in-time copy of every metric (safe to mutate)."""
+        with LOCK:
+            return {
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "histograms": {k: dict(v) for k, v in self.histograms.items()},
+            }
+
+    def reset(self) -> None:
+        with LOCK:
+            self.counters.clear()
+            self.gauges.clear()
+            self.histograms.clear()
+
+
+REGISTRY = Registry()
+
+
+def inc(name: str, amount=1) -> None:
+    """Bump counter ``name`` (no-op — and no allocation — when disabled)."""
+    if not config.enabled():
+        return
+    REGISTRY.inc(name, amount)
+
+
+def set_gauge(name: str, value) -> None:
+    """Set gauge ``name`` (no-op when disabled)."""
+    if not config.enabled():
+        return
+    REGISTRY.set_gauge(name, value)
+
+
+def observe(name: str, value) -> None:
+    """Record ``value`` into histogram ``name`` (no-op when disabled)."""
+    if not config.enabled():
+        return
+    REGISTRY.observe(name, value)
+
+
+def reset() -> None:
+    """Zero every metric (test hook; always runs, even disabled)."""
+    REGISTRY.reset()

@@ -1,0 +1,93 @@
+"""End-of-run folds: ``snapshot()``, ``run_summary()``, ``report()``.
+
+``snapshot()`` is the single picture the four private status channels
+used to be: the registry's metrics plus ``plans.stats()``, the prefetch
+overlap ratio (from the counters the streaming engine folds in when a
+pass closes), and the guard / checkpoint counter groups.
+
+``report()`` is the multi-process reduction, and deliberately REUSES
+``utils.timer.timer_report``'s gather contract: with
+``distributed=True`` every process of the ``jax.distributed`` job must
+call it with the same counter-name set — the CRC32 name-signature is
+allgathered first and a mismatch raises instead of silently misaligning
+columns (tested via the synthetic ``(P, k)`` stacked path in
+``tests/test_telemetry.py``).
+"""
+
+from __future__ import annotations
+
+from . import config
+from .ledger import event, flush
+from .registry import REGISTRY
+
+__all__ = ["snapshot", "run_summary", "report"]
+
+
+def _ratio(num, den):
+    return round(num / den, 6) if den else None
+
+
+def snapshot() -> dict:
+    """Fold every status channel into one dict (works even disabled —
+    an empty registry still reports the plan-cache block)."""
+    from .. import plans
+
+    snap = REGISTRY.snapshot()
+    counters = snap["counters"]
+    st = plans.stats()
+    snap["plans"] = st
+    lookups = st["hits"] + st["misses"]
+    snap["plan_cache_hit_rate"] = _ratio(st["hits"], lookups)
+    gets = counters.get("prefetch.hits", 0) + counters.get("prefetch.waits", 0)
+    snap["prefetch_overlap"] = _ratio(counters.get("prefetch.hits", 0), gets)
+    snap["guard"] = {
+        k.split(".", 1)[1]: v
+        for k, v in counters.items()
+        if k.startswith("guard.")
+    }
+    snap["checkpoint"] = {
+        k.split(".", 1)[1]: v
+        for k, v in counters.items()
+        if k.startswith("checkpoint.")
+    }
+    return snap
+
+
+def run_summary(name: str, info: dict | None = None, **attrs):
+    """Terminal ledger event of one solver run.
+
+    Every ``(x, info)`` solver entrypoint calls this with its ``info``
+    dict right before returning (static contract in
+    ``tests/test_review_regressions.py``), so the ledger's last word on
+    a run carries the recovery ledger, the row/batch accounting, AND the
+    registry + plan-cache counters to correlate them against.  Returns
+    the event's ``seq`` (None when disabled).
+    """
+    if not config.enabled():
+        return None
+    payload = dict(attrs)
+    payload["info"] = dict(info or {})
+    payload["snapshot"] = snapshot()
+    seq = event("run_summary", name, payload)
+    flush()
+    return seq
+
+
+def report(distributed: bool = False) -> str:
+    """Counter table, optionally reduced min/max/avg over processes.
+
+    Reuses :func:`~libskylark_tpu.utils.timer.timer_report` wholesale:
+    same ``process_allgather`` collective, same CRC32 name-signature
+    misalignment guard, same three-column reduction — telemetry counters
+    simply ride where phase totals normally do.
+    """
+    from ..utils.timer import timer_report
+
+    snap = REGISTRY.snapshot()
+    totals = {k: float(v) for k, v in snap["counters"].items()}
+    for k, g in snap["gauges"].items():
+        try:
+            totals[f"gauge.{k}"] = float(g)
+        except (TypeError, ValueError):
+            continue
+    return timer_report(totals, distributed=distributed)

@@ -1,0 +1,113 @@
+"""Nestable spans: wall time + device regions + ledger events.
+
+A span is the telemetry analogue of one ``PhaseTimer`` phase, and keeps
+its sync discipline: assign the span handle's ``result`` inside the
+region and the exit path runs ``jax.block_until_ready`` on it before
+reading the clock, so the span measures DEVICE time, not dispatch time.
+Each span also opens a ``utils.profiling.annotate`` region, so an XProf
+trace captured around the run carries the same names as the ledger.
+
+Nesting is tracked per thread: every span records its parent's id (the
+``seq`` of the parent's ``span_start`` event) so the ledger reconstructs
+the span tree.  ``span(...)`` with telemetry disabled returns a shared
+no-op singleton — no allocation, no sync, no events.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import jax
+
+from ..utils import profiling
+from . import config
+from .ledger import event
+from .registry import REGISTRY
+
+__all__ = ["span", "Span", "NOOP_SPAN"]
+
+_LOCAL = threading.local()
+
+
+class _NoopSpan:
+    """Shared disabled-path span: accepts ``result`` assignment (ignored,
+    never synced) and nests freely."""
+
+    __slots__ = ("result",)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+def _stack() -> list:
+    stack = getattr(_LOCAL, "spans", None)
+    if stack is None:
+        stack = _LOCAL.spans = []
+    return stack
+
+
+class Span:
+    """One live span; ``attrs`` may be amended inside the region (the
+    ``span_end`` event re-reads them, so late facts — rows folded,
+    batches seen — land on the closing record)."""
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.result = None
+        self.id = None
+        self.seconds = None
+
+    def __enter__(self):
+        stack = _stack()
+        start_attrs = dict(self.attrs)
+        if stack:
+            start_attrs["parent"] = stack[-1].id
+        start_attrs["depth"] = len(stack)
+        self._t0 = time.perf_counter()
+        self.id = event("span_start", self.name, start_attrs)
+        stack.append(self)
+        self._region = profiling.annotate(self.name)
+        self._region.__enter__()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._region.__exit__(exc_type, exc, tb)
+        if self.result is not None:
+            jax.block_until_ready(self.result)
+        self.seconds = time.perf_counter() - self._t0
+        stack = _stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        REGISTRY.inc(f"span.{self.name}.calls")
+        REGISTRY.inc(f"span.{self.name}.seconds", self.seconds)
+        end_attrs = dict(self.attrs)
+        end_attrs["span"] = self.id
+        end_attrs["seconds"] = round(self.seconds, 6)
+        if exc_type is not None:
+            end_attrs["error"] = exc_type.__name__
+        event("span_end", self.name, end_attrs)
+        return False
+
+
+def span(name: str, **attrs):
+    """Open a nestable span (context manager).
+
+    Usage::
+
+        with telemetry.span("stream.chunk", chunk=b0) as sp:
+            sp.result = acc        # blocked on at exit (PhaseTimer rule)
+            sp.attrs["rows"] = k   # lands on the span_end event
+
+    Disabled (``SKYLARK_TELEMETRY=0``): returns the shared no-op span.
+    """
+    if not config.enabled():
+        return NOOP_SPAN
+    return Span(name, attrs)

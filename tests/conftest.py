@@ -37,11 +37,13 @@ import pytest  # noqa: E402
 FAULTS_TIMEOUT_S = 120
 STREAMING_TIMEOUT_S = 120
 GUARD_TIMEOUT_S = 120
+TELEMETRY_TIMEOUT_S = 120
 
 _TIMEOUT_MARKS = {
     "faults": FAULTS_TIMEOUT_S,
     "streaming": STREAMING_TIMEOUT_S,
     "guard": GUARD_TIMEOUT_S,
+    "telemetry": TELEMETRY_TIMEOUT_S,
 }
 
 
@@ -69,6 +71,12 @@ def pytest_configure(config):
         "guard: numerical-health guard tests (sentinels, certification, "
         "recovery ladder, fault-injected recovery); tier-1, guarded by a "
         f"per-test {GUARD_TIMEOUT_S}s timeout",
+    )
+    config.addinivalue_line(
+        "markers",
+        "telemetry: observability-layer tests (spans, metrics registry, "
+        "JSONL run ledger, run_summary contract); tier-1, guarded by a "
+        f"per-test {TELEMETRY_TIMEOUT_S}s timeout",
     )
 
 

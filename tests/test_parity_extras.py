@@ -196,6 +196,68 @@ class TestUtils:
         prox = [ln for ln in rep.splitlines() if ln.startswith("prox")][0]
         assert float(prox.split()[1]) == float(prox.split()[2]) == 10.0
 
+    def test_timer_report_distributed_synthetic_ranks(self, monkeypatch):
+        """The full ``timer_report(distributed=True)`` path over a
+        synthetic 3-process gather (monkeypatched ``process_allgather``):
+        the stacked (P, k) totals must flow through
+        :func:`aggregate_report` into per-phase min/max/avg columns."""
+        import numpy as np
+
+        from libskylark_tpu.utils.timer import timer_report
+
+        P = 3
+        calls = {"n": 0}
+
+        def fake_allgather(x):
+            x = np.asarray(x)
+            calls["n"] += 1
+            if calls["n"] == 1:  # signature gather: all ranks agree
+                return np.stack([x] * P)
+            if x.dtype == np.float64:  # totals: rank r scaled by r+1
+                return np.stack([x * (r + 1) for r in range(P)])
+            return np.stack([x] * P)  # counts
+
+        monkeypatch.setattr(
+            "jax.experimental.multihost_utils.process_allgather",
+            fake_allgather,
+        )
+        rep = timer_report(
+            {"solve": 2.0, "sketch": 1.0},
+            {"solve": 4, "sketch": 2},
+            distributed=True,
+        )
+        assert "over 3 processes" in rep
+        solve = [ln for ln in rep.splitlines() if ln.startswith("solve")][0]
+        mn, mx, avg, nc = solve.split()[1:5]
+        assert (float(mn), float(mx), float(avg)) == (2.0, 6.0, 4.0)
+        assert int(nc) == 4
+        sketch = [ln for ln in rep.splitlines() if ln.startswith("sketch")][0]
+        assert (float(sketch.split()[1]), float(sketch.split()[2])) == (1.0, 3.0)
+
+    def test_timer_report_distributed_misalignment_guard(self, monkeypatch):
+        """Mismatched phase-name sets across ranks must raise the
+        CRC-signature RuntimeError BEFORE any totals gather — silent
+        positional misalignment is the failure mode the guard exists
+        to catch (utility/timer.hpp:44-66's world-collective contract)."""
+        import numpy as np
+
+        import pytest
+
+        from libskylark_tpu.utils.timer import timer_report
+
+        def fake_allgather(x):
+            x = np.asarray(x)
+            # Signature gather: rank 1 hashed a different name list.
+            other = np.array([int(x[0]) ^ 0x5A5A, int(x[1]) + 1], np.int64)
+            return np.stack([x, other])
+
+        monkeypatch.setattr(
+            "jax.experimental.multihost_utils.process_allgather",
+            fake_allgather,
+        )
+        with pytest.raises(RuntimeError, match="different phase-name sets"):
+            timer_report({"solve": 1.0}, {"solve": 1}, distributed=True)
+
     def test_exception_codes(self):
         assert issubclass(SketchError, SkylarkError)
         assert SketchError.code == 103
