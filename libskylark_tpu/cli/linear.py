@@ -45,6 +45,14 @@ def main(argv=None) -> int:
     p.add_argument("--resume", action="store_true",
                    help="resume a streamed pass from the newest valid "
                         "checkpoint in --checkpoint-dir")
+    p.add_argument("--distributed", action="store_true",
+                   help="with --stream: partition the stream over the "
+                        "jax.distributed world (every rank runs this "
+                        "same command; each folds its own row range, "
+                        "one psum merges; --checkpoint-dir becomes the "
+                        "shared root of per-host state, and --resume "
+                        "replays only each rank's uncheckpointed "
+                        "batches)")
     add_perf_args(p)
     add_telemetry_args(p)
     args = p.parse_args(argv)
@@ -61,6 +69,10 @@ def main(argv=None) -> int:
     from ..io import read_libsvm
     from ..solvers import RegressionProblem, solve_regression
 
+    if args.distributed and not args.stream:
+        print("error: --distributed rides the streaming path; add "
+              "--stream", file=sys.stderr)
+        return 2
     if args.stream:
         return _stream_main(args)
     A, b = read_libsvm(args.inputfile, sparse=args.sparse)
@@ -116,7 +128,7 @@ def _stream_main(args) -> int:
     from ..core.context import SketchContext
     from ..io import scan_libsvm_dims, stream_libsvm
     from ..linalg import streaming_least_squares
-    from ..streaming import StreamParams, skip_batches
+    from ..streaming import RowPartition, StreamParams, skip_batches, world_info
 
     nrows, ncols = scan_libsvm_dims(args.inputfile)
     print(f"Streaming {nrows}x{ncols} in batches of {args.batch_rows} rows")
@@ -133,10 +145,19 @@ def _stream_main(args) -> int:
         checkpoint_every=args.checkpoint_every,
         resume=args.resume,
     )
+    partition = None
+    if args.distributed:
+        rank, world = world_info()
+        partition = RowPartition(
+            nrows=nrows, batch_rows=args.batch_rows, world_size=world
+        )
+        b0, b1 = partition.batch_range(rank)
+        print(f"Distributed stream: rank {rank}/{world} owns batches "
+              f"[{b0}, {b1}) of {partition.num_batches}")
     t0 = time.perf_counter()
     x, info = streaming_least_squares(
         batches, nrows, ncols, SketchContext(seed=args.seed),
-        sparse=args.sparse, stream_params=sp,
+        sparse=args.sparse, stream_params=sp, partition=partition,
     )
     x = np.asarray(x)
     dt = time.perf_counter() - t0

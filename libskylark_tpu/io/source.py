@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import io
 import os
+import random
+import time
 from typing import Callable
 
 __all__ = [
@@ -79,17 +81,53 @@ class FsspecSource(ByteSource):
     Instantiating raises ImportError with a pointer when fsspec is not
     installed; schemes fsspec knows but whose backend deps are absent
     (e.g. hdfs without a JVM) raise their own error at ``open()``.
+
+    ``open()`` retries transient ``OSError``/``IOError`` with jittered
+    exponential backoff (``retries`` extra attempts after the first) —
+    a flaky remote store must not kill a multi-hour stream over one
+    dropped connection.  Non-OSError failures (missing backend deps,
+    auth errors) propagate immediately.  Retries are counted in the
+    telemetry registry (``io.open_retries``) with one ledger event per
+    retried attempt.
     """
 
-    def __init__(self, url: str):
+    def __init__(self, url: str, *, retries: int = 3, backoff: float = 0.2):
         from ..utils.deps import require
 
         self._fsspec = require("fsspec")
         self.url = url
         self.name = url
+        self.retries = int(retries)
+        self.backoff = float(backoff)
+        self._sleep = time.sleep  # injectable: tests skip the real wait
+        self._jitter = random.random  # likewise
 
     def open(self):
-        return self._fsspec.open(self.url, "rb").open()
+        from .. import telemetry
+
+        attempt = 0
+        while True:
+            try:
+                return self._fsspec.open(self.url, "rb").open()
+            except OSError as e:
+                if attempt >= self.retries:
+                    raise
+                # Full jitter on the exponential step: concurrent hosts
+                # re-opening the same store must not thunder in lockstep.
+                delay = self.backoff * (2**attempt) * (0.5 + self._jitter())
+                if telemetry.enabled():
+                    telemetry.inc("io.open_retries")
+                    telemetry.event(
+                        "io", "open_retry",
+                        {
+                            "url": self.url,
+                            "attempt": attempt,
+                            "delay": round(delay, 4),
+                            "error": f"{type(e).__name__}: {e}"[:200],
+                        },
+                    )
+                self._sleep(delay)
+                attempt += 1
 
 
 _SCHEMES: dict[str, Callable[[str], ByteSource]] = {}

@@ -220,6 +220,7 @@ def streaming_least_squares(
     sparse: bool = False,
     stream_params=None,
     fault_plan=None,
+    partition=None,
 ):
     """Out-of-core sketch-and-solve LS over ``(A_block, b_block)`` batches.
 
@@ -238,6 +239,12 @@ def streaming_least_squares(
     replays of NaN-poisoned batches and small-solve fallbacks — and
     ``fault_plan`` (``nan_at``/``bad_sketch_at`` keyed by batch index)
     injects the faults the guard recovers from.
+
+    ``partition`` (a :class:`~libskylark_tpu.streaming.RowPartition`)
+    selects the multi-host elastic path: every process of a
+    ``jax.distributed`` world calls this with the same arguments, each
+    folds only its own row range, and the merged ``(x, info)`` comes
+    back identical on every rank (``docs/distributed_streaming.md``).
     """
     from .. import streaming
 
@@ -247,5 +254,5 @@ def streaming_least_squares(
     S = create_sketch(stype, nrows, s, context)
     return streaming.sketch_least_squares(
         source, S, ncols=ncols, targets=targets, alg=alg,
-        params=stream_params, fault_plan=fault_plan,
+        params=stream_params, fault_plan=fault_plan, partition=partition,
     )

@@ -14,6 +14,7 @@ invariant must be enforced by hand.
 from .collectives import (
     ShardedBCOO,
     columnwise_sharded,
+    cross_host_psum,
     columnwise_sharded_sparse,
     columnwise_sharded_sparse_2d,
     columnwise_sharded_sparse_out,
@@ -53,6 +54,7 @@ __all__ = [
     "sharding",
     "row_sharding",
     "constrain_rows",
+    "cross_host_psum",
     "rowwise_sharded",
     "columnwise_sharded",
     "rowwise_sharded_sparse",

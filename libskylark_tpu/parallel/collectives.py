@@ -33,6 +33,7 @@ from ..sketch.dense import DenseSketch
 from ..sketch.hash import _segment_sum as _hash_segment_sum
 
 __all__ = [
+    "cross_host_psum",
     "rowwise_sharded",
     "columnwise_sharded",
     "rowwise_sharded_sparse",
@@ -51,6 +52,85 @@ def _coerce_float(A):
     if not jnp.issubdtype(A.dtype, jnp.floating):
         A = A.astype(jnp.float32)
     return A
+
+
+def _shard_map_fn():
+    # jax < 0.5 keeps shard_map under jax.experimental
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        return sm
+    from jax.experimental.shard_map import shard_map
+
+    return shard_map
+
+
+def cross_host_psum(tree, mesh: Mesh | None = None):
+    """Elementwise sum of a host-local float pytree over every process of
+    the ``jax.distributed`` world — the merge schedule of the elastic
+    streaming engine (each host folds its own row range into a partial
+    ``S·A``; columnwise partials merge by sum, so one psum finishes the
+    global sketch).
+
+    Layout: each process contributes its value on its FIRST addressable
+    device of ``mesh`` (default: the global 1-D device mesh) and zeros on
+    the rest, then one ``shard_map`` ``psum`` over the device axis sums
+    exactly one copy per process.  The result comes back as host numpy
+    arrays, identical on every process.
+
+    Single-process worlds return ``tree`` unchanged — a bitwise no-op,
+    so the non-distributed streaming paths keep their PR-5 bit-identity
+    even when routed through this merge.
+    """
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return tree
+
+    from jax.sharding import NamedSharding
+
+    if mesh is None:
+        mesh = Mesh(np.asarray(jax.devices()), ("hosts",))
+    axes = tuple(mesh.axis_names)
+    mesh_devs = list(mesh.devices.flat)
+    nd = len(mesh_devs)
+    me = jax.process_index()
+    mine = [i for i, d in enumerate(mesh_devs) if d.process_index == me]
+    if not mine:
+        raise ValueError(
+            "cross_host_psum: mesh has no addressable device for process "
+            f"{me}"
+        )
+    first = mine[0]
+
+    leaves, treedef = jax.tree.flatten(tree)
+    out = []
+    for leaf in leaves:
+        x = np.asarray(leaf)
+        if not jnp.issubdtype(x.dtype, jnp.floating):
+            raise TypeError(
+                "cross_host_psum sums floating-point leaves only (merge "
+                f"bookkeeping ints locally), got {x.dtype}"
+            )
+        zeros = np.zeros_like(x)
+        spec = P(axes, *([None] * x.ndim))
+
+        def _cb(idx, x=x, zeros=zeros):
+            dev = idx[0].start or 0
+            return (x if dev == first else zeros)[None]
+
+        g = jax.make_array_from_callback(
+            (nd,) + x.shape, NamedSharding(mesh, spec), _cb
+        )
+        summed = jax.jit(
+            _shard_map_fn()(
+                lambda a: jax.lax.psum(a, axes),
+                mesh=mesh,
+                in_specs=spec,
+                out_specs=P(*([None] * (x.ndim + 1))),
+            )
+        )(g)
+        out.append(np.asarray(summed.addressable_data(0))[0])
+    return jax.tree.unflatten(treedef, out)
 
 
 def rowwise_sharded(S, A, mesh: Mesh):

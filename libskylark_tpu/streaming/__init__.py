@@ -10,6 +10,10 @@ them).
   ``resilient`` runtime (resume is bit-for-bit)
 - ``drivers``: one-pass ``sketch`` (S·A / A·Ωᵀ), streaming
   sketch-and-solve least squares, streaming KRR Gram accumulation
+- ``elastic``: the multi-host face — each rank of a ``jax.distributed``
+  world folds its deterministic row range (``RowPartition``) with
+  per-host checkpoints + a JSONL progress ledger, merges by psum, and
+  resumes elastically (``docs/distributed_streaming.md``)
 
 See ``docs/streaming.md`` for the partial-sketch math and the merge
 rules; the transform-side protocol is ``SketchTransform.apply_slice`` /
@@ -17,6 +21,17 @@ rules; the transform-side protocol is ``SketchTransform.apply_slice`` /
 """
 
 from .drivers import kernel_ridge, sketch, sketch_batches, sketch_least_squares
+from .elastic import (
+    ElasticParams,
+    HostLedger,
+    RowPartition,
+    distributed_sketch,
+    distributed_sketch_least_squares,
+    elastic_run_stream,
+    host_dir,
+    read_progress,
+    world_info,
+)
 from .engine import StreamParams, as_block_factory, run_stream, skip_batches
 from .pipeline import Prefetcher, PrefetchStats, device_placer
 
@@ -32,4 +47,13 @@ __all__ = [
     "Prefetcher",
     "PrefetchStats",
     "device_placer",
+    "ElasticParams",
+    "RowPartition",
+    "HostLedger",
+    "read_progress",
+    "world_info",
+    "host_dir",
+    "elastic_run_stream",
+    "distributed_sketch",
+    "distributed_sketch_least_squares",
 ]

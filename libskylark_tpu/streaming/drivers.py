@@ -60,6 +60,7 @@ def sketch(
     dtype=None,
     params: StreamParams | None = None,
     fault_plan=None,
+    partition=None,
 ):
     """One-pass ``S·A`` (COLUMNWISE) or ``A·Ωᵀ`` (ROWWISE) over row
     blocks of A, without ever materializing A.
@@ -78,8 +79,31 @@ def sketch(
     no checkpointable fixed-shape state — ``params.checkpoint_dir`` is
     rejected; use :func:`sketch_batches` to keep the result out-of-core
     too.
+
+    ``partition`` (a :class:`~libskylark_tpu.streaming.RowPartition`)
+    routes to the multi-host elastic path (COLUMNWISE only): each
+    process of the ``jax.distributed`` world folds its own row range
+    and one psum merges — see ``docs/distributed_streaming.md``.
     """
     dim = Dimension.of(dim)
+    if partition is not None:
+        if dim is not Dimension.COLUMNWISE:
+            raise ValueError(
+                "distributed streaming is columnwise-only (rowwise "
+                "output concatenates in stream order, which has no "
+                "cross-rank merge)"
+            )
+        if ncols is None:
+            raise ValueError(
+                "columnwise streaming needs ncols (the width m of A) to "
+                "size the (S, m) accumulator"
+            )
+        from .elastic import distributed_sketch
+
+        return distributed_sketch(
+            source, S, ncols=int(ncols), partition=partition, dtype=dtype,
+            params=params, fault_plan=fault_plan,
+        )
     params = params or StreamParams()
     if dim is Dimension.ROWWISE:
         if params.checkpoint_dir:
@@ -170,10 +194,18 @@ def sketch_least_squares(
     dtype=None,
     params: StreamParams | None = None,
     fault_plan=None,
+    partition=None,
 ):
     """Streaming sketch-and-solve least squares: accumulate the sketched
     system ``(S·A, S·b)`` over ``(A_block, b_block)`` batches in one
     pass, then solve the small (s, n) problem exactly.
+
+    ``partition`` (a :class:`~libskylark_tpu.streaming.RowPartition`)
+    routes to the multi-host elastic path: each process of the
+    ``jax.distributed`` world folds its own row range, one psum merges
+    the partials, guard verdicts psum so all ranks take the same ladder
+    rung, and ``(x, info)`` is identical on every rank — see
+    ``docs/distributed_streaming.md``.
 
     ≙ ``ApproximateLeastSquares`` (``nla/least_squares.hpp:42-184``) with
     the sketch applies decomposed over row blocks — A never resident.
@@ -187,6 +219,14 @@ def sketch_least_squares(
     """
     from ..linalg.least_squares import exact_least_squares
 
+    if partition is not None:
+        from .elastic import distributed_sketch_least_squares
+
+        return distributed_sketch_least_squares(
+            source, S, ncols=int(ncols), partition=partition,
+            targets=targets, alg=alg, dtype=dtype, params=params,
+            fault_plan=fault_plan,
+        )
     params = params or StreamParams()
     dt = _result_dtype(dtype)
     init = {

@@ -1,0 +1,711 @@
+"""Multi-host elastic streaming: preemption-safe out-of-core sketching
+across a ``jax.distributed`` world.
+
+The reference distributes sketching over MPI (CombBLAS/Elemental) under
+a fail-stop model: any rank failure restarts the whole job.  Here the
+stream itself is sharded and rank loss is a LOCAL replay:
+
+- :class:`RowPartition` assigns each host a deterministic, contiguous
+  batch range of the global stream (derived from ``(nrows, batch_rows,
+  world_size)`` alone, so every process — and every restart — computes
+  the same split without communication).
+- Each host folds its range through the unchanged single-process
+  :func:`~libskylark_tpu.streaming.engine.run_stream` engine, with the
+  accumulator's row cursor started at the host's global row offset: the
+  counter contract makes the partial sketch operands identical to what
+  an unsharded pass would realize for those rows (columnwise ``S·A`` is
+  a SUM of window applies — ``apply_slice``).
+- Partials merge with ONE cross-process psum
+  (:func:`~libskylark_tpu.parallel.collectives.cross_host_psum`), then
+  ``finalize_slices`` runs on the merged sum (identity for linear
+  sketches, the RFT epilogue otherwise).
+
+Robustness model: each host owns a private subdirectory of the shared
+checkpoint root — ``host-<rank:05d>/`` holding its ``CheckpointStore``
+slots, a ``manifest.json`` (world size, row partition, epoch, kind) and
+a ``progress.jsonl`` ledger in the telemetry run-ledger schema (``{ts,
+seq, pid, kind, name, attrs}``).  SIGKILL one rank mid-stream, restart
+the world with ``resume=True``, and every rank reloads its own newest
+checkpoint: the killed rank re-folds only its uncheckpointed batches
+(bit-identically — same blocks, same order), the survivors re-fold
+nothing, and the merged result is bit-for-bit the uninterrupted run's.
+Resuming under a DIFFERENT world size or row partition is detected two
+ways — the on-disk manifest check and a pre-fold allgather handshake of
+``(world, partition signature, epoch, kind)`` — and fails fast with
+:class:`~libskylark_tpu.utils.exceptions.WorldMismatchError` (code 109)
+instead of silently merging stale partials.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zlib
+from dataclasses import dataclass
+from itertools import islice
+
+import numpy as np
+
+from .. import guard, telemetry
+from ..utils.exceptions import InvalidParameters, WorldMismatchError
+from .engine import StreamParams, as_block_factory, run_stream
+
+__all__ = [
+    "RowPartition",
+    "ElasticParams",
+    "HostLedger",
+    "read_progress",
+    "world_info",
+    "host_dir",
+    "elastic_run_stream",
+    "distributed_sketch",
+    "distributed_sketch_least_squares",
+]
+
+MANIFEST_NAME = "manifest.json"
+PROGRESS_NAME = "progress.jsonl"
+_MANIFEST_VERSION = 1
+
+
+def world_info() -> tuple[int, int]:
+    """``(rank, world_size)`` of the current process.
+
+    Reads ``jax.process_index()/process_count()`` — ``(0, 1)`` in an
+    uninitialized (single-process) runtime, so single-process code paths
+    need no special casing.
+    """
+    import jax
+
+    return int(jax.process_index()), int(jax.process_count())
+
+
+@dataclass(frozen=True)
+class RowPartition:
+    """Deterministic contiguous split of a batched row stream over ranks.
+
+    The global stream is ``num_batches = ceil(nrows / batch_rows)``
+    batches of ``batch_rows`` rows (last batch ragged).  Rank ``r`` owns
+    batches ``[batch_range(r))`` — balanced contiguous ranges, the first
+    ``num_batches % world_size`` ranks taking one extra — and therefore
+    rows ``[row_range(r))``.  Pure arithmetic on ``(nrows, batch_rows,
+    world_size)``: every process computes the identical split, which is
+    what makes restarted ranks re-address the same counter windows.
+    """
+
+    nrows: int
+    batch_rows: int
+    world_size: int
+
+    def __post_init__(self):
+        for name in ("nrows", "batch_rows", "world_size"):
+            v = getattr(self, name)
+            if int(v) != v or int(v) < 1:
+                raise InvalidParameters(
+                    f"RowPartition.{name} must be a positive int, got {v!r}"
+                )
+            object.__setattr__(self, name, int(v))
+
+    @property
+    def num_batches(self) -> int:
+        return -(-self.nrows // self.batch_rows)
+
+    def batch_range(self, rank: int) -> tuple[int, int]:
+        """Global batch indices ``[start, end)`` owned by ``rank``."""
+        if not 0 <= rank < self.world_size:
+            raise InvalidParameters(
+                f"rank {rank} outside world of {self.world_size}"
+            )
+        base, extra = divmod(self.num_batches, self.world_size)
+        start = rank * base + min(rank, extra)
+        return start, start + base + (1 if rank < extra else 0)
+
+    def row_range(self, rank: int) -> tuple[int, int]:
+        """Global row indices ``[start, end)`` owned by ``rank``."""
+        b0, b1 = self.batch_range(rank)
+        return (
+            b0 * self.batch_rows,
+            min(b1 * self.batch_rows, self.nrows),
+        )
+
+    def to_json(self) -> dict:
+        return {
+            "nrows": self.nrows,
+            "batch_rows": self.batch_rows,
+            "world_size": self.world_size,
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "RowPartition":
+        return cls(
+            nrows=d["nrows"],
+            batch_rows=d["batch_rows"],
+            world_size=d["world_size"],
+        )
+
+    def signature(self) -> int:
+        """CRC32 of the canonical JSON — the partition's identity in
+        manifests and the barrier handshake."""
+        payload = json.dumps(self.to_json(), sort_keys=True).encode()
+        return zlib.crc32(payload)
+
+    def validate_world(self, rank: int, world_size: int) -> None:
+        """Fail fast (code 109) when the resolved world disagrees with
+        this partition — the resume-under-a-different-world guard."""
+        if world_size != self.world_size:
+            raise WorldMismatchError(
+                f"stream partitioned for world size {self.world_size} "
+                f"but this process resolves a world of {world_size}; "
+                "repartition (and restart from scratch) instead of "
+                "merging mismatched partials",
+                expected=self.world_size,
+                got=world_size,
+            )
+        if not 0 <= rank < world_size:
+            raise WorldMismatchError(
+                f"rank {rank} outside world of {world_size}",
+                expected=f"0 <= rank < {world_size}",
+                got=rank,
+            )
+
+
+class ElasticParams(StreamParams):
+    """:class:`~libskylark_tpu.streaming.StreamParams` plus the world
+    overrides of an elastic pass.
+
+    ``rank``/``world_size`` default to the live ``jax.distributed``
+    world (:func:`world_info`); tests override them to exercise a
+    simulated rank's local fold — manifest, ledger and partition checks
+    included — inside one process.  ``checkpoint_dir`` is the SHARED
+    root; each rank derives its private ``host-<rank:05d>/`` under it.
+    """
+
+    def __init__(
+        self,
+        *,
+        rank: int | None = None,
+        world_size: int | None = None,
+        **kw,
+    ):
+        super().__init__(**kw)
+        self.rank = rank
+        self.world_size = world_size
+
+
+def _resolve_world(params) -> tuple[int, int]:
+    live_rank, live_world = world_info()
+    rank = getattr(params, "rank", None)
+    world = getattr(params, "world_size", None)
+    return (
+        live_rank if rank is None else int(rank),
+        live_world if world is None else int(world),
+    )
+
+
+def host_dir(root, rank: int) -> str:
+    """The per-host state directory under the shared checkpoint root."""
+    return os.path.join(str(root), f"host-{int(rank):05d}")
+
+
+class HostLedger:
+    """Per-host JSONL progress ledger, one record per FOLDED batch.
+
+    Rides the telemetry run-ledger schema (``{ts, seq, pid, kind, name,
+    attrs}``, ``kind="elastic"``) so the same tooling reads both.  Lines
+    are flushed per record: after a SIGKILL the file shows exactly which
+    batches this incarnation folded (at most one torn trailing line,
+    which :func:`read_progress` skips).  ``seq`` continues from the
+    existing file so restart records stay totally ordered per host.
+    """
+
+    def __init__(self, path, *, rank: int, epoch: int = 0):
+        self.path = str(path)
+        self.rank = int(rank)
+        self.epoch = int(epoch)
+        self._seq = 0
+        for rec in read_progress(self.path):
+            self._seq = max(self._seq, int(rec.get("seq", 0)))
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def record(self, name: str, **attrs) -> int:
+        self._seq += 1
+        rec = {
+            "ts": round(time.time(), 6),
+            "seq": self._seq,
+            "pid": os.getpid(),
+            "kind": "elastic",
+            "name": name,
+            "attrs": {"rank": self.rank, "epoch": self.epoch, **attrs},
+        }
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+        return self._seq
+
+    def close(self) -> None:
+        try:
+            self._fh.close()
+        except OSError:
+            pass
+
+
+def read_progress(path) -> list[dict]:
+    """Parse a ``progress.jsonl`` — tolerant of the torn trailing line a
+    SIGKILL mid-write can leave.  Missing file → ``[]``."""
+    out = []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            for line in fh:
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    except OSError:
+        pass
+    return out
+
+
+def _manifest_payload(partition, rank, kind, epoch) -> dict:
+    return {
+        "skylark_object_type": "elastic_manifest",
+        "format_version": _MANIFEST_VERSION,
+        "kind": str(kind),
+        "epoch": int(epoch),
+        "rank": int(rank),
+        "partition": partition.to_json(),
+        "signature": partition.signature(),
+    }
+
+
+def _check_manifest(hdir, partition, rank, kind, epoch, resume) -> None:
+    """Verify (on resume) then (re)write the per-host manifest.
+
+    The manifest is the on-disk half of the mismatch guard: checkpoints
+    under this directory were written for exactly one ``(partition,
+    rank, kind)``; resuming under any other raises code 109 BEFORE a
+    stale slot can be loaded into a differently-partitioned fold.
+    """
+    os.makedirs(hdir, exist_ok=True)
+    path = os.path.join(hdir, MANIFEST_NAME)
+    want = _manifest_payload(partition, rank, kind, epoch)
+    if resume and os.path.exists(path):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                have = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            raise WorldMismatchError(
+                f"unreadable elastic manifest {path}: {e}; the host "
+                "directory cannot be certified against this partition",
+                expected=want,
+                got=None,
+            )
+        for key in ("kind", "rank", "partition", "signature"):
+            if have.get(key) != want[key]:
+                raise WorldMismatchError(
+                    "elastic resume mismatch: checkpoint state in "
+                    f"{hdir} was written for {key}={have.get(key)!r}, "
+                    f"this run wants {key}={want[key]!r} (world size or "
+                    "row partition changed; restart from scratch)",
+                    expected={k: have.get(k) for k in ("kind", "rank",
+                                                       "partition",
+                                                       "signature")},
+                    got={k: want[k] for k in ("kind", "rank", "partition",
+                                              "signature")},
+                )
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(want, fh)
+    os.replace(tmp, path)
+
+
+def _handshake(partition, rank, world, kind, epoch) -> None:
+    """Barrier/epoch handshake: every live process allgathers its
+    ``(world, partition signature, epoch, kind crc)`` tuple and checks
+    the others'.  A drifted rank (stale restart script, wrong epoch,
+    different partition constants) is detected by EVERY rank before any
+    work or merge happens — and the allgather doubles as the barrier
+    that keeps a fast rank from merging before a slow one joined.
+
+    Single-process worlds (including simulated-rank tests) skip the
+    collective — there is nobody to disagree with.
+    """
+    import jax
+
+    if jax.process_count() <= 1:
+        return
+    from jax.experimental import multihost_utils
+
+    mine = np.asarray(
+        [
+            int(world),
+            int(partition.signature()),
+            int(epoch),
+            zlib.crc32(str(kind).encode()),
+        ],
+        np.int64,
+    )
+    theirs = np.atleast_2d(
+        np.asarray(multihost_utils.process_allgather(mine))
+    )
+    for r in range(theirs.shape[0]):
+        if not np.array_equal(theirs[r], mine):
+            raise WorldMismatchError(
+                f"elastic handshake failed: rank {rank} sees (world, "
+                f"partition, epoch, kind) = {mine.tolist()} but process "
+                f"{r} announced {theirs[r].tolist()}; refusing to merge "
+                "across mismatched worlds",
+                expected=mine.tolist(),
+                got=theirs[r].tolist(),
+            )
+    if telemetry.enabled():
+        telemetry.event(
+            "elastic", "handshake",
+            {"rank": rank, "world": world, "epoch": int(epoch),
+             "signature": int(partition.signature()), "kind": kind},
+        )
+
+
+def _local_params(params, hdir) -> StreamParams:
+    """This rank's private view of the shared params: same knobs, but
+    checkpoints under the rank's host directory."""
+    return StreamParams(
+        prefetch=params.prefetch,
+        placer=params.placer,
+        checkpoint_dir=hdir,
+        checkpoint_every=params.checkpoint_every,
+        keep_last=params.keep_last,
+        resume=params.resume,
+        io_retries=params.io_retries,
+        io_backoff=params.io_backoff,
+        check_divergence=params.check_divergence,
+        max_chunks=params.max_chunks,
+        am_i_printing=params.am_i_printing,
+        log_level=params.log_level,
+        prefix=params.prefix,
+        debug_level=params.debug_level,
+        log_stream=params.log_stream,
+    )
+
+
+def elastic_run_stream(
+    source,
+    step_fn,
+    init_acc,
+    partition: RowPartition,
+    params: ElasticParams | StreamParams | None = None,
+    *,
+    kind: str = "elastic_pass",
+    metadata: dict | None = None,
+    fault_plan=None,
+    report=None,
+    epoch: int = 0,
+):
+    """This rank's share of a partitioned stream fold.
+
+    ``source`` is the GLOBAL batch factory (``f(start_batch) ->
+    iterator`` over all ``partition.num_batches`` batches — the same
+    factory every rank gets); the rank's window is carved out here with
+    a seek-and-bound (``factory(global_start)`` + ``islice``), riding
+    the ``io/source.py`` byte-source seam: factories over seekable
+    stores skip in O(1), line-parsed ones re-parse the prefix.
+
+    ``step_fn(acc, block, local_index)`` sees LOCAL batch indices
+    ``0..nlocal-1`` (checkpoint/resume and fault-plan indices are local
+    to the rank); global addressing lives in the accumulator's row
+    cursor, which the caller must start at the rank's global row offset
+    (the distributed drivers do).
+
+    Returns ``(acc, local_batches)`` — the UNMERGED partial.  Callers
+    merge float accumulators via ``parallel.cross_host_psum`` and
+    validate row counts themselves.  Raises
+    :class:`~libskylark_tpu.utils.exceptions.WorldMismatchError` (code
+    109) when the resolved world disagrees with ``partition``, when the
+    on-disk manifest was written for a different partition, or when the
+    pre-fold handshake sees a drifted rank.
+    """
+    params = params or ElasticParams()
+    rank, world = _resolve_world(params)
+    partition.validate_world(rank, world)
+    start_b, end_b = partition.batch_range(rank)
+    nlocal = end_b - start_b
+    global_factory = as_block_factory(source)
+
+    def local_factory(local_start: int):
+        if not 0 <= local_start <= nlocal:
+            raise ValueError(
+                f"local start batch {local_start} outside this rank's "
+                f"range of {nlocal} batches"
+            )
+        return islice(
+            iter(global_factory(start_b + local_start)),
+            nlocal - local_start,
+        )
+
+    ledger = None
+    local_params = _local_params(params, None)
+    if params.checkpoint_dir:
+        hdir = host_dir(params.checkpoint_dir, rank)
+        _check_manifest(hdir, partition, rank, kind, epoch, params.resume)
+        local_params = _local_params(params, hdir)
+        ledger = HostLedger(
+            os.path.join(hdir, PROGRESS_NAME), rank=rank, epoch=epoch
+        )
+
+    step = step_fn
+    if ledger is not None:
+        last = {"b": -1}
+
+        def step(acc, block, b):
+            out = step_fn(acc, block, b)
+            # Ledgered at FOLD time (not at prefetch), once per index:
+            # a guard replay re-folds the same indices and must not
+            # double-count the batch.
+            if b > last["b"]:
+                ledger.record("batch", batch=int(start_b + b), local=int(b))
+                last["b"] = b
+            return out
+
+    _handshake(partition, rank, world, kind, epoch)
+    if telemetry.enabled():
+        r0, r1 = partition.row_range(rank)
+        telemetry.inc("elastic.runs")
+        telemetry.event(
+            "elastic", "partition",
+            {"kind": kind, "rank": rank, "world": world, "epoch": int(epoch),
+             "batches": [start_b, end_b], "rows": [r0, r1],
+             "signature": int(partition.signature())},
+        )
+    meta = dict(metadata or {})
+    meta.update(
+        elastic={"rank": rank, "world": world, "epoch": int(epoch),
+                 "signature": int(partition.signature())}
+    )
+    acc, nbatches = run_stream(
+        local_factory, step, init_acc, local_params, kind=kind,
+        metadata=meta, fault_plan=fault_plan, report=report,
+    )
+    if ledger is not None:
+        ledger.record("done", batches=int(nbatches))
+        ledger.close()
+    return acc, nbatches
+
+
+def _require_real_world(partition) -> None:
+    """The distributed drivers MERGE across processes, so a simulated
+    (single-process, world_size > 1) configuration would silently return
+    an unmerged partial as if it were the global result.  Simulated-rank
+    tests fold through :func:`elastic_run_stream` and merge by hand."""
+    import jax
+
+    if partition.world_size != jax.process_count():
+        raise InvalidParameters(
+            f"distributed drivers need a live jax.distributed world of "
+            f"{partition.world_size} processes (found "
+            f"{jax.process_count()}); for simulated ranks use "
+            "elastic_run_stream and merge partials explicitly"
+        )
+
+
+def distributed_sketch(
+    source,
+    S,
+    *,
+    ncols: int,
+    partition: RowPartition,
+    dtype=None,
+    params: ElasticParams | None = None,
+    fault_plan=None,
+    epoch: int = 0,
+):
+    """Distributed one-pass columnwise ``S·A`` over a partitioned stream.
+
+    Every process calls this with the same arguments; each folds its
+    partition share locally (global row offsets address the counter
+    windows, so partials are exactly the rows an unsharded pass would
+    realize), partials merge with one psum, and the merged sum is
+    finalized — sum-then-epilogue, the same contract as
+    ``finalize_slices`` in-core.  Returns the full (s, ncols) sketch,
+    identical on every process.
+    """
+    import jax.numpy as jnp
+
+    from ..parallel.collectives import cross_host_psum
+    from ..plans import accumulate_slice
+    from ..sketch.base import Dimension
+    from .drivers import _result_dtype, _unwrap
+
+    if partition.nrows != S.n:
+        raise InvalidParameters(
+            f"partition covers {partition.nrows} rows but the sketch "
+            f"domain is {S.n}"
+        )
+    _require_real_world(partition)
+    params = params or ElasticParams()
+    rank, world = _resolve_world(params)
+    partition.validate_world(rank, world)
+    r0, r1 = partition.row_range(rank)
+    dt = _result_dtype(dtype)
+    init = {
+        "sa": jnp.zeros((S.s, int(ncols)), dt),
+        "row": np.asarray(r0, np.int64),
+    }
+
+    def step(acc, block, index):
+        row = int(acc["row"])
+        block, k = _unwrap(block)
+        return {
+            "sa": accumulate_slice(S, acc["sa"], block, row, true_rows=k),
+            "row": np.asarray(row + k, np.int64),
+        }
+
+    report = guard.RecoveryReport(stage="distributed_streaming_sketch")
+    acc, nbatches = elastic_run_stream(
+        source, step, init, partition, params,
+        kind="distributed_streaming_sketch", fault_plan=fault_plan,
+        report=report, epoch=epoch,
+    )
+    rows = int(acc["row"])
+    if rows != r1:
+        raise ValueError(
+            f"rank {rank} folded rows [{r0}, {rows}) but its partition "
+            f"share is [{r0}, {r1}); the source and partition disagree"
+        )
+    merged = cross_host_psum({"sa": acc["sa"]})
+    out = S.finalize_slices(jnp.asarray(merged["sa"]), Dimension.COLUMNWISE)
+    if guard.enabled():
+        guard.check_finite(out, "distributed_streaming_sketch",
+                           report=report)
+    return out
+
+
+def distributed_sketch_least_squares(
+    source,
+    S,
+    *,
+    ncols: int,
+    partition: RowPartition,
+    targets: int = 1,
+    alg: str = "qr",
+    dtype=None,
+    params: ElasticParams | None = None,
+    fault_plan=None,
+    epoch: int = 0,
+):
+    """Distributed streaming sketch-and-solve least squares.
+
+    One partitioned pass accumulates per-rank partials of ``(S·A,
+    S·b)``, one psum merges them, and every rank solves the identical
+    small (s, n) problem — so ``x`` is bit-identical across ranks with
+    no broadcast.  Guard verdicts are WORLD decisions: each rank
+    certifies the merged ``S·A`` locally, the ok/not-ok flags (plus the
+    ranks' chunk-sentinel replay counts) psum across the world, and a
+    bad certificate on ANY rank sends EVERY rank down the same ladder
+    rung (the SVD pseudoinverse small solve) — ranks must agree on
+    ``SKYLARK_GUARD`` for the collective order to match.
+
+    Returns ``(x, info)``; ``info`` carries only world-deterministic
+    fields (global ``rows``/``batches``, the rank's own
+    ``local_batches``, ``world_size``, ``rank``, ``recovery``) so an
+    interrupted-and-resumed run reproduces an uninterrupted run's
+    ``(x, info)`` bit-for-bit.
+    """
+    import jax.numpy as jnp
+
+    from ..linalg.least_squares import exact_least_squares
+    from ..parallel.collectives import cross_host_psum
+    from ..plans import accumulate_slice
+    from ..sketch.base import Dimension
+    from .drivers import _result_dtype
+
+    if partition.nrows != S.n:
+        raise InvalidParameters(
+            f"partition covers {partition.nrows} rows but the sketch "
+            f"domain is {S.n}"
+        )
+    _require_real_world(partition)
+    params = params or ElasticParams()
+    rank, world = _resolve_world(params)
+    partition.validate_world(rank, world)
+    r0, r1 = partition.row_range(rank)
+    dt = _result_dtype(dtype)
+    init = {
+        "sa": jnp.zeros((S.s, int(ncols)), dt),
+        "sb": jnp.zeros((S.s, int(targets)), dt),
+        "row": np.asarray(r0, np.int64),
+    }
+
+    def step(acc, batch, index):
+        A_b, b_b = batch
+        row = int(acc["row"])
+        b2 = b_b[:, None] if getattr(b_b, "ndim", 1) == 1 else b_b
+        return {
+            "sa": accumulate_slice(S, acc["sa"], A_b, row),
+            "sb": accumulate_slice(S, acc["sb"], b2, row),
+            "row": np.asarray(row + A_b.shape[0], np.int64),
+        }
+
+    guarded = guard.enabled()
+    report = (
+        guard.RecoveryReport(stage="distributed_streaming_lsq")
+        if guarded
+        else guard.RecoveryReport.disabled("distributed_streaming_lsq")
+    )
+    acc, nbatches = elastic_run_stream(
+        source, step, init, partition, params,
+        kind="distributed_streaming_lsq", fault_plan=fault_plan,
+        report=report, epoch=epoch,
+    )
+    rows = int(acc["row"])
+    if rows != r1:
+        raise ValueError(
+            f"rank {rank} folded rows [{r0}, {rows}) but its partition "
+            f"share is [{r0}, {r1}); the source and partition disagree"
+        )
+    merged = cross_host_psum({"sa": acc["sa"], "sb": acc["sb"]})
+    SA = S.finalize_slices(jnp.asarray(merged["sa"]), Dimension.COLUMNWISE)
+    SB = S.finalize_slices(jnp.asarray(merged["sb"]), Dimension.COLUMNWISE)
+    if guarded:
+        # No resketch rung exists for a one-pass stream (the data is
+        # gone), so a failed certificate degrades the SMALL solve — and
+        # the degradation is a WORLD decision: psum the verdict so every
+        # rank takes the same rung even when only one saw the failure.
+        cert = guard.certify_sketch(SA, stage="distributed_streaming_lsq")
+        local_replays = sum(
+            1 for a in report.attempts if a.action == "replay"
+        )
+        votes = cross_host_psum(
+            np.asarray([0.0 if cert.ok else 1.0, float(local_replays)],
+                       np.float64)
+        )
+        world_bad, world_replays = int(votes[0]), int(votes[1])
+        report.record(
+            "initial", verdict=cert.verdict, detail=cert.detail,
+            cond=cert.cond, sketch_size=int(SA.shape[0]),
+        )
+        report.record(
+            "world",
+            detail=(
+                f"psum verdict over {world} rank(s): bad_certs="
+                f"{world_bad}, chunk_replays={world_replays}"
+            ),
+        )
+        if world_bad:
+            alg = "svd"
+            report.record(
+                "fallback", verdict=guard.FALLBACK,
+                detail="svd pseudoinverse small solve (world verdict)",
+            )
+            report.recovered = True
+    X = exact_least_squares(SA, SB, alg=alg)
+    if guarded:
+        guard.check_finite(X, "distributed_streaming_lsq", report=report)
+    x = X[:, 0] if targets == 1 else X
+    info = {
+        "rows": int(partition.nrows),
+        "batches": int(partition.num_batches),
+        "local_batches": int(nbatches),
+        "world_size": int(partition.world_size),
+        "rank": int(rank),
+        "recovery": report.to_dict(),
+    }
+    telemetry.run_summary("distributed_streaming_lsq", info)
+    return x, info

@@ -12,6 +12,7 @@ from .exceptions import (
     SkylarkError,
     SketchError,
     UnsupportedError,
+    WorldMismatchError,
 )
 from .timer import PhaseTimer, timer_report
 
@@ -27,6 +28,7 @@ __all__ = [
     "IOError_",
     "ConvergenceError",
     "CheckpointError",
+    "WorldMismatchError",
     "save_solver_state",
     "load_solver_state",
     "CheckpointStore",

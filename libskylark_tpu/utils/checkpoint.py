@@ -48,8 +48,31 @@ def _leaf_bytes(arr: np.ndarray) -> bytes:
     return np.ascontiguousarray(arr).tobytes()
 
 
+def _fsync_dir(directory: str) -> None:
+    """Flush a directory's entry table (rename durability on POSIX).
+    Best-effort: some filesystems refuse O_RDONLY dir fds — a failed
+    sync must not fail the save."""
+    try:
+        fd = os.open(directory or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def save_solver_state(path, state, metadata: dict | None = None) -> None:
-    """``state`` is any pytree of arrays; saved atomically (tmp+rename)."""
+    """``state`` is any pytree of arrays; saved atomically (tmp+rename).
+
+    Durability order matters for crash safety: the tmp file's CONTENTS
+    are fsynced before the rename, and the directory entry after it, so
+    a host crash at any point leaves either the old slot, or the new
+    slot fully written — never a named-but-empty file that would count
+    as the newest slot while holding garbage.
+    """
     leaves, treedef = jax.tree.flatten(state)
     arrays = [np.asarray(v) for v in leaves]
     meta = {
@@ -67,7 +90,11 @@ def save_solver_state(path, state, metadata: dict | None = None) -> None:
         __meta__=np.frombuffer(json.dumps(meta).encode(), dtype=np.uint8),
         **{f"leaf_{i}": a for i, a in enumerate(arrays)},
     )
+    with open(tmp, "rb+") as f:
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, str(path) + ".npz")
+    _fsync_dir(os.path.dirname(str(path)))
 
 
 def _restore_dtype(arr: np.ndarray, name: str | None) -> np.ndarray:
@@ -184,6 +211,10 @@ class CheckpointStore:
         meta = dict(metadata or {})
         meta["step"] = int(step)
         slot = self._slot(step)
+        # save_solver_state fsyncs the slot's contents AND the directory
+        # entry before returning, so by the time pruning below unlinks
+        # older slots the new one is durable — a crash mid-rotation can
+        # cost old slots but never the only valid one.
         save_solver_state(slot, state, meta)
         for old in self.steps()[: -self.keep_last]:
             try:

@@ -16,6 +16,7 @@ __all__ = [
     "ConvergenceError",
     "CheckpointError",
     "NumericalHealthError",
+    "WorldMismatchError",
 ]
 
 
@@ -82,3 +83,21 @@ class NumericalHealthError(SkylarkError):
         super().__init__(msg)
         self.stage = stage
         self.report = report
+
+
+class WorldMismatchError(SkylarkError):
+    """An elastic distributed stream was resumed (or joined) under a
+    world that disagrees with the one that wrote its state: different
+    ``jax.distributed`` world size, a different row partition, or ranks
+    whose partition/epoch signatures disagree at the barrier handshake.
+    Merging partial sketches across such a mismatch would silently
+    combine stale or mis-addressed partials, so the engine fails fast
+    instead.  ``expected``/``got`` carry the two sides of the mismatch
+    (dicts or scalars, best-effort) for diagnostics."""
+
+    code = 109
+
+    def __init__(self, msg, expected=None, got=None):
+        super().__init__(msg)
+        self.expected = expected
+        self.got = got

@@ -40,6 +40,10 @@ def main() -> int:
     from jax.sharding import Mesh, NamedSharding
     from jax.sharding import PartitionSpec as P
 
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # jax < 0.5 keeps it under jax.experimental
+        from jax.experimental.shard_map import shard_map
+
     assert jax.process_count() == nprocs, jax.process_count()
     assert len(jax.devices()) == 2 * nprocs, jax.devices()
     print("CHECK world OK", flush=True)
@@ -55,7 +59,7 @@ def main() -> int:
         (nglobal,), sh, lambda idx: np.arange(nglobal, dtype=np.float32)[idx]
     )
     summed = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda a: jax.lax.psum(a, "p"), mesh=mesh,
             in_specs=P("p"), out_specs=P(),
         )
@@ -111,7 +115,7 @@ def main() -> int:
     # that runs but miscomputes still fails the rank.
     try:
         colsums = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda a: jax.lax.psum_scatter(
                     a, "p", scatter_dimension=1, tiled=True
                 ),
@@ -139,7 +143,7 @@ def main() -> int:
     # (device i ends with X[:, i]) — a pure cross-process data exchange.
     try:
         cols = jax.jit(
-            jax.shard_map(
+            shard_map(
                 lambda a: jax.lax.all_to_all(
                     a, "p", split_axis=1, concat_axis=0, tiled=True
                 ),

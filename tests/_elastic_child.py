@@ -1,0 +1,117 @@
+"""Child process for the multi-process elastic streaming tests.
+
+Usage::
+
+    python tests/_elastic_child.py <proc_id> <num_procs> <port> \
+        <checkpoint_root> <out_dir> <resume>
+
+One rank of a ``jax.distributed`` world running the distributed
+streaming sketch-and-solve (``distributed_sketch_least_squares``) over
+a deterministic synthetic problem.  The whole world streams the SAME
+global source; each rank folds only its ``RowPartition`` share and the
+psum merge makes ``x`` identical everywhere.  On success the rank saves
+``x-<rank>.npy`` + ``info-<rank>.json`` into ``out_dir`` and prints
+``ELASTIC-OK``.
+
+Fault injection (the kill-one-rank scenario): when
+``ELASTIC_KILL_RANK`` matches this rank, a ``FaultPlan`` subclass
+SIGKILLs the process right after checkpoint chunk
+``ELASTIC_KILL_AFTER_CHUNK`` commits — a real uncatchable death
+mid-stream, not an exception.  The parent restarts the world with
+``resume=1`` and checks bit-identity against an uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+
+NROWS, NCOLS, BATCH_ROWS, S_SIZE = 96, 5, 4, 24
+
+
+def main() -> int:
+    proc_id, nprocs, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    root, out_dir, resume = sys.argv[4], sys.argv[5], sys.argv[6] == "1"
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=nprocs,
+        process_id=proc_id,
+        initialization_timeout=60,
+    )
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from libskylark_tpu import SketchContext
+    from libskylark_tpu.resilient import FaultPlan
+    from libskylark_tpu.sketch.dense import JLT
+    from libskylark_tpu.streaming import ElasticParams, RowPartition
+    from libskylark_tpu.streaming.elastic import (
+        distributed_sketch_least_squares,
+    )
+
+    # Deterministic synthetic problem — every rank (and every restart)
+    # regenerates the identical stream.
+    rng = np.random.default_rng(5)
+    A = rng.standard_normal((NROWS, NCOLS))
+    b = rng.standard_normal(NROWS)
+    blocks = [
+        (jnp.asarray(A[lo : lo + BATCH_ROWS]),
+         jnp.asarray(b[lo : lo + BATCH_ROWS]))
+        for lo in range(0, NROWS, BATCH_ROWS)
+    ]
+
+    def factory(start: int):
+        return iter(blocks[start:])
+
+    part = RowPartition(
+        nrows=NROWS, batch_rows=BATCH_ROWS, world_size=nprocs
+    )
+    S = JLT(NROWS, S_SIZE, SketchContext(seed=13))
+
+    kill_rank = int(os.environ.get("ELASTIC_KILL_RANK", "-1"))
+    kill_after = int(os.environ.get("ELASTIC_KILL_AFTER_CHUNK", "-1"))
+
+    class KillPlan(FaultPlan):
+        """SIGKILL this process right after a chunk commit — the commit
+        is durable (fsynced file + directory), the death is real."""
+
+        def after_commit(self, chunk: int) -> None:
+            if chunk == kill_after:
+                sys.stdout.flush()
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    plan = KillPlan() if (proc_id == kill_rank and kill_after >= 0) else None
+    params = ElasticParams(
+        checkpoint_dir=root, checkpoint_every=1, resume=resume, prefetch=0
+    )
+    x, info = distributed_sketch_least_squares(
+        factory, S, ncols=NCOLS, partition=part, params=params,
+        fault_plan=plan,
+    )
+    np.save(os.path.join(out_dir, f"x-{proc_id}.npy"), np.asarray(x))
+    with open(
+        os.path.join(out_dir, f"info-{proc_id}.json"), "w", encoding="utf-8"
+    ) as fh:
+        json.dump(
+            {k: info[k] for k in
+             ("rows", "batches", "local_batches", "world_size", "rank")},
+            fh,
+        )
+    print("ELASTIC-OK", flush=True)
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,12 +38,17 @@ FAULTS_TIMEOUT_S = 120
 STREAMING_TIMEOUT_S = 120
 GUARD_TIMEOUT_S = 120
 TELEMETRY_TIMEOUT_S = 120
+# Multi-process elastic streaming runs three real jax.distributed worlds
+# back-to-back (reference run, kill-one-rank run, resume run), each with
+# its own formation timeout — the alarm must cover the worst-case sum.
+DISTRIBUTED_STREAMING_TIMEOUT_S = 900
 
 _TIMEOUT_MARKS = {
     "faults": FAULTS_TIMEOUT_S,
     "streaming": STREAMING_TIMEOUT_S,
     "guard": GUARD_TIMEOUT_S,
     "telemetry": TELEMETRY_TIMEOUT_S,
+    "distributed_streaming": DISTRIBUTED_STREAMING_TIMEOUT_S,
 }
 
 
@@ -77,6 +82,13 @@ def pytest_configure(config):
         "telemetry: observability-layer tests (spans, metrics registry, "
         "JSONL run ledger, run_summary contract); tier-1, guarded by a "
         f"per-test {TELEMETRY_TIMEOUT_S}s timeout",
+    )
+    config.addinivalue_line(
+        "markers",
+        "distributed_streaming: multi-process elastic streaming tests "
+        "(kill-one-rank resume over real jax.distributed worlds); slow "
+        f"tier, guarded by a per-test {DISTRIBUTED_STREAMING_TIMEOUT_S}s "
+        "timeout",
     )
 
 

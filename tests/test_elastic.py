@@ -1,0 +1,434 @@
+"""Multi-host elastic streaming tests — the single-process tier.
+
+Everything a ``jax.distributed`` world does that can be verified inside
+one process is verified here: RowPartition arithmetic, world=1 bitwise
+parity with the plain streaming drivers (the elastic route must not
+perturb PR-5 bit-identity), simulated multi-rank folds through
+``elastic_run_stream`` merged by hand (partial-sum parity + per-host
+ledger/manifest contents), the typed code-109 resume guards (manifest
+mismatch AND world-resolution mismatch), and single-rank kill-and-resume
+bit-identity with ledger replay accounting.  The REAL multi-process
+kill-one-rank scenario lives in ``tests/test_distributed.py`` (slow
+tier).
+"""
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libskylark_tpu import sketch as sk
+from libskylark_tpu import streaming
+from libskylark_tpu.core import SketchContext
+from libskylark_tpu.parallel import cross_host_psum
+from libskylark_tpu.plans import accumulate_slice
+from libskylark_tpu.sketch.base import Dimension
+from libskylark_tpu.streaming import (
+    ElasticParams,
+    HostLedger,
+    RowPartition,
+    StreamParams,
+    elastic_run_stream,
+    host_dir,
+    read_progress,
+    skip_batches,
+    world_info,
+)
+from libskylark_tpu.streaming.elastic import MANIFEST_NAME, PROGRESS_NAME
+from libskylark_tpu.utils.exceptions import (
+    InvalidParameters,
+    WorldMismatchError,
+)
+
+pytestmark = pytest.mark.streaming
+
+N, M, S_OUT = 60, 5, 16
+BATCH = 7  # 60/7 -> 9 batches, last one ragged (4 rows)
+
+
+def make_matrix(rng, n=N, m=M):
+    return jnp.asarray(rng.standard_normal((n, m)))
+
+
+def blocks_of(*arrays, batch=BATCH):
+    n = arrays[0].shape[0]
+    out = []
+    for lo in range(0, n, batch):
+        sl = tuple(a[lo : lo + batch] for a in arrays)
+        out.append(sl[0] if len(arrays) == 1 else sl)
+    return out
+
+
+def factory_of(*arrays, batch=BATCH):
+    def factory(start):
+        it = iter(blocks_of(*arrays, batch=batch))
+        return skip_batches(it, start) if start else it
+
+    return factory
+
+
+# ---------------------------------------------------------------------------
+# RowPartition arithmetic
+# ---------------------------------------------------------------------------
+
+
+class TestRowPartition:
+    @pytest.mark.parametrize(
+        "nrows,batch_rows,world",
+        [(60, 7, 1), (60, 7, 2), (60, 7, 4), (60, 7, 9), (60, 7, 16),
+         (64, 8, 3), (1, 1, 1), (5, 100, 2)],
+    )
+    def test_batch_ranges_partition_the_stream(self, nrows, batch_rows,
+                                               world):
+        p = RowPartition(nrows=nrows, batch_rows=batch_rows,
+                         world_size=world)
+        ranges = [p.batch_range(r) for r in range(world)]
+        # contiguous, ordered, covering [0, num_batches) exactly
+        assert ranges[0][0] == 0
+        assert ranges[-1][1] == p.num_batches
+        for (a0, a1), (b0, b1) in zip(ranges, ranges[1:]):
+            assert a1 == b0
+        # balanced: sizes differ by at most one, extras go to low ranks
+        sizes = [b1 - b0 for b0, b1 in ranges]
+        assert max(sizes) - min(sizes) <= 1
+        assert sizes == sorted(sizes, reverse=True)
+
+    def test_row_ranges_cover_rows_with_ragged_tail(self):
+        p = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        assert p.num_batches == 9
+        r = [p.row_range(i) for i in range(2)]
+        assert r[0] == (0, 5 * BATCH)  # rank 0 takes the extra batch
+        assert r[1] == (5 * BATCH, N)  # ragged tail lands on the last rank
+        assert r[1][1] - r[1][0] == 4 * BATCH - (9 * BATCH - N)
+
+    def test_every_process_computes_the_same_split(self):
+        a = RowPartition(nrows=1000, batch_rows=32, world_size=5)
+        b = RowPartition.from_json(json.loads(json.dumps(a.to_json())))
+        assert a == b
+        assert a.signature() == b.signature()
+
+    def test_signature_distinguishes_partitions(self):
+        base = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        for other in (
+            RowPartition(nrows=N, batch_rows=BATCH, world_size=4),
+            RowPartition(nrows=N, batch_rows=BATCH + 1, world_size=2),
+            RowPartition(nrows=N + 1, batch_rows=BATCH, world_size=2),
+        ):
+            assert other.signature() != base.signature()
+
+    def test_invalid_parameters_rejected(self):
+        with pytest.raises(InvalidParameters):
+            RowPartition(nrows=0, batch_rows=BATCH, world_size=1)
+        with pytest.raises(InvalidParameters):
+            RowPartition(nrows=N, batch_rows=-1, world_size=1)
+        with pytest.raises(InvalidParameters):
+            RowPartition(nrows=N, batch_rows=BATCH, world_size=0)
+        p = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        with pytest.raises(InvalidParameters):
+            p.batch_range(2)
+
+    def test_validate_world_is_the_typed_109_guard(self):
+        p = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        p.validate_world(0, 2)
+        p.validate_world(1, 2)
+        with pytest.raises(WorldMismatchError) as ei:
+            p.validate_world(0, 4)
+        assert ei.value.code == 109
+        assert ei.value.expected == 2
+        assert ei.value.got == 4
+        with pytest.raises(WorldMismatchError):
+            p.validate_world(3, 2)
+
+
+# ---------------------------------------------------------------------------
+# world=1 parity: the elastic route must be bitwise the plain route
+# ---------------------------------------------------------------------------
+
+
+class TestSingleProcessParity:
+    def test_distributed_sketch_is_bitwise_plain_sketch(self, rng):
+        ctx = SketchContext(seed=21)
+        S = sk.JLT(N, S_OUT, ctx)
+        A = make_matrix(rng)
+        want = streaming.sketch(factory_of(A), S, "columnwise", ncols=M)
+        part = RowPartition(nrows=N, batch_rows=BATCH, world_size=1)
+        got = streaming.sketch(
+            factory_of(A), S, "columnwise", ncols=M, partition=part
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_distributed_lsq_is_bitwise_plain_lsq(self, rng):
+        ctx = lambda: SketchContext(seed=22)  # noqa: E731
+        A = make_matrix(rng)
+        b = jnp.asarray(rng.standard_normal(N))
+
+        def run(ctx_, partition):
+            S = sk.CWT(N, S_OUT, ctx_)
+            return streaming.sketch_least_squares(
+                factory_of(A, b), S, ncols=M, partition=partition
+            )
+
+        want, winfo = run(ctx(), None)
+        part = RowPartition(nrows=N, batch_rows=BATCH, world_size=1)
+        got, ginfo = run(ctx(), part)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert ginfo["rows"] == winfo["rows"] == N
+        assert ginfo["batches"] == winfo["batches"] == 9
+        assert ginfo["local_batches"] == 9
+        assert ginfo["world_size"] == 1 and ginfo["rank"] == 0
+
+    def test_rowwise_partition_rejected(self, rng):
+        ctx = SketchContext(seed=23)
+        S = sk.JLT(M, S_OUT, ctx)
+        part = RowPartition(nrows=N, batch_rows=BATCH, world_size=1)
+        with pytest.raises(ValueError, match="columnwise-only"):
+            streaming.sketch(
+                factory_of(make_matrix(rng)), S, "rowwise", partition=part
+            )
+
+    def test_partition_route_requires_ncols(self, rng):
+        ctx = SketchContext(seed=23)
+        S = sk.JLT(N, S_OUT, ctx)
+        part = RowPartition(nrows=N, batch_rows=BATCH, world_size=1)
+        with pytest.raises(ValueError, match="ncols"):
+            streaming.sketch(
+                factory_of(make_matrix(rng)), S, "columnwise",
+                partition=part,
+            )
+
+    def test_simulated_world_rejected_by_merge_drivers(self, rng):
+        # The drivers psum-merge; a world_size>1 partition in a single
+        # process would return an unmerged partial as if global.
+        ctx = SketchContext(seed=24)
+        S = sk.JLT(N, S_OUT, ctx)
+        part = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        with pytest.raises(InvalidParameters, match="live jax.distributed"):
+            streaming.sketch(
+                factory_of(make_matrix(rng)), S, "columnwise", ncols=M,
+                partition=part,
+            )
+
+    def test_cross_host_psum_is_identity_at_world_one(self, rng):
+        tree = {"sa": jnp.asarray(rng.standard_normal((3, 4))),
+                "sb": jnp.asarray(rng.standard_normal((3, 1)))}
+        out = cross_host_psum(tree)
+        assert set(out) == {"sa", "sb"}
+        np.testing.assert_array_equal(np.asarray(out["sa"]),
+                                      np.asarray(tree["sa"]))
+        np.testing.assert_array_equal(np.asarray(out["sb"]),
+                                      np.asarray(tree["sb"]))
+
+
+# ---------------------------------------------------------------------------
+# simulated ranks: per-rank folds + hand merge, ledgers, manifests
+# ---------------------------------------------------------------------------
+
+
+def _rank_fold(A, S, part, rank, root, *, resume=False, fault_plan=None,
+               checkpoint_every=1):
+    """One simulated rank's partial fold of columnwise S·A."""
+    r0, _ = part.row_range(rank)
+    init = {
+        "sa": jnp.zeros((S.s, A.shape[1]), jnp.float64),
+        "row": np.asarray(r0, np.int64),
+    }
+
+    def step(acc, block, index):
+        row = int(acc["row"])
+        return {
+            "sa": accumulate_slice(S, acc["sa"], block, row),
+            "row": np.asarray(row + block.shape[0], np.int64),
+        }
+
+    params = ElasticParams(
+        rank=rank, world_size=part.world_size,
+        checkpoint_dir=str(root) if root is not None else None,
+        checkpoint_every=checkpoint_every, resume=resume, prefetch=0,
+    )
+    return elastic_run_stream(
+        factory_of(A), step, init, part, params, fault_plan=fault_plan
+    )
+
+
+class TestSimulatedRanks:
+    def test_two_rank_merge_matches_in_core_apply(self, tmp_path, rng):
+        ctx = SketchContext(seed=31)
+        S = sk.JLT(N, S_OUT, ctx)
+        A = make_matrix(rng)
+        part = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        partials = []
+        for rank in range(2):
+            acc, nbatches = _rank_fold(A, S, part, rank, tmp_path)
+            b0, b1 = part.batch_range(rank)
+            assert nbatches == b1 - b0
+            r0, r1 = part.row_range(rank)
+            assert int(acc["row"]) == r1
+            partials.append(acc["sa"])
+        merged = S.finalize_slices(partials[0] + partials[1],
+                                   Dimension.COLUMNWISE)
+        want = S.apply(A, Dimension.COLUMNWISE)
+        np.testing.assert_allclose(
+            np.asarray(merged), np.asarray(want), rtol=1e-10, atol=1e-10
+        )
+
+    def test_per_host_ledger_records_owned_batches(self, tmp_path, rng):
+        ctx = SketchContext(seed=32)
+        S = sk.JLT(N, S_OUT, ctx)
+        A = make_matrix(rng)
+        part = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        for rank in range(2):
+            _rank_fold(A, S, part, rank, tmp_path)
+        for rank in range(2):
+            hdir = host_dir(tmp_path, rank)
+            recs = read_progress(os.path.join(hdir, PROGRESS_NAME))
+            b0, b1 = part.batch_range(rank)
+            folded = [r["attrs"]["batch"] for r in recs
+                      if r["name"] == "batch"]
+            assert folded == list(range(b0, b1))
+            locals_ = [r["attrs"]["local"] for r in recs
+                       if r["name"] == "batch"]
+            assert locals_ == list(range(b1 - b0))
+            done = [r for r in recs if r["name"] == "done"]
+            assert len(done) == 1
+            assert done[0]["attrs"]["batches"] == b1 - b0
+            # telemetry run-ledger schema, per-host manifest
+            for r in recs:
+                assert set(r) == {"ts", "seq", "pid", "kind", "name",
+                                  "attrs"}
+                assert r["kind"] == "elastic"
+                assert r["attrs"]["rank"] == rank
+            with open(os.path.join(hdir, MANIFEST_NAME)) as fh:
+                man = json.load(fh)
+            assert man["rank"] == rank
+            assert man["signature"] == part.signature()
+            assert man["partition"] == part.to_json()
+
+    def test_kill_one_rank_resume_is_bit_identical(self, tmp_path, rng):
+        from libskylark_tpu.resilient import FaultPlan, SimulatedPreemption
+
+        ctx = SketchContext(seed=33)
+        S = sk.JLT(N, S_OUT, ctx)
+        A = make_matrix(rng)
+        part = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        victim = 1
+        # uninterrupted reference fold for the victim rank
+        want_acc, _ = _rank_fold(A, S, part, victim, tmp_path / "ref")
+        # killed fold: checkpoint every batch, die after chunk 1
+        root = tmp_path / "elastic"
+        with pytest.raises(SimulatedPreemption):
+            _rank_fold(
+                A, S, part, victim, root,
+                fault_plan=FaultPlan(preempt_after_chunk=1),
+            )
+        hdir = host_dir(root, victim)
+        killed = read_progress(os.path.join(hdir, PROGRESS_NAME))
+        folded_before = [r["attrs"]["batch"] for r in killed
+                         if r["name"] == "batch"]
+        assert folded_before  # died mid-stream, after some progress
+        assert not [r for r in killed if r["name"] == "done"]
+        # restart with resume: only the uncheckpointed tail re-folds
+        got_acc, nbatches = _rank_fold(
+            A, S, part, victim, root, resume=True
+        )
+        b0, b1 = part.batch_range(victim)
+        assert nbatches == b1 - b0
+        np.testing.assert_array_equal(
+            np.asarray(got_acc["sa"]), np.asarray(want_acc["sa"])
+        )
+        recs = read_progress(os.path.join(hdir, PROGRESS_NAME))
+        replayed = [r["attrs"]["batch"] for r in recs[len(killed):]
+                    if r["name"] == "batch"]
+        # checkpoint_every=1: both committed chunks (batches b0, b0+1)
+        # are on disk, so the resume replays exactly the tail
+        assert replayed == list(range(b0 + 2, b1))
+        assert [r for r in recs if r["name"] == "done"]
+
+    def test_resume_under_different_world_raises_109(self, tmp_path, rng):
+        ctx = SketchContext(seed=34)
+        S = sk.JLT(N, S_OUT, ctx)
+        A = make_matrix(rng)
+        part2 = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        _rank_fold(A, S, part2, 0, tmp_path)
+        # same host dir, resumed for a world of 4: manifest mismatch
+        part4 = RowPartition(nrows=N, batch_rows=BATCH, world_size=4)
+        with pytest.raises(WorldMismatchError) as ei:
+            _rank_fold(A, S, part4, 0, tmp_path, resume=True)
+        assert ei.value.code == 109
+        assert ei.value.expected["signature"] == part2.signature()
+        assert ei.value.got["signature"] == part4.signature()
+
+    def test_resume_under_different_row_partition_raises_109(
+        self, tmp_path, rng
+    ):
+        ctx = SketchContext(seed=35)
+        S = sk.JLT(N, S_OUT, ctx)
+        A = make_matrix(rng)
+        part = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        _rank_fold(A, S, part, 0, tmp_path)
+        repart = RowPartition(nrows=N, batch_rows=BATCH + 3, world_size=2)
+        with pytest.raises(WorldMismatchError) as ei:
+            _rank_fold(A, S, repart, 0, tmp_path, resume=True)
+        assert ei.value.code == 109
+
+    def test_world_resolution_mismatch_raises_109_without_disk(self, rng):
+        # the validate_world half of the guard: no checkpoint dir at all
+        ctx = SketchContext(seed=36)
+        S = sk.JLT(N, S_OUT, ctx)
+        A = make_matrix(rng)
+        part = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        init = {"sa": jnp.zeros((S.s, M), jnp.float64),
+                "row": np.asarray(0, np.int64)}
+        with pytest.raises(WorldMismatchError) as ei:
+            elastic_run_stream(
+                factory_of(A), lambda a, b, i: a, init, part,
+                ElasticParams(rank=0, world_size=3, prefetch=0),
+            )
+        assert ei.value.code == 109
+
+    def test_world_info_single_process(self):
+        rank, world = world_info()
+        assert (rank, world) == (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# HostLedger contract
+# ---------------------------------------------------------------------------
+
+
+class TestHostLedger:
+    def test_schema_and_seq_continuation(self, tmp_path):
+        path = tmp_path / "progress.jsonl"
+        led = HostLedger(path, rank=3, epoch=2)
+        led.record("batch", batch=7, local=0)
+        led.record("done", batches=1)
+        led.close()
+        recs = read_progress(path)
+        assert [r["seq"] for r in recs] == [1, 2]
+        assert all(r["kind"] == "elastic" for r in recs)
+        assert recs[0]["attrs"] == {"rank": 3, "epoch": 2, "batch": 7,
+                                    "local": 0}
+        # a restarted incarnation keeps the per-host total order
+        led2 = HostLedger(path, rank=3, epoch=2)
+        led2.record("batch", batch=8, local=1)
+        led2.close()
+        recs = read_progress(path)
+        assert [r["seq"] for r in recs] == [1, 2, 3]
+
+    def test_read_progress_tolerates_torn_tail(self, tmp_path):
+        path = tmp_path / "progress.jsonl"
+        led = HostLedger(path, rank=0)
+        led.record("batch", batch=0, local=0)
+        led.close()
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write('{"ts": 1.0, "seq": 2, "pid": 1, "ki')  # SIGKILL tear
+        recs = read_progress(path)
+        assert len(recs) == 1
+        # and the next incarnation continues from the last INTACT seq
+        led2 = HostLedger(path, rank=0)
+        assert led2.record("done", batches=1) == 2
+        led2.close()
+
+    def test_missing_file_reads_empty(self, tmp_path):
+        assert read_progress(tmp_path / "absent.jsonl") == []

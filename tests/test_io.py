@@ -213,6 +213,79 @@ def test_unknown_remote_scheme_raises():
         open_source("no-such-proto-xyz://bucket/key").open()
 
 
+class _FlakyFsspec:
+    """Stands in for the fsspec module: ``open()`` raises transient
+    OSErrors for the first ``fail`` calls, then returns an OpenFile-alike
+    whose ``.open()`` yields the payload."""
+
+    def __init__(self, fail: int, payload: bytes = b"payload"):
+        self.fail = fail
+        self.calls = 0
+        self.payload = payload
+
+    def open(self, url, mode):
+        self.calls += 1
+        if self.calls <= self.fail:
+            raise OSError(f"transient failure #{self.calls}")
+        payload = self.payload
+
+        class _OpenFile:
+            def open(self):
+                import io as _io
+
+                return _io.BytesIO(payload)
+
+        return _OpenFile()
+
+
+def test_fsspec_open_retries_transient_oserror():
+    """Satellite: FsspecSource.open() retries transient OSError with
+    jittered exponential backoff instead of killing the stream."""
+    pytest.importorskip("fsspec")
+    from libskylark_tpu.io.source import FsspecSource
+
+    src = FsspecSource("memory://flaky/x", retries=3, backoff=0.1)
+    flaky = _FlakyFsspec(fail=2)
+    src._fsspec = flaky
+    sleeps = []
+    src._sleep = sleeps.append
+    src._jitter = lambda: 0.5  # deterministic: delay = backoff * 2**k
+    with src.open() as f:
+        assert f.read() == b"payload"
+    assert flaky.calls == 3  # 2 failures + 1 success
+    # Exponential steps (jitter pinned): 0.1, 0.2.
+    np.testing.assert_allclose(sleeps, [0.1, 0.2])
+
+
+def test_fsspec_open_retry_budget_exhausted():
+    pytest.importorskip("fsspec")
+    from libskylark_tpu.io.source import FsspecSource
+
+    src = FsspecSource("memory://flaky/y", retries=2, backoff=0.01)
+    flaky = _FlakyFsspec(fail=10)
+    src._fsspec = flaky
+    src._sleep = lambda s: None
+    with pytest.raises(OSError, match="transient failure #3"):
+        src.open()
+    assert flaky.calls == 3  # first try + 2 retries, then the raise
+
+
+def test_fsspec_open_retries_counted_in_telemetry(tmp_path, monkeypatch):
+    pytest.importorskip("fsspec")
+    from libskylark_tpu import telemetry
+    from libskylark_tpu.io.source import FsspecSource
+
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    telemetry.reset()
+    src = FsspecSource("memory://flaky/z", retries=3, backoff=0.01)
+    src._fsspec = _FlakyFsspec(fail=1)
+    src._sleep = lambda s: None
+    with src.open() as f:
+        f.read()
+    assert telemetry.REGISTRY.snapshot()["counters"]["io.open_retries"] == 1
+    telemetry.reset()
+
+
 class TestRemoteSchemeIntegration:
     """A REAL non-local fsspec driver (http:// against a live local
     server): the network-remote code path an hdfs:///s3:// URL takes —

@@ -153,6 +153,46 @@ class TestCheckpointStore:
         with pytest.raises(CheckpointError, match="no valid checkpoint"):
             store.load_latest(like={"x": jnp.zeros(2)})
 
+    def test_save_fsyncs_file_and_directory_before_rotation(
+        self, tmp_path, monkeypatch
+    ):
+        """Satellite: the new slot's contents AND the directory entry are
+        fsynced before keep-last-N pruning unlinks older slots, so a
+        crash mid-rotation can never leave zero durable slots."""
+        events = []
+        real_fsync = os.fsync
+        real_remove = os.remove
+
+        def spy_fsync(fd):
+            events.append(("fsync", "dir" if _fd_is_dir(fd) else "file"))
+            return real_fsync(fd)
+
+        def _fd_is_dir(fd):
+            import stat
+
+            return stat.S_ISDIR(os.fstat(fd).st_mode)
+
+        def spy_remove(path):
+            events.append(("remove", os.path.basename(path)))
+            return real_remove(path)
+
+        monkeypatch.setattr(os, "fsync", spy_fsync)
+        monkeypatch.setattr(os, "remove", spy_remove)
+        store = CheckpointStore(tmp_path, keep_last=1)
+        store.save({"x": jnp.ones(2)}, step=1)
+        store.save({"x": jnp.full((2,), 2.0)}, step=2)  # prunes step 1
+        kinds = [e for e in events if e[0] == "fsync"]
+        assert ("fsync", "file") in kinds and ("fsync", "dir") in kinds
+        # Rotation's unlink of the old slot happens strictly after the
+        # new slot's syncs.
+        last_sync = max(i for i, e in enumerate(events) if e[0] == "fsync")
+        first_rm = next(i for i, e in enumerate(events) if e[0] == "remove")
+        assert first_rm > last_sync, events
+        # And the surviving slot is the durable new one.
+        state, _, step = store.load_latest(like={"x": jnp.zeros(2)})
+        assert step == 2
+        np.testing.assert_array_equal(state["x"], [2.0, 2.0])
+
 
 class TestWithRetries:
     def test_succeeds_after_transient_failures(self):
