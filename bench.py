@@ -880,6 +880,79 @@ def bench_telemetry(on_tpu, table):
     )
 
 
+def bench_elastic_resume(on_tpu, table):
+    """Elastic-resume submetric (docs/fault_tolerance.md): a world=1
+    partitioned streaming fold is preempted mid-pass right after a chunk
+    commit, then resumed from the per-host checkpoints; the emitted value
+    is wall-seconds from the kill to the FIRST post-resume fold landing —
+    the restore + ledger-replay latency a real preempted host pays before
+    it makes forward progress again.  Dry-run scale on purpose: the cost
+    is dominated by checkpoint restore and plan/ledger I/O, not FLOPs.
+    First capture: vs_baseline fixed at 1.0."""
+    import tempfile
+
+    from libskylark_tpu.plans import accumulate_slice
+    from libskylark_tpu.resilient import FaultPlan, SimulatedPreemption
+    from libskylark_tpu.sketch.hash import CWT
+    from libskylark_tpu.streaming import ElasticParams, RowPartition
+    from libskylark_tpu.streaming.elastic import elastic_run_stream
+
+    n, d, br = 8192, 64, 512  # 16 batches, preempt after chunk 7
+    rng = np.random.default_rng(77)
+    A = rng.standard_normal((n, d))
+    blocks = [jnp.asarray(A[lo : lo + br]) for lo in range(0, n, br)]
+    S = CWT(n, 256, SketchContext(seed=77))
+    part = RowPartition(nrows=n, batch_rows=br, world_size=1)
+    init = {
+        "sa": jnp.zeros((S.s, d), jnp.float32),
+        "row": np.asarray(0, np.int64),
+    }
+    first_fold: list[float] = []
+
+    def step(acc, block, index):
+        row = int(acc["row"])
+        out = {
+            "sa": accumulate_slice(S, acc["sa"], block, row),
+            "row": np.asarray(row + block.shape[0], np.int64),
+        }
+        if not first_fold:
+            jax.block_until_ready(out["sa"])
+            first_fold.append(time.perf_counter())
+        return out
+
+    def factory(start):
+        return iter(blocks[start:])
+
+    with tempfile.TemporaryDirectory() as root:
+        params = ElasticParams(
+            checkpoint_dir=root, checkpoint_every=1, prefetch=0
+        )
+        try:
+            elastic_run_stream(
+                factory, step, init, part, params,
+                fault_plan=FaultPlan(preempt_after_chunk=7),
+            )
+            raise RuntimeError("preemption never fired")
+        except SimulatedPreemption:
+            t_kill = time.perf_counter()
+        first_fold.clear()
+        elastic_run_stream(
+            factory, step, init, part,
+            ElasticParams(
+                checkpoint_dir=root, checkpoint_every=1, prefetch=0,
+                resume=True,
+            ),
+        )
+    _emit(
+        f"elastic resume kill-to-first-fold (world=1, {n}x{d})",
+        first_fold[0] - t_kill,
+        "s",
+        1.0,
+        table,
+        contention=None,  # single wall-clock interval, not pooled
+    )
+
+
 _FINAL: dict | None = None
 _FINAL_PRINTED = False
 
@@ -1182,6 +1255,10 @@ def main() -> None:
         # Telemetry ratios ride with the never-captured rows: cheap, and
         # they certify the observability layer on real hardware.
         ("telemetry", 60, lambda: bench_telemetry(on_tpu, table)),
+        # Elastic resume latency rides with them: the round-7
+        # fault-tolerance measurement (docs/fault_tolerance.md), world=1
+        # dry-run scale so it costs seconds, not minutes.
+        ("elastic resume", 30, lambda: bench_elastic_resume(on_tpu, table)),
         ("streaming SVD", 150, lambda: bench_streaming_svd(on_tpu, table)),
         ("sparse CWT", 150, lambda: bench_sparse_cwt(on_tpu, table)),
         ("QRFT", 90, lambda: bench_qrft(on_tpu, table)),

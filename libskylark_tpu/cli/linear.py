@@ -53,6 +53,23 @@ def main(argv=None) -> int:
                         "shared root of per-host state, and --resume "
                         "replays only each rank's uncheckpointed "
                         "batches)")
+    p.add_argument("--resume-policy", default="strict",
+                   choices=["strict", "repartition"],
+                   help="with --distributed --resume: 'strict' demands "
+                        "the same world size as the interrupted run "
+                        "(exit on mismatch, code 109); 'repartition' "
+                        "replans — every rank merges the completed "
+                        "partial-sketch checkpoints it is assigned and "
+                        "re-folds only the batches no host finished, so "
+                        "a 4-host run can resume on 2 hosts (or 2 on 4)")
+    p.add_argument("--collective-timeout", type=float, default=None,
+                   metavar="SECONDS",
+                   help="with --distributed: deadline for cross-host "
+                        "collectives (handshake, psum merge); a hung or "
+                        "straggling peer raises CollectiveTimeoutError "
+                        "(code 110) naming the stragglers instead of "
+                        "hanging forever (default: no deadline, or "
+                        "SKYLARK_COLLECTIVE_TIMEOUT_S)")
     add_perf_args(p)
     add_telemetry_args(p)
     args = p.parse_args(argv)
@@ -128,7 +145,13 @@ def _stream_main(args) -> int:
     from ..core.context import SketchContext
     from ..io import scan_libsvm_dims, stream_libsvm
     from ..linalg import streaming_least_squares
-    from ..streaming import RowPartition, StreamParams, skip_batches, world_info
+    from ..streaming import (
+        ElasticParams,
+        RowPartition,
+        StreamParams,
+        skip_batches,
+        world_info,
+    )
 
     nrows, ncols = scan_libsvm_dims(args.inputfile)
     print(f"Streaming {nrows}x{ncols} in batches of {args.batch_rows} rows")
@@ -140,20 +163,32 @@ def _stream_main(args) -> int:
         )
         return skip_batches(it, start) if start else it
 
-    sp = StreamParams(
-        checkpoint_dir=args.checkpoint_dir,
-        checkpoint_every=args.checkpoint_every,
-        resume=args.resume,
-    )
     partition = None
     if args.distributed:
+        # The elastic face carries the world knobs the plain stream
+        # lacks: resume_policy decides strict-vs-repartition, the
+        # collective timeout bounds the merge.
+        sp = ElasticParams(
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            resume=args.resume,
+            resume_policy=args.resume_policy,
+            collective_timeout_s=args.collective_timeout,
+        )
         rank, world = world_info()
         partition = RowPartition(
             nrows=nrows, batch_rows=args.batch_rows, world_size=world
         )
         b0, b1 = partition.batch_range(rank)
         print(f"Distributed stream: rank {rank}/{world} owns batches "
-              f"[{b0}, {b1}) of {partition.num_batches}")
+              f"[{b0}, {b1}) of {partition.num_batches} "
+              f"(resume policy: {args.resume_policy})")
+    else:
+        sp = StreamParams(
+            checkpoint_dir=args.checkpoint_dir,
+            checkpoint_every=args.checkpoint_every,
+            resume=args.resume,
+        )
     t0 = time.perf_counter()
     x, info = streaming_least_squares(
         batches, nrows, ncols, SketchContext(seed=args.seed),

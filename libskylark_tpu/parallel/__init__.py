@@ -12,6 +12,8 @@ invariant must be enforced by hand.
 """
 
 from .collectives import (
+    HEARTBEAT_DIR,
+    CollectiveWatchdog,
     ShardedBCOO,
     columnwise_sharded,
     cross_host_psum,
@@ -55,6 +57,8 @@ __all__ = [
     "row_sharding",
     "constrain_rows",
     "cross_host_psum",
+    "CollectiveWatchdog",
+    "HEARTBEAT_DIR",
     "rowwise_sharded",
     "columnwise_sharded",
     "rowwise_sharded_sparse",

@@ -10,7 +10,15 @@ notes the MPI fail-stop model; this subsystem is the TPU-production answer).
 """
 
 from .chunked import ChunkedSolver
-from .faults import FaultPlan, SimulatedPreemption, corrupt_checkpoint, with_retries
+from .faults import (
+    FaultPlan,
+    HostFaultPlan,
+    SimulatedPreemption,
+    corrupt_checkpoint,
+    corrupt_manifest,
+    tear_ledger_tail,
+    with_retries,
+)
 from .runner import ResilientParams, ResilientRunner
 
 __all__ = [
@@ -18,7 +26,10 @@ __all__ = [
     "ResilientParams",
     "ResilientRunner",
     "FaultPlan",
+    "HostFaultPlan",
     "SimulatedPreemption",
     "corrupt_checkpoint",
+    "corrupt_manifest",
+    "tear_ledger_tail",
     "with_retries",
 ]

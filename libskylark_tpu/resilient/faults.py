@@ -26,7 +26,10 @@ from dataclasses import dataclass, field
 __all__ = [
     "SimulatedPreemption",
     "FaultPlan",
+    "HostFaultPlan",
     "corrupt_checkpoint",
+    "corrupt_manifest",
+    "tear_ledger_tail",
     "with_retries",
 ]
 
@@ -53,6 +56,28 @@ def corrupt_checkpoint(path, nbytes: int = 64, offset: int | None = None):
         chunk = f.read(nbytes)
         f.seek(offset)
         f.write(bytes(b ^ 0xFF for b in chunk))
+
+
+def corrupt_manifest(host_directory) -> str:
+    """Flip the bytes of a host's elastic ``manifest.json`` in place —
+    the corrupt-at-rest / hostile-host scenario.  The repartition
+    scanner must treat the host as uncertifiable (its coverage is
+    dropped and its batches re-fold) instead of trusting its stores.
+    Returns the manifest path."""
+    path = os.path.join(str(host_directory), "manifest.json")
+    with open(path, "r+b") as f:
+        data = f.read()
+        f.seek(0)
+        f.write(bytes(b ^ 0xFF for b in data))
+    return path
+
+
+def tear_ledger_tail(ledger_path) -> None:
+    """Append a torn (half-written, unterminated) record to a host's
+    ``progress.jsonl`` — what a SIGKILL mid-``write`` leaves behind.
+    ``read_progress`` must skip it without losing the intact prefix."""
+    with open(str(ledger_path), "a", encoding="utf-8") as f:
+        f.write('{"ts": 0.0, "seq": 99999, "kind": "elas')
 
 
 def with_retries(
@@ -182,3 +207,109 @@ class FaultPlan:
             # the CERTIFICATION path has to catch it.
             return SA.at[1:].set(0.0) if SA.shape[0] > 1 else SA * 0.0
         return SA
+
+
+@dataclass
+class HostFaultPlan(FaultPlan):
+    """Host-level chaos schedule for the elastic streaming layer — the
+    failure modes of a *machine*, not a computation.  The elastic engine
+    binds the plan to this rank's on-disk state (:meth:`bind_host`) and
+    consults :meth:`before_batch` before folding each LOCAL batch, so
+    every scenario is deterministic and driveable from a child process
+    (``tests/_elastic_child.py``) via environment variables:
+
+    - ``die_at_batch``: **rank death** — SIGKILL this process (a real
+      kill, not an exception) just before folding local batch k.  With
+      ``torn_ledger=True`` a half-written ledger record is appended
+      first, modeling a kill mid-``write``.
+    - ``die_after_commit``: rank death right after chunk k's checkpoint
+      commits — the survivor-visible state is exactly k+1 chunks.
+    - ``slow_at_batch`` / ``slow_seconds``: **straggler** — sleep before
+      folding local batch k (drives peers into their collective
+      deadline → ``CollectiveTimeoutError``).
+    - ``corrupt_manifest_at``: **hostile host** — flip every byte of our
+      own ``manifest.json`` before folding local batch k; a later
+      repartition must drop this host's coverage, not trust it.
+    - ``bump_epoch_at``: **stale-epoch writer** — advance the shared
+      root's epoch marker before folding local batch k, simulating the
+      rest of the world repartitioning while this host lags.  The
+      host's own next ledger record then raises ``StaleEpochError``.
+
+    Inherits every :class:`FaultPlan` knob (chunk-boundary preemption,
+    transient IO errors, guard-layer numerical faults), so host chaos
+    composes with the existing injection points.
+    """
+
+    die_at_batch: int | None = None
+    die_after_commit: int | None = None
+    torn_ledger: bool = False
+    slow_at_batch: int | None = None
+    slow_seconds: float = 0.0
+    corrupt_manifest_at: int | None = None
+    bump_epoch_at: int | None = None
+    host_dir: str | None = None
+    root: str | None = None
+    epoch: int = 0
+    sleep: object = time.sleep  # injectable for tests
+
+    def bind_host(self, *, hdir: str, root: str, epoch: int = 0) -> None:
+        """Called by the elastic engine once the rank's host directory
+        is known — the file-targeting faults need paths to aim at."""
+        self.host_dir = str(hdir)
+        self.root = str(root)
+        self.epoch = int(epoch)
+
+    def _suicide(self) -> None:
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def before_batch(self, index: int) -> None:
+        if self._fire("slow_batch", self.slow_at_batch, index):
+            self.sleep(float(self.slow_seconds))
+        if (
+            self._fire("corrupt_manifest", self.corrupt_manifest_at, index)
+            and self.host_dir
+        ):
+            try:
+                corrupt_manifest(self.host_dir)
+            except OSError:
+                pass
+        if self._fire("bump_epoch", self.bump_epoch_at, index) and self.root:
+            from ..streaming.elastic import RowPartition
+            from ..streaming.repartition import read_epoch, write_epoch
+
+            est = read_epoch(self.root)
+            cur = int(est["epoch"]) if est else int(self.epoch)
+            write_epoch(
+                self.root,
+                epoch=cur + 1,
+                partition=RowPartition(
+                    nrows=1, batch_rows=1, world_size=1
+                ),
+                kind=(est or {}).get("kind", "chaos"),
+            )
+        if self.die_at_batch is not None and index == self.die_at_batch:
+            if self.torn_ledger and self.host_dir:
+                try:
+                    tear_ledger_tail(
+                        os.path.join(self.host_dir, "progress.jsonl")
+                    )
+                except OSError:
+                    pass
+            self._suicide()
+
+    def after_commit(self, chunk: int) -> None:
+        if (
+            self.die_after_commit is not None
+            and chunk == self.die_after_commit
+        ):
+            if self.torn_ledger and self.host_dir:
+                try:
+                    tear_ledger_tail(
+                        os.path.join(self.host_dir, "progress.jsonl")
+                    )
+                except OSError:
+                    pass
+            self._suicide()
+        super().after_commit(chunk)

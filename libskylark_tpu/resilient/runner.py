@@ -47,6 +47,9 @@ class ResilientParams(Params):
     io_backoff: float = 0.05
     check_divergence: bool = True
     max_chunks: int | None = None  # backstop against non-terminating solvers
+    # Elastic resumes pin restores to one repartition epoch: a slot written
+    # under any other epoch raises StaleEpochError (111) instead of loading.
+    expect_epoch: int | None = None
 
 
 def _residual_of(state):
@@ -115,7 +118,9 @@ class ResilientRunner:
         # Two-phase: load flat leaves first so the solver-kind check runs
         # BEFORE any structural validation — "wrong solver" beats
         # "wrong leaf count" as a diagnosis.
-        loaded = self.store.load_latest()
+        loaded = self.store.load_latest(
+            expect_epoch=self.params.expect_epoch
+        )
         if loaded is None:
             return state
         leaves, meta, step = loaded

@@ -34,6 +34,13 @@ from .elastic import (
 )
 from .engine import StreamParams, as_block_factory, run_stream, skip_batches
 from .pipeline import Prefetcher, PrefetchStats, device_placer
+from .repartition import (
+    ResumePlan,
+    execute_rank_plan,
+    read_epoch,
+    replan_resume,
+    resolve_resume,
+)
 
 __all__ = [
     "sketch",
@@ -56,4 +63,9 @@ __all__ = [
     "elastic_run_stream",
     "distributed_sketch",
     "distributed_sketch_least_squares",
+    "ResumePlan",
+    "replan_resume",
+    "resolve_resume",
+    "execute_rank_plan",
+    "read_epoch",
 ]

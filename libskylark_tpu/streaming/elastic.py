@@ -34,6 +34,23 @@ ways — the on-disk manifest check and a pre-fold allgather handshake of
 ``(world, partition signature, epoch, kind)`` — and fails fast with
 :class:`~libskylark_tpu.utils.exceptions.WorldMismatchError` (code 109)
 instead of silently merging stale partials.
+
+Elastic-resize layer (``resume_policy="repartition"``): instead of the
+109 fail-fast, the drivers hand the mismatched root to
+``streaming.repartition`` — durable partial-sketch checkpoints from the
+old world are adopted as-is (linearity of the counter-addressed sum)
+and only the never-committed batches re-fold, under a bumped **epoch**
+that fences the old world's stragglers out
+(:class:`~libskylark_tpu.utils.exceptions.StaleEpochError`, code 111).
+Epoch ``e > 0`` state lives under ``epoch-<e:04d>/host-<rank:05d>/``;
+the bare layout is epoch 0, so pre-repartition roots read unchanged.
+Collectives are deadline-bounded by
+:class:`~libskylark_tpu.parallel.collectives.CollectiveWatchdog` when
+``collective_timeout_s`` (or ``SKYLARK_COLLECTIVE_TIMEOUT_S``) is set —
+a hung peer raises
+:class:`~libskylark_tpu.utils.exceptions.CollectiveTimeoutError` (code
+110) with heartbeat-derived straggler evidence instead of blocking the
+world forever.  See ``docs/distributed_streaming.md``.
 """
 
 from __future__ import annotations
@@ -48,7 +65,11 @@ from itertools import islice
 import numpy as np
 
 from .. import guard, telemetry
-from ..utils.exceptions import InvalidParameters, WorldMismatchError
+from ..utils.exceptions import (
+    InvalidParameters,
+    StaleEpochError,
+    WorldMismatchError,
+)
 from .engine import StreamParams, as_block_factory, run_stream
 
 __all__ = [
@@ -178,6 +199,15 @@ class ElasticParams(StreamParams):
     simulated rank's local fold — manifest, ledger and partition checks
     included — inside one process.  ``checkpoint_dir`` is the SHARED
     root; each rank derives its private ``host-<rank:05d>/`` under it.
+
+    ``resume_policy`` decides what a resume does when the on-disk state
+    was written for a DIFFERENT world/partition: ``"strict"`` (default)
+    fails fast with code 109 exactly as before; ``"repartition"`` adopts
+    the old world's durable partials and re-folds only the uncommitted
+    batches (``streaming.repartition``).  ``collective_timeout_s``
+    deadline-bounds the handshake and merge collectives (code 110 on
+    expiry; ``None`` = blocking, env ``SKYLARK_COLLECTIVE_TIMEOUT_S``
+    applies when unset).
     """
 
     def __init__(
@@ -185,11 +215,20 @@ class ElasticParams(StreamParams):
         *,
         rank: int | None = None,
         world_size: int | None = None,
+        resume_policy: str = "strict",
+        collective_timeout_s: float | None = None,
         **kw,
     ):
         super().__init__(**kw)
         self.rank = rank
         self.world_size = world_size
+        if resume_policy not in ("strict", "repartition"):
+            raise InvalidParameters(
+                f"resume_policy must be 'strict' or 'repartition', got "
+                f"{resume_policy!r}"
+            )
+        self.resume_policy = resume_policy
+        self.collective_timeout_s = collective_timeout_s
 
 
 def _resolve_world(params) -> tuple[int, int]:
@@ -202,9 +241,18 @@ def _resolve_world(params) -> tuple[int, int]:
     )
 
 
-def host_dir(root, rank: int) -> str:
-    """The per-host state directory under the shared checkpoint root."""
-    return os.path.join(str(root), f"host-{int(rank):05d}")
+def host_dir(root, rank: int, epoch: int = 0) -> str:
+    """The per-host state directory under the shared checkpoint root.
+
+    Epoch 0 keeps the bare pre-repartition layout (``host-<rank>/``
+    directly under the root); repartitioned epochs namespace their state
+    under ``epoch-<e:04d>/`` so a new world never overwrites the old
+    world's durable partials while it is still merging them.
+    """
+    base = str(root)
+    if int(epoch) > 0:
+        base = os.path.join(base, f"epoch-{int(epoch):04d}")
+    return os.path.join(base, f"host-{int(rank):05d}")
 
 
 class HostLedger:
@@ -216,18 +264,27 @@ class HostLedger:
     batches this incarnation folded (at most one torn trailing line,
     which :func:`read_progress` skips).  ``seq`` continues from the
     existing file so restart records stay totally ordered per host.
+
+    ``fence`` (optional zero-arg callable) runs before every record —
+    the elastic layer passes the epoch fence, so a writer from a world
+    that has since repartitioned dies with
+    :class:`~libskylark_tpu.utils.exceptions.StaleEpochError` at its
+    next ledger write instead of silently mutating superseded state.
     """
 
-    def __init__(self, path, *, rank: int, epoch: int = 0):
+    def __init__(self, path, *, rank: int, epoch: int = 0, fence=None):
         self.path = str(path)
         self.rank = int(rank)
         self.epoch = int(epoch)
+        self.fence = fence
         self._seq = 0
         for rec in read_progress(self.path):
             self._seq = max(self._seq, int(rec.get("seq", 0)))
         self._fh = open(self.path, "a", encoding="utf-8")
 
     def record(self, name: str, **attrs) -> int:
+        if self.fence is not None:
+            self.fence()
         self._seq += 1
         rec = {
             "ts": round(time.time(), 6),
@@ -250,18 +307,39 @@ class HostLedger:
 
 def read_progress(path) -> list[dict]:
     """Parse a ``progress.jsonl`` — tolerant of the torn trailing line a
-    SIGKILL mid-write can leave.  Missing file → ``[]``."""
-    out = []
+    SIGKILL mid-write can leave.  Missing file → ``[]``.
+
+    Hardened against duplicate / out-of-order ``seq`` entries (a crash
+    during a guard replay can append the same batch record twice, and a
+    hostile host can interleave epochs): records are deduplicated by
+    ``(epoch, seq)`` — keeping the LAST occurrence, the rewrite wins —
+    and returned ordered by ``(epoch, seq)``.  Records without a usable
+    ``seq`` are kept in file order after the sequenced ones.
+    """
+    sequenced: dict[tuple[int, int], dict] = {}
+    stray = []
     try:
-        with open(path, encoding="utf-8") as fh:
+        # errors="replace": a torn tail can end mid-UTF-8-sequence; the
+        # mangled line must fail json.loads (and be skipped), not abort
+        # the whole read with UnicodeDecodeError.
+        with open(path, encoding="utf-8", errors="replace") as fh:
             for line in fh:
                 try:
-                    out.append(json.loads(line))
+                    rec = json.loads(line)
                 except json.JSONDecodeError:
                     continue
+                if not isinstance(rec, dict):
+                    continue
+                try:
+                    attrs = rec.get("attrs") or {}
+                    key = (int(attrs.get("epoch", 0)), int(rec["seq"]))
+                except (AttributeError, KeyError, TypeError, ValueError):
+                    stray.append(rec)
+                    continue
+                sequenced[key] = rec
     except OSError:
         pass
-    return out
+    return [sequenced[k] for k in sorted(sequenced)] + stray
 
 
 def _manifest_payload(partition, rank, kind, epoch) -> dict:
@@ -291,7 +369,9 @@ def _check_manifest(hdir, partition, rank, kind, epoch, resume) -> None:
         try:
             with open(path, encoding="utf-8") as fh:
                 have = json.load(fh)
-        except (OSError, json.JSONDecodeError) as e:
+        except (OSError, UnicodeDecodeError, json.JSONDecodeError) as e:
+            # UnicodeDecodeError: corrupt-at-rest manifests are arbitrary
+            # bytes, which fail at decode before json.load sees them.
             raise WorldMismatchError(
                 f"unreadable elastic manifest {path}: {e}; the host "
                 "directory cannot be certified against this partition",
@@ -317,7 +397,61 @@ def _check_manifest(hdir, partition, rank, kind, epoch, resume) -> None:
     os.replace(tmp, path)
 
 
-def _handshake(partition, rank, world, kind, epoch) -> None:
+def _epoch_fence(root, epoch: int):
+    """A zero-arg callable that raises
+    :class:`~libskylark_tpu.utils.exceptions.StaleEpochError` (111) when
+    the shared root's epoch marker has advanced past ``epoch`` — i.e.
+    the world repartitioned without this process.  Installed on the host
+    ledger (checked before every record, which precedes every commit) so
+    a stale writer dies before it can mutate superseded state."""
+    from .repartition import read_epoch
+
+    root = str(root)
+    epoch = int(epoch)
+
+    def fence():
+        est = read_epoch(root)
+        if est is not None and int(est.get("epoch", 0)) > epoch:
+            if telemetry.enabled():
+                telemetry.inc("elastic.fenced")
+                telemetry.event(
+                    "elastic", "fenced",
+                    {"epoch": epoch, "root_epoch": int(est["epoch"])},
+                )
+            raise StaleEpochError(
+                f"this writer runs at elastic epoch {epoch} but the "
+                f"shared root advanced to epoch {est.get('epoch')}: the "
+                "world repartitioned past this process; its partials "
+                "are superseded and must not be written",
+                expected=epoch,
+                got=int(est.get("epoch", 0)),
+            )
+
+    return fence
+
+
+def _make_watchdog(params, root, rank, world, epoch):
+    """Build the collective watchdog for a real multi-process world (a
+    single process has no peers to wait on — and must not pay file
+    writes the pre-watchdog code never made)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return None
+    from ..parallel.collectives import CollectiveWatchdog
+
+    return CollectiveWatchdog(
+        root,
+        rank=rank,
+        world=world,
+        epoch=epoch,
+        deadline_s=getattr(params, "collective_timeout_s", None),
+    )
+
+
+def _handshake(
+    partition, rank, world, kind, epoch, extra: int = 0, watchdog=None
+) -> None:
     """Barrier/epoch handshake: every live process allgathers its
     ``(world, partition signature, epoch, kind crc)`` tuple and checks
     the others'.  A drifted rank (stale restart script, wrong epoch,
@@ -327,6 +461,12 @@ def _handshake(partition, rank, world, kind, epoch) -> None:
 
     Single-process worlds (including simulated-rank tests) skip the
     collective — there is nobody to disagree with.
+
+    ``extra`` folds one more world-deterministic word into the gathered
+    tuple (the repartition path passes the plan CRC, so ranks that
+    somehow derived different recovery plans fail here, before any
+    merge).  ``watchdog`` deadline-bounds the allgather — a peer that
+    never arrives raises code 110 instead of hanging the handshake.
     """
     import jax
 
@@ -340,12 +480,20 @@ def _handshake(partition, rank, world, kind, epoch) -> None:
             int(partition.signature()),
             int(epoch),
             zlib.crc32(str(kind).encode()),
+            int(extra) & 0xFFFFFFFF,
         ],
         np.int64,
     )
-    theirs = np.atleast_2d(
-        np.asarray(multihost_utils.process_allgather(mine))
-    )
+
+    def _gather():
+        return np.atleast_2d(
+            np.asarray(multihost_utils.process_allgather(mine))
+        )
+
+    if watchdog is not None:
+        theirs = watchdog.guard("handshake", _gather)
+    else:
+        theirs = _gather()
     for r in range(theirs.shape[0]):
         if not np.array_equal(theirs[r], mine):
             raise WorldMismatchError(
@@ -364,12 +512,14 @@ def _handshake(partition, rank, world, kind, epoch) -> None:
         )
 
 
-def _local_params(params, hdir) -> StreamParams:
+def _local_params(params, hdir, expect_epoch: int | None = None) -> StreamParams:
     """This rank's private view of the shared params: same knobs, but
-    checkpoints under the rank's host directory."""
+    checkpoints under the rank's host directory (and restores pinned to
+    the rank's elastic epoch when one is set)."""
     return StreamParams(
         prefetch=params.prefetch,
         placer=params.placer,
+        expect_epoch=expect_epoch,
         checkpoint_dir=hdir,
         checkpoint_every=params.checkpoint_every,
         keep_last=params.keep_last,
@@ -441,30 +591,42 @@ def elastic_run_stream(
         )
 
     ledger = None
+    fence = None
+    watchdog = None
     local_params = _local_params(params, None)
     if params.checkpoint_dir:
-        hdir = host_dir(params.checkpoint_dir, rank)
+        root = params.checkpoint_dir
+        fence = _epoch_fence(root, epoch)
+        fence()  # a stale incarnation dies before touching any state
+        hdir = host_dir(root, rank, epoch)
         _check_manifest(hdir, partition, rank, kind, epoch, params.resume)
-        local_params = _local_params(params, hdir)
+        local_params = _local_params(params, hdir, expect_epoch=epoch)
         ledger = HostLedger(
-            os.path.join(hdir, PROGRESS_NAME), rank=rank, epoch=epoch
+            os.path.join(hdir, PROGRESS_NAME), rank=rank, epoch=epoch,
+            fence=fence,
         )
+        watchdog = _make_watchdog(params, root, rank, world, epoch)
+        if fault_plan is not None and hasattr(fault_plan, "bind_host"):
+            fault_plan.bind_host(hdir=hdir, root=str(root), epoch=epoch)
 
+    host_hooks = fault_plan is not None and hasattr(fault_plan, "before_batch")
     step = step_fn
-    if ledger is not None:
+    if ledger is not None or host_hooks:
         last = {"b": -1}
 
         def step(acc, block, b):
+            if host_hooks:
+                fault_plan.before_batch(b)
             out = step_fn(acc, block, b)
             # Ledgered at FOLD time (not at prefetch), once per index:
             # a guard replay re-folds the same indices and must not
             # double-count the batch.
-            if b > last["b"]:
+            if ledger is not None and b > last["b"]:
                 ledger.record("batch", batch=int(start_b + b), local=int(b))
                 last["b"] = b
             return out
 
-    _handshake(partition, rank, world, kind, epoch)
+    _handshake(partition, rank, world, kind, epoch, watchdog=watchdog)
     if telemetry.enabled():
         r0, r1 = partition.row_range(rank)
         telemetry.inc("elastic.runs")
@@ -544,10 +706,13 @@ def distributed_sketch(
     partition.validate_world(rank, world)
     r0, r1 = partition.row_range(rank)
     dt = _result_dtype(dtype)
-    init = {
-        "sa": jnp.zeros((S.s, int(ncols)), dt),
-        "row": np.asarray(r0, np.int64),
-    }
+    kind = "distributed_streaming_sketch"
+
+    def init_at(row0: int):
+        return {
+            "sa": jnp.zeros((S.s, int(ncols)), dt),
+            "row": np.asarray(row0, np.int64),
+        }
 
     def step(acc, block, index):
         row = int(acc["row"])
@@ -557,19 +722,40 @@ def distributed_sketch(
             "row": np.asarray(row + k, np.int64),
         }
 
-    report = guard.RecoveryReport(stage="distributed_streaming_sketch")
-    acc, nbatches = elastic_run_stream(
-        source, step, init, partition, params,
-        kind="distributed_streaming_sketch", fault_plan=fault_plan,
-        report=report, epoch=epoch,
-    )
-    rows = int(acc["row"])
-    if rows != r1:
-        raise ValueError(
-            f"rank {rank} folded rows [{r0}, {rows}) but its partition "
-            f"share is [{r0}, {r1}); the source and partition disagree"
+    report = guard.RecoveryReport(stage=kind)
+    plan = None
+    if getattr(params, "resume_policy", "strict") == "repartition":
+        from .repartition import execute_rank_plan, resolve_resume
+
+        epoch, plan = resolve_resume(
+            params.checkpoint_dir, partition, kind=kind, params=params
         )
-    merged = cross_host_psum({"sa": acc["sa"]})
+    watchdog = (
+        _make_watchdog(params, params.checkpoint_dir, rank, world, epoch)
+        if params.checkpoint_dir
+        else None
+    )
+    if plan is not None:
+        partial, _replay = execute_rank_plan(
+            plan, source, params=params, root=params.checkpoint_dir,
+            init_at=init_at, step_fn=step, kind=kind,
+            fault_plan=fault_plan, report=report,
+        )
+        partial = {"sa": jnp.asarray(partial["sa"])}
+    else:
+        acc, nbatches = elastic_run_stream(
+            source, step, init_at(r0), partition, params,
+            kind=kind, fault_plan=fault_plan, report=report, epoch=epoch,
+        )
+        rows = int(acc["row"])
+        if rows != r1:
+            raise ValueError(
+                f"rank {rank} folded rows [{r0}, {rows}) but its "
+                f"partition share is [{r0}, {r1}); the source and "
+                "partition disagree"
+            )
+        partial = {"sa": acc["sa"]}
+    merged = cross_host_psum(partial, watchdog=watchdog)
     out = S.finalize_slices(jnp.asarray(merged["sa"]), Dimension.COLUMNWISE)
     if guard.enabled():
         guard.check_finite(out, "distributed_streaming_sketch",
@@ -627,11 +813,14 @@ def distributed_sketch_least_squares(
     partition.validate_world(rank, world)
     r0, r1 = partition.row_range(rank)
     dt = _result_dtype(dtype)
-    init = {
-        "sa": jnp.zeros((S.s, int(ncols)), dt),
-        "sb": jnp.zeros((S.s, int(targets)), dt),
-        "row": np.asarray(r0, np.int64),
-    }
+    kind = "distributed_streaming_lsq"
+
+    def init_at(row0: int):
+        return {
+            "sa": jnp.zeros((S.s, int(ncols)), dt),
+            "sb": jnp.zeros((S.s, int(targets)), dt),
+            "row": np.asarray(row0, np.int64),
+        }
 
     def step(acc, batch, index):
         A_b, b_b = batch
@@ -645,22 +834,48 @@ def distributed_sketch_least_squares(
 
     guarded = guard.enabled()
     report = (
-        guard.RecoveryReport(stage="distributed_streaming_lsq")
+        guard.RecoveryReport(stage=kind)
         if guarded
-        else guard.RecoveryReport.disabled("distributed_streaming_lsq")
+        else guard.RecoveryReport.disabled(kind)
     )
-    acc, nbatches = elastic_run_stream(
-        source, step, init, partition, params,
-        kind="distributed_streaming_lsq", fault_plan=fault_plan,
-        report=report, epoch=epoch,
-    )
-    rows = int(acc["row"])
-    if rows != r1:
-        raise ValueError(
-            f"rank {rank} folded rows [{r0}, {rows}) but its partition "
-            f"share is [{r0}, {r1}); the source and partition disagree"
+    plan = None
+    replay = None
+    if getattr(params, "resume_policy", "strict") == "repartition":
+        from .repartition import execute_rank_plan, resolve_resume
+
+        epoch, plan = resolve_resume(
+            params.checkpoint_dir, partition, kind=kind, params=params
         )
-    merged = cross_host_psum({"sa": acc["sa"], "sb": acc["sb"]})
+    watchdog = (
+        _make_watchdog(params, params.checkpoint_dir, rank, world, epoch)
+        if params.checkpoint_dir
+        else None
+    )
+    if plan is not None:
+        partial, replay = execute_rank_plan(
+            plan, source, params=params, root=params.checkpoint_dir,
+            init_at=init_at, step_fn=step, kind=kind,
+            fault_plan=fault_plan, report=report,
+        )
+        nbatches = replay["replayed_batches"]
+        partial = {
+            "sa": jnp.asarray(partial["sa"]),
+            "sb": jnp.asarray(partial["sb"]),
+        }
+    else:
+        acc, nbatches = elastic_run_stream(
+            source, step, init_at(r0), partition, params,
+            kind=kind, fault_plan=fault_plan, report=report, epoch=epoch,
+        )
+        rows = int(acc["row"])
+        if rows != r1:
+            raise ValueError(
+                f"rank {rank} folded rows [{r0}, {rows}) but its "
+                f"partition share is [{r0}, {r1}); the source and "
+                "partition disagree"
+            )
+        partial = {"sa": acc["sa"], "sb": acc["sb"]}
+    merged = cross_host_psum(partial, watchdog=watchdog)
     SA = S.finalize_slices(jnp.asarray(merged["sa"]), Dimension.COLUMNWISE)
     SB = S.finalize_slices(jnp.asarray(merged["sb"]), Dimension.COLUMNWISE)
     if guarded:
@@ -674,7 +889,9 @@ def distributed_sketch_least_squares(
         )
         votes = cross_host_psum(
             np.asarray([0.0 if cert.ok else 1.0, float(local_replays)],
-                       np.float64)
+                       np.float64),
+            watchdog=watchdog,
+            phase="verdict",
         )
         world_bad, world_replays = int(votes[0]), int(votes[1])
         report.record(
@@ -706,6 +923,11 @@ def distributed_sketch_least_squares(
         "world_size": int(partition.world_size),
         "rank": int(rank),
         "recovery": report.to_dict(),
+        # None on the normal path; a repartitioned resume reports the
+        # plan-global accounting (identical on every rank): which batch
+        # ranges were re-folded, how many durable refs merged, the
+        # epoch transition — "only the dead hosts' batches replayed".
+        "replay": replay,
     }
-    telemetry.run_summary("distributed_streaming_lsq", info)
+    telemetry.run_summary(kind, info)
     return x, info

@@ -1,0 +1,774 @@
+"""Repartition-on-resume: remap ledgered elastic progress onto a new world.
+
+PR 6's elastic layer fails fast (``WorldMismatchError``, code 109) when a
+stream is resumed under a different world size or row partition — safe,
+but it discards every host's durable partial sketch and restarts the job
+from batch 0.  Because columnwise ``S·A`` is a pure SUM of counter-
+addressed window applies (``apply_slice`` with global row offsets), a
+partial sketch checkpoint covering global batches ``[s, e)`` is valid
+under ANY partition: linearity lets a new world adopt the old world's
+durable partials wholesale and re-fold only what was never committed.
+
+The flow (``resume_policy="repartition"``):
+
+1. :func:`replan_resume` scans the shared checkpoint root WITHOUT
+   communication: every ``host-*/`` manifest + ``progress.jsonl`` of the
+   current epoch (or the persisted plan of an already-repartitioned
+   epoch) is read, kind/signature coherence is verified, and each host's
+   newest CRC-valid checkpoint slot becomes a **coverage ref** — a
+   global batch range ``[start, start+step)`` backed by a durable file.
+   Hosts with unreadable manifests or corrupt slots simply contribute no
+   coverage (their batches are re-folded); a readable manifest for a
+   DIFFERENT kind or a mix of partitions raises 109.
+2. The globally-completed set is the union of refs; the **residual** is
+   its complement in ``[0, num_batches)``.  A deterministic greedy
+   assignment (refs round-robin by start order; residual ranges split to
+   a per-rank quota and packed least-loaded-first, ties to the lowest
+   rank) maps both onto the new world — pure arithmetic on the scanned
+   state, so every rank computes the IDENTICAL plan independently.
+3. The plan and a root-level ``epoch.json`` marker are persisted with
+   canonical bytes (every rank writes the same content, so racing
+   ``os.replace`` is benign) and the epoch is bumped: stale writers from
+   the old world are fenced at their next ledger record
+   (:class:`~libskylark_tpu.utils.exceptions.StaleEpochError`, 111).
+4. :func:`execute_rank_plan` runs one rank's share: merge assigned refs
+   (exact-slot loads, CRC + epoch validated), re-fold assigned residual
+   segments through the ordinary checkpointable ``run_stream`` (each
+   segment has its own store under ``epoch-<e>/host-<rank>/seg-*``, so a
+   second kill mid-recovery resumes *the recovery*), and hand back the
+   float partial for the usual single ``cross_host_psum``.
+
+The merged result equals the uninterrupted new-world run's sum of the
+same window applies — exactly, up to floating-point reassociation of the
+commutative merge (bitwise when the summands are exactly representable,
+e.g. integer-valued data under a ±1-valued CountSketch).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from dataclasses import dataclass, field
+from itertools import islice
+
+import numpy as np
+
+from .. import telemetry
+from ..utils.checkpoint import CheckpointStore, load_solver_state
+from ..utils.exceptions import (
+    CheckpointError,
+    InvalidParameters,
+    StaleEpochError,
+    WorldMismatchError,
+)
+from .engine import as_block_factory, run_stream
+
+__all__ = [
+    "EPOCH_NAME",
+    "PlanRef",
+    "RankAssignment",
+    "ResumePlan",
+    "read_epoch",
+    "write_epoch",
+    "plan_path",
+    "load_plan",
+    "scan_coverage",
+    "replan_resume",
+    "resolve_resume",
+    "execute_rank_plan",
+    "merge_ranges",
+    "complement_ranges",
+]
+
+EPOCH_NAME = "epoch.json"
+_PLAN_VERSION = 1
+_EPOCH_VERSION = 1
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    """Canonical-bytes atomic write: every rank of a repartitioning world
+    writes the identical content, so concurrent ``os.replace`` races are
+    benign (last writer wins with the same bytes)."""
+    data = json.dumps(payload, sort_keys=True)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def read_epoch(root) -> dict | None:
+    """The root-level epoch marker, or ``None`` for a pre-repartition
+    (epoch 0) root.  Unreadable marker → ``None`` — the strict manifest
+    checks downstream still guard against merging mismatched state."""
+    try:
+        with open(os.path.join(str(root), EPOCH_NAME), encoding="utf-8") as fh:
+            d = json.load(fh)
+    except (OSError, UnicodeDecodeError, json.JSONDecodeError):
+        return None
+    if d.get("skylark_object_type") != "elastic_epoch":
+        return None
+    return d
+
+
+def write_epoch(root, *, epoch: int, partition, kind: str) -> None:
+    _atomic_write_json(
+        os.path.join(str(root), EPOCH_NAME),
+        {
+            "skylark_object_type": "elastic_epoch",
+            "format_version": _EPOCH_VERSION,
+            "epoch": int(epoch),
+            "kind": str(kind),
+            "partition": partition.to_json(),
+            "signature": int(partition.signature()),
+        },
+    )
+
+
+def current_epoch(root) -> int:
+    est = read_epoch(root)
+    return int(est["epoch"]) if est else 0
+
+
+def plan_path(root, epoch: int) -> str:
+    return os.path.join(str(root), f"plan-{int(epoch):04d}.json")
+
+
+def merge_ranges(ranges) -> list[tuple[int, int]]:
+    """Union of half-open int ranges, sorted and coalesced."""
+    out: list[list[int]] = []
+    for s, e in sorted((int(s), int(e)) for s, e in ranges if e > s):
+        if out and s <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([s, e])
+    return [(s, e) for s, e in out]
+
+
+def complement_ranges(ranges, total: int) -> list[tuple[int, int]]:
+    """Complement of (merged) ``ranges`` within ``[0, total)``."""
+    out = []
+    pos = 0
+    for s, e in merge_ranges(ranges):
+        if s > pos:
+            out.append((pos, s))
+        pos = max(pos, e)
+    if pos < total:
+        out.append((pos, total))
+    return out
+
+
+@dataclass(frozen=True)
+class PlanRef:
+    """A durable partial-sketch checkpoint covering global batches
+    ``[start, end)``.  ``directory`` is the store directory RELATIVE to
+    the shared root; ``step`` pins the exact slot (refs never chase a
+    store's newest slot — the plan is a frozen snapshot)."""
+
+    directory: str
+    step: int
+    start: int
+    end: int
+    epoch: int
+
+    def to_json(self) -> dict:
+        return {
+            "dir": self.directory,
+            "step": int(self.step),
+            "start": int(self.start),
+            "end": int(self.end),
+            "epoch": int(self.epoch),
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "PlanRef":
+        return cls(
+            directory=d["dir"], step=int(d["step"]), start=int(d["start"]),
+            end=int(d["end"]), epoch=int(d["epoch"]),
+        )
+
+
+@dataclass
+class RankAssignment:
+    refs: list = field(default_factory=list)
+    segments: list = field(default_factory=list)  # [(start, end)) to re-fold
+
+    def to_json(self) -> dict:
+        return {
+            "refs": [r.to_json() for r in self.refs],
+            "segments": [[int(s), int(e)] for s, e in self.segments],
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "RankAssignment":
+        return cls(
+            refs=[PlanRef.from_json(r) for r in d.get("refs", [])],
+            segments=[(int(s), int(e)) for s, e in d.get("segments", [])],
+        )
+
+
+@dataclass
+class ResumePlan:
+    """The world-deterministic repartition plan: what every rank of the
+    NEW world merges and re-folds.  Serialized to ``plan-<epoch>.json``
+    under the root so chained resizes (and a kill during recovery) can
+    re-derive coverage without rescanning superseded layouts."""
+
+    kind: str
+    source_epoch: int
+    epoch: int
+    partition: object  # RowPartition of the NEW world
+    old_partition: dict | None
+    assignments: dict  # rank -> RankAssignment
+    completed: list  # merged [(s, e)) durable at plan time
+    residual: list  # merged [(s, e)) to re-fold
+    lost_hosts: list = field(default_factory=list)
+
+    def to_json(self) -> dict:
+        return {
+            "skylark_object_type": "elastic_resume_plan",
+            "format_version": _PLAN_VERSION,
+            "kind": self.kind,
+            "source_epoch": int(self.source_epoch),
+            "epoch": int(self.epoch),
+            "partition": self.partition.to_json(),
+            "signature": int(self.partition.signature()),
+            "old_partition": self.old_partition,
+            "assignments": {
+                str(r): a.to_json() for r, a in sorted(self.assignments.items())
+            },
+            "completed": [[int(s), int(e)] for s, e in self.completed],
+            "residual": [[int(s), int(e)] for s, e in self.residual],
+            "lost_hosts": [int(r) for r in self.lost_hosts],
+        }
+
+    @classmethod
+    def from_json(cls, d: dict) -> "ResumePlan":
+        from .elastic import RowPartition
+
+        if d.get("skylark_object_type") != "elastic_resume_plan":
+            raise CheckpointError(
+                f"not an elastic resume plan: {d.get('skylark_object_type')!r}"
+            )
+        return cls(
+            kind=d["kind"],
+            source_epoch=int(d["source_epoch"]),
+            epoch=int(d["epoch"]),
+            partition=RowPartition.from_json(d["partition"]),
+            old_partition=d.get("old_partition"),
+            assignments={
+                int(r): RankAssignment.from_json(a)
+                for r, a in d.get("assignments", {}).items()
+            },
+            completed=[(int(s), int(e)) for s, e in d.get("completed", [])],
+            residual=[(int(s), int(e)) for s, e in d.get("residual", [])],
+            lost_hosts=[int(r) for r in d.get("lost_hosts", [])],
+        )
+
+    def signature(self) -> int:
+        """CRC32 of the canonical plan bytes — carried in the resume
+        handshake so ranks that somehow derived different plans fail
+        fast instead of merging mismatched recoveries."""
+        return zlib.crc32(json.dumps(self.to_json(), sort_keys=True).encode())
+
+    def replay_info(self) -> dict:
+        """World-deterministic ``info["replay"]`` accounting: identical
+        on every rank because it is pure plan arithmetic."""
+        return {
+            "epoch": int(self.epoch),
+            "source_epoch": int(self.source_epoch),
+            "from_world": (
+                int(self.old_partition["world_size"])
+                if self.old_partition
+                else None
+            ),
+            "to_world": int(self.partition.world_size),
+            "completed_batches": sum(e - s for s, e in self.completed),
+            "replayed_batches": sum(e - s for s, e in self.residual),
+            "replayed": [[int(s), int(e)] for s, e in self.residual],
+            "merged_refs": sum(
+                len(a.refs) for a in self.assignments.values()
+            ),
+            "lost_hosts": [int(r) for r in self.lost_hosts],
+        }
+
+
+def load_plan(root, epoch: int) -> ResumePlan | None:
+    try:
+        with open(plan_path(root, epoch), encoding="utf-8") as fh:
+            return ResumePlan.from_json(json.load(fh))
+    except (OSError, UnicodeDecodeError, json.JSONDecodeError):
+        return None
+
+
+def _newest_valid_step(directory: str) -> tuple[int, int] | None:
+    """``(step, epoch)`` of the newest CRC-valid slot of a store, or
+    ``None``.  Loads (and discards) the leaves — validity of a coverage
+    ref means its bytes check out NOW, not that a file merely exists."""
+    if not os.path.isdir(directory):
+        return None
+    store = CheckpointStore(directory)
+    try:
+        loaded = store.load_latest()
+    except CheckpointError:
+        return None
+    if loaded is None:
+        return None
+    _, meta, step = loaded
+    return int(step), CheckpointStore.slot_epoch(meta)
+
+
+def scan_coverage(root, *, kind: str) -> dict:
+    """Scan the shared root's CURRENT epoch without communication.
+
+    Returns ``{"epoch", "old_partition" (dict | None), "refs"
+    (list[PlanRef], durable coverage), "lost_hosts" (ranks whose state
+    could not be certified and contributes nothing)}``.  Raises
+    :class:`WorldMismatchError` when readable state belongs to a
+    different ``kind`` or mixes partitions — repartitioning across jobs
+    would merge unrelated sketches.
+    """
+    from .elastic import MANIFEST_NAME, host_dir
+
+    root = str(root)
+    epoch = current_epoch(root)
+    refs: list[PlanRef] = []
+    lost: list[int] = []
+
+    if epoch > 0:
+        plan = load_plan(root, epoch)
+        if plan is None:
+            raise WorldMismatchError(
+                f"epoch marker at {root} names epoch {epoch} but "
+                f"{plan_path(root, epoch)} is missing/unreadable; the "
+                "root's repartition history cannot be certified",
+                expected=epoch,
+                got=None,
+            )
+        if plan.kind != str(kind):
+            raise WorldMismatchError(
+                f"checkpoint root {root} holds a "
+                f"{plan.kind!r} stream, refusing to repartition it into "
+                f"a {kind!r} resume",
+                expected=plan.kind,
+                got=str(kind),
+            )
+        # Inherited refs: re-validate each (corrupt-at-rest since the
+        # last plan → its range degrades to residual).
+        for rank, asg in sorted(plan.assignments.items()):
+            for ref in asg.refs:
+                slot = os.path.join(
+                    root, ref.directory, f"ckpt-{ref.step:012d}"
+                )
+                try:
+                    load_solver_state(slot)
+                except CheckpointError:
+                    lost.append(rank)  # corrupt since planning: re-fold
+                    continue
+                refs.append(ref)
+            # Segment stores: whatever the recovery durably folded.
+            hdir = host_dir(root, rank, epoch)
+            for s, e in asg.segments:
+                seg = os.path.join(hdir, f"seg-{int(s):06d}")
+                probe = _newest_valid_step(seg)
+                if probe is None:
+                    continue
+                step, slot_epoch = probe
+                if slot_epoch != epoch or step <= 0:
+                    continue
+                refs.append(
+                    PlanRef(
+                        directory=os.path.relpath(seg, root),
+                        step=min(step, e - s),
+                        start=s,
+                        end=s + min(step, e - s),
+                        epoch=epoch,
+                    )
+                )
+        return {
+            "epoch": epoch,
+            "old_partition": plan.partition.to_json(),
+            "refs": refs,
+            "lost_hosts": sorted(set(lost)),
+        }
+
+    # Epoch 0: bare host-<rank>/ dirs written by plain elastic runs.
+    old_partition = None
+    old_signature = None
+    hosts = []
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        names = []
+    for name in names:
+        if not name.startswith("host-"):
+            continue
+        try:
+            rank = int(name.split("-", 1)[1])
+        except ValueError:
+            continue
+        hosts.append((rank, os.path.join(root, name)))
+    for rank, hdir in hosts:
+        mpath = os.path.join(hdir, MANIFEST_NAME)
+        try:
+            with open(mpath, encoding="utf-8") as fh:
+                manifest = json.load(fh)
+        except (OSError, UnicodeDecodeError, json.JSONDecodeError):
+            # Hostile/corrupt host: certify nothing, re-fold its range.
+            lost.append(rank)
+            continue
+        if manifest.get("kind") != str(kind):
+            raise WorldMismatchError(
+                f"host state {hdir} belongs to kind "
+                f"{manifest.get('kind')!r}, refusing to repartition into "
+                f"a {kind!r} resume",
+                expected=manifest.get("kind"),
+                got=str(kind),
+            )
+        if int(manifest.get("epoch", 0)) != 0:
+            lost.append(rank)
+            continue
+        sig = manifest.get("signature")
+        if old_signature is None:
+            old_signature, old_partition = sig, manifest.get("partition")
+        elif sig != old_signature:
+            raise WorldMismatchError(
+                f"host manifests under {root} mix partitions "
+                f"(signatures {old_signature} and {sig}); the root "
+                "cannot be repartitioned coherently",
+                expected=old_signature,
+                got=sig,
+            )
+        probe = _newest_valid_step(hdir)
+        if probe is None:
+            lost.append(rank)
+            continue
+        step, slot_epoch = probe
+        if slot_epoch != 0 or step <= 0:
+            lost.append(rank)
+            continue
+        part = manifest.get("partition") or {}
+        try:
+            from .elastic import RowPartition
+
+            start_b, end_b = RowPartition.from_json(part).batch_range(rank)
+        except (KeyError, TypeError, InvalidParameters):
+            lost.append(rank)
+            continue
+        covered = min(step, end_b - start_b)
+        if covered > 0:
+            refs.append(
+                PlanRef(
+                    directory=os.path.relpath(hdir, root),
+                    step=covered,
+                    start=start_b,
+                    end=start_b + covered,
+                    epoch=0,
+                )
+            )
+    return {
+        "epoch": 0,
+        "old_partition": old_partition,
+        "refs": refs,
+        "lost_hosts": sorted(set(lost)),
+    }
+
+
+def _assign(refs, residual, world: int) -> dict:
+    """Deterministic greedy assignment: pure arithmetic on the scanned
+    state, so every rank derives the identical plan with no
+    communication.  Refs (cheap merges) go round-robin in start order;
+    residual ranges (real re-folds) are split to a per-rank quota and
+    packed onto the least-loaded rank, ties to the lowest rank."""
+    assignments = {r: RankAssignment() for r in range(world)}
+    for i, ref in enumerate(sorted(refs, key=lambda r: (r.start, r.directory))):
+        assignments[i % world].refs.append(ref)
+    total = sum(e - s for s, e in residual)
+    if total:
+        quota = -(-total // world)
+        load = [0] * world
+        for s, e in residual:
+            while s < e:
+                piece = min(e - s, quota)
+                rank = min(range(world), key=lambda r: (load[r], r))
+                assignments[rank].segments.append((s, s + piece))
+                load[rank] += piece
+                s += piece
+        for asg in assignments.values():
+            asg.segments.sort()
+    return assignments
+
+
+def replan_resume(root, new_partition, *, kind: str) -> ResumePlan:
+    """Compute (and persist) the repartition plan that adopts the current
+    epoch's durable coverage under ``new_partition``, then bump the
+    root's epoch marker to fence stale writers.  Deterministic: every
+    rank calling this against the same root state writes byte-identical
+    ``plan-<epoch>.json`` / ``epoch.json``."""
+    scan = scan_coverage(root, kind=kind)
+    source_epoch = int(scan["epoch"])
+    new_epoch = source_epoch + 1
+    nb = new_partition.num_batches
+    refs = [r for r in scan["refs"] if r.start < r.end]
+    completed = merge_ranges((r.start, r.end) for r in refs)
+    if any(e > nb for _, e in completed):
+        raise WorldMismatchError(
+            f"durable coverage reaches batch "
+            f"{max(e for _, e in completed)} but the new partition has "
+            f"only {nb} batches; nrows/batch_rows changed, not just the "
+            "world size — restart from scratch",
+            expected=nb,
+            got=max(e for _, e in completed),
+        )
+    residual = complement_ranges(completed, nb)
+    plan = ResumePlan(
+        kind=str(kind),
+        source_epoch=source_epoch,
+        epoch=new_epoch,
+        partition=new_partition,
+        old_partition=scan["old_partition"],
+        assignments=_assign(refs, residual, new_partition.world_size),
+        completed=completed,
+        residual=residual,
+        lost_hosts=scan["lost_hosts"],
+    )
+    _atomic_write_json(plan_path(root, new_epoch), plan.to_json())
+    write_epoch(root, epoch=new_epoch, partition=new_partition, kind=kind)
+    if telemetry.enabled():
+        telemetry.inc("elastic.replans")
+        telemetry.event("elastic", "replan", plan.replay_info())
+    return plan
+
+
+def resolve_resume(root, partition, *, kind: str, params) -> tuple:
+    """Decide this resume's ``(epoch, plan)``.
+
+    ``resume_policy="strict"`` (the default) pins ``(0, None)``: the
+    pre-repartition behavior — bare ``host-*/`` layout, manifest checks,
+    code 109 on any world change — bit-for-bit.
+
+    ``"repartition"`` (with ``resume=True`` and a checkpoint root):
+
+    - fresh root → ``(0, None)``;
+    - disk partition == ours → normal resume at the disk epoch
+      (re-executing the persisted plan idempotently when that epoch was
+      itself a repartition);
+    - disk partition differs → :func:`replan_resume` at a bumped epoch.
+    """
+    policy = getattr(params, "resume_policy", "strict") or "strict"
+    if policy not in ("strict", "repartition"):
+        raise InvalidParameters(
+            f"resume_policy must be 'strict' or 'repartition', got "
+            f"{policy!r}"
+        )
+    if policy == "strict" or not root or not getattr(params, "resume", False):
+        return 0, None
+    est = read_epoch(root)
+    ours = int(partition.signature())
+    if est is not None:
+        if est.get("kind") != str(kind):
+            raise WorldMismatchError(
+                f"checkpoint root {root} holds a {est.get('kind')!r} "
+                f"stream, this resume is {kind!r}",
+                expected=est.get("kind"),
+                got=str(kind),
+            )
+        epoch = int(est["epoch"])
+        if int(est.get("signature", -1)) == ours:
+            plan = load_plan(root, epoch)
+            return epoch, plan  # idempotent re-execution (or plain resume)
+        return epoch + 1, replan_resume(root, partition, kind=kind)
+    # Epoch-0 root: repartition only when the on-disk manifests disagree
+    # with our partition; matching manifests resume the normal way.
+    scan_needed = False
+    from .elastic import MANIFEST_NAME
+
+    try:
+        names = sorted(os.listdir(str(root)))
+    except OSError:
+        names = []
+    for name in names:
+        if not name.startswith("host-"):
+            continue
+        mpath = os.path.join(str(root), name, MANIFEST_NAME)
+        try:
+            with open(mpath, encoding="utf-8") as fh:
+                manifest = json.load(fh)
+        except (OSError, UnicodeDecodeError, json.JSONDecodeError):
+            scan_needed = True  # uncertifiable host: replan around it
+            continue
+        if manifest.get("signature") != ours or manifest.get("kind") != str(
+            kind
+        ):
+            scan_needed = True
+    if not scan_needed:
+        return 0, None
+    return 1, replan_resume(root, partition, kind=kind)
+
+
+def _add_float_leaves(total: dict | None, acc: dict) -> dict:
+    """Running sum of a driver accumulator's float leaves (the int
+    bookkeeping cursor — ``"row"`` — is partition-relative and
+    meaningless across plan pieces, so it is dropped)."""
+    floats = {
+        k: np.asarray(v)
+        for k, v in acc.items()
+        if np.issubdtype(np.asarray(v).dtype, np.floating)
+    }
+    if total is None:
+        return floats
+    if set(total) != set(floats):
+        raise CheckpointError(
+            f"plan pieces disagree on accumulator leaves: {sorted(total)} "
+            f"vs {sorted(floats)}"
+        )
+    return {k: total[k] + floats[k] for k in total}
+
+
+def execute_rank_plan(
+    plan: ResumePlan,
+    source,
+    *,
+    params,
+    root,
+    init_at,
+    step_fn,
+    kind: str,
+    fault_plan=None,
+    report=None,
+):
+    """Run THIS rank's share of ``plan``; returns ``(float_partial,
+    replay_info)`` ready for the usual single ``cross_host_psum``.
+
+    ``init_at(row0)`` builds the driver accumulator with its row cursor
+    at global row ``row0`` (the same closure shape the drivers already
+    use); ``step_fn`` is the unchanged driver fold.  Residual segments
+    run through the ordinary checkpointable ``run_stream`` with a
+    per-segment store under this rank's NEW-epoch host directory, so a
+    preemption during recovery resumes the recovery.
+    """
+    from .elastic import (
+        PROGRESS_NAME,
+        HostLedger,
+        _check_manifest,
+        _epoch_fence,
+        _handshake,
+        _local_params,
+        _make_watchdog,
+        _resolve_world,
+        host_dir,
+    )
+
+    rank, world = _resolve_world(params)
+    plan.partition.validate_world(rank, world)
+    batch_rows = plan.partition.batch_rows
+    epoch = int(plan.epoch)
+    root = str(root)
+    hdir = host_dir(root, rank, epoch)
+    _check_manifest(hdir, plan.partition, rank, kind, epoch, True)
+    fence = _epoch_fence(root, epoch)
+    ledger = HostLedger(
+        os.path.join(hdir, PROGRESS_NAME), rank=rank, epoch=epoch,
+        fence=fence,
+    )
+    watchdog = _make_watchdog(params, root, rank, world, epoch)
+    _handshake(
+        plan.partition, rank, world, kind, epoch,
+        extra=plan.signature(), watchdog=watchdog,
+    )
+    if fault_plan is not None and hasattr(fault_plan, "bind_host"):
+        fault_plan.bind_host(hdir=hdir, root=root, epoch=epoch)
+    asg = plan.assignments.get(rank, RankAssignment())
+    proto = {"batch": np.asarray(0, np.int64), "acc": init_at(0)}
+    total = None
+
+    for ref in asg.refs:
+        slot = os.path.join(root, ref.directory, f"ckpt-{ref.step:012d}")
+        state, meta = load_solver_state(slot, like=proto)
+        slot_epoch = CheckpointStore.slot_epoch(meta)
+        if slot_epoch != ref.epoch:
+            raise StaleEpochError(
+                f"plan ref {slot} was written at epoch {slot_epoch}, the "
+                f"plan recorded epoch {ref.epoch}; the store was mutated "
+                "since planning — replan",
+                expected=ref.epoch,
+                got=slot_epoch,
+            )
+        folded = int(state["batch"])
+        if folded != ref.end - ref.start:
+            raise CheckpointError(
+                f"plan ref {slot} holds {folded} folded batches but "
+                f"covers [{ref.start}, {ref.end}); the store was mutated "
+                "since planning — replan"
+            )
+        total = _add_float_leaves(total, state["acc"])
+        ledger.record(
+            "merge_ref", start=int(ref.start), end=int(ref.end),
+            source=ref.directory, source_epoch=int(ref.epoch),
+        )
+        if telemetry.enabled():
+            telemetry.inc("elastic.ref_merges")
+
+    global_factory = as_block_factory(source)
+    for s, e in asg.segments:
+        seg_dir = os.path.join(hdir, f"seg-{int(s):06d}")
+        local_params = _local_params(params, seg_dir, expect_epoch=epoch)
+        local_params.resume = True  # a killed recovery resumes itself
+
+        def seg_factory(local_start: int, s=s, e=e):
+            if not 0 <= local_start <= e - s:
+                raise ValueError(
+                    f"segment start {local_start} outside [0, {e - s}]"
+                )
+            return islice(
+                iter(global_factory(s + local_start)), e - s - local_start
+            )
+
+        last = {"b": -1}
+
+        def seg_step(acc, block, b, s=s, last=last):
+            fence()
+            if fault_plan is not None and hasattr(fault_plan, "before_batch"):
+                fault_plan.before_batch(b)
+            out = step_fn(acc, block, b)
+            if b > last["b"]:
+                ledger.record("batch", batch=int(s + b), local=int(b))
+                last["b"] = b
+            return out
+
+        meta = {
+            "elastic": {
+                "rank": rank, "world": world, "epoch": epoch,
+                "signature": int(plan.partition.signature()),
+                "segment": [int(s), int(e)],
+            }
+        }
+        acc, nb = run_stream(
+            seg_factory, seg_step, init_at(s * batch_rows), local_params,
+            kind=kind, metadata=meta, fault_plan=fault_plan, report=report,
+        )
+        if nb != e - s:
+            raise ValueError(
+                f"rank {rank} re-folded {nb} batches of segment "
+                f"[{s}, {e}); the source and partition disagree"
+            )
+        total = _add_float_leaves(total, acc)
+        ledger.record("segment_done", start=int(s), end=int(e))
+
+    if total is None:
+        # A rank with no assignment still contributes (zeros) to the
+        # psum — build them from the prototype.
+        total = _add_float_leaves(None, init_at(0))
+        total = {k: np.zeros_like(v) for k, v in total.items()}
+    ledger.record(
+        "replayed",
+        segments=[[int(s), int(e)] for s, e in asg.segments],
+        refs=len(asg.refs),
+    )
+    ledger.close()
+    info = plan.replay_info()
+    if telemetry.enabled():
+        telemetry.event("elastic", "repartition_done", info)
+    return total, info

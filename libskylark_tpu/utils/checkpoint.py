@@ -32,7 +32,7 @@ import zlib
 import jax
 import numpy as np
 
-from .exceptions import CheckpointError
+from .exceptions import CheckpointError, StaleEpochError
 
 __all__ = [
     "save_solver_state",
@@ -223,10 +223,29 @@ class CheckpointStore:
                 pass  # pruning is best-effort; a leftover slot is harmless
         return slot + ".npz"
 
-    def load_latest(self, like=None):
+    @staticmethod
+    def slot_epoch(metadata: dict) -> int:
+        """The elastic epoch a slot was written under.  Elastic runs stamp
+        it at ``metadata["elastic"]["epoch"]``; a bare ``"epoch"`` key is
+        honored too; slots that predate epochs are epoch 0."""
+        elastic = metadata.get("elastic")
+        if isinstance(elastic, dict) and "epoch" in elastic:
+            return int(elastic["epoch"])
+        return int(metadata.get("epoch", 0))
+
+    def load_latest(self, like=None, expect_epoch: int | None = None):
         """Returns ``(state, metadata, step)`` from the newest valid slot,
         or ``None`` when no slot exists.  Raises :class:`CheckpointError`
-        only when every slot on disk fails validation."""
+        only when every slot on disk fails validation.
+
+        ``expect_epoch`` (elastic resumes) pins the slot to one epoch:
+        a structurally-valid newest slot whose recorded epoch differs
+        raises :class:`StaleEpochError` (code 111) IMMEDIATELY — it is
+        deliberately not a ``CheckpointError``, so the corrupt-slot
+        fallback below cannot swallow it and silently load an equally
+        stale older slot.  Corrupt slots still fall back: a stale-epoch
+        verdict needs a readable manifest to be trustworthy.
+        """
         steps = self.steps()
         if not steps:
             return None
@@ -234,9 +253,22 @@ class CheckpointStore:
         for step in reversed(steps):
             try:
                 state, meta = load_solver_state(self._slot(step), like=like)
-                return state, meta, step
             except CheckpointError as e:
                 errors.append(str(e))
+                continue
+            if expect_epoch is not None:
+                have = self.slot_epoch(meta)
+                if have != int(expect_epoch):
+                    raise StaleEpochError(
+                        f"checkpoint slot step {step} in {self.directory} "
+                        f"was written at elastic epoch {have}, this resume "
+                        f"runs at epoch {int(expect_epoch)}; the slot "
+                        "belongs to a superseded partition — replan "
+                        "instead of loading it",
+                        expected=int(expect_epoch),
+                        got=have,
+                    )
+            return state, meta, step
         raise CheckpointError(
             "no valid checkpoint among "
             f"{len(steps)} slot(s): " + "; ".join(errors)

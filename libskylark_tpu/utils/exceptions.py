@@ -17,6 +17,8 @@ __all__ = [
     "CheckpointError",
     "NumericalHealthError",
     "WorldMismatchError",
+    "CollectiveTimeoutError",
+    "StaleEpochError",
 ]
 
 
@@ -96,6 +98,44 @@ class WorldMismatchError(SkylarkError):
     (dicts or scalars, best-effort) for diagnostics."""
 
     code = 109
+
+    def __init__(self, msg, expected=None, got=None):
+        super().__init__(msg)
+        self.expected = expected
+        self.got = got
+
+
+class CollectiveTimeoutError(SkylarkError):
+    """A deadline-bounded collective (elastic handshake, cross-host psum)
+    did not complete within its configured deadline: at least one peer
+    never arrived — dead, hung, or stuck in device work.  Raised instead
+    of hanging the world forever so an orchestrator can kill the job and
+    resume with ``resume_policy="repartition"``.  ``phase`` names the
+    collective; ``deadline_s`` is the budget that expired; ``stragglers``
+    lists the ranks whose heartbeats never reached the phase (best-effort
+    — empty when no heartbeat directory was configured)."""
+
+    code = 110
+
+    def __init__(self, msg, phase=None, deadline_s=None, stragglers=None):
+        super().__init__(msg)
+        self.phase = phase
+        self.deadline_s = deadline_s
+        self.stragglers = stragglers
+
+
+class StaleEpochError(SkylarkError):
+    """This process is operating at an elastic epoch the world has moved
+    past: the shared root's epoch marker (or a peer's heartbeat, or a
+    checkpoint slot's manifest) carries a HIGHER epoch than this writer
+    was started with.  The process is fenced out — its partials belong
+    to a superseded partition and must not be merged or overwritten into
+    the new epoch's state.  Deliberately NOT a ``CheckpointError``: the
+    store's corrupt-slot fallback must not swallow it and silently load
+    an equally-stale older slot.  ``expected``/``got`` carry the two
+    epochs."""
+
+    code = 111
 
     def __init__(self, msg, expected=None, got=None):
         super().__init__(msg)

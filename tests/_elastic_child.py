@@ -13,12 +13,28 @@ psum merge makes ``x`` identical everywhere.  On success the rank saves
 ``x-<rank>.npy`` + ``info-<rank>.json`` into ``out_dir`` and prints
 ``ELASTIC-OK``.
 
-Fault injection (the kill-one-rank scenario): when
-``ELASTIC_KILL_RANK`` matches this rank, a ``FaultPlan`` subclass
-SIGKILLs the process right after checkpoint chunk
-``ELASTIC_KILL_AFTER_CHUNK`` commits — a real uncatchable death
-mid-stream, not an exception.  The parent restarts the world with
-``resume=1`` and checks bit-identity against an uninterrupted run.
+Fault injection, all driven by environment variables so the parent
+composes scenarios without new scripts:
+
+- ``ELASTIC_KILL_RANK`` / ``ELASTIC_KILL_AFTER_CHUNK``: SIGKILL that
+  rank right after the given checkpoint chunk commits — a real
+  uncatchable death mid-stream, not an exception.
+- ``ELASTIC_FAULT_RANK`` + ``ELASTIC_DIE_AT_BATCH`` /
+  ``ELASTIC_SLOW_AT_BATCH`` + ``ELASTIC_SLOW_SECONDS`` /
+  ``ELASTIC_TORN_LEDGER``: a :class:`HostFaultPlan` on that rank —
+  rank death before a batch (optionally tearing the ledger tail first)
+  or a straggler sleep that drives peers into their collective
+  deadline.
+- ``ELASTIC_RESUME_POLICY``: ``strict`` (default) or ``repartition`` —
+  the resumed world may be a DIFFERENT size than the interrupted one.
+- ``ELASTIC_COLLECTIVE_TIMEOUT_S``: deadline-bound the handshake and
+  psum merges; on timeout the rank prints ``ELASTIC-TIMEOUT`` with the
+  straggler list and exits with code 110 (111 for a stale epoch)
+  instead of hanging the parent.
+- ``ELASTIC_EXACT=1``: integer-valued data + a CWT sketch (±1 values),
+  so every fold is exact integer arithmetic in float64 and a
+  repartitioned resume must match an uninterrupted run at the NEW
+  world size bit-for-bit.
 """
 
 from __future__ import annotations
@@ -53,18 +69,32 @@ def main() -> int:
     import numpy as np
 
     from libskylark_tpu import SketchContext
-    from libskylark_tpu.resilient import FaultPlan
+    from libskylark_tpu.resilient import FaultPlan, HostFaultPlan
     from libskylark_tpu.sketch.dense import JLT
+    from libskylark_tpu.sketch.hash import CWT
     from libskylark_tpu.streaming import ElasticParams, RowPartition
     from libskylark_tpu.streaming.elastic import (
         distributed_sketch_least_squares,
+    )
+    from libskylark_tpu.utils.exceptions import (
+        CollectiveTimeoutError,
+        StaleEpochError,
     )
 
     # Deterministic synthetic problem — every rank (and every restart)
     # regenerates the identical stream.
     rng = np.random.default_rng(5)
-    A = rng.standard_normal((NROWS, NCOLS))
-    b = rng.standard_normal(NROWS)
+    exact = os.environ.get("ELASTIC_EXACT") == "1"
+    if exact:
+        # integer data + CWT: exact f64 sums, bitwise-stable under any
+        # summation regrouping (the repartition bit-identity lock)
+        A = rng.integers(-9, 10, size=(NROWS, NCOLS)).astype(np.float64)
+        b = rng.integers(-9, 10, size=NROWS).astype(np.float64)
+        S = CWT(NROWS, S_SIZE, SketchContext(seed=13))
+    else:
+        A = rng.standard_normal((NROWS, NCOLS))
+        b = rng.standard_normal(NROWS)
+        S = JLT(NROWS, S_SIZE, SketchContext(seed=13))
     blocks = [
         (jnp.asarray(A[lo : lo + BATCH_ROWS]),
          jnp.asarray(b[lo : lo + BATCH_ROWS]))
@@ -77,7 +107,6 @@ def main() -> int:
     part = RowPartition(
         nrows=NROWS, batch_rows=BATCH_ROWS, world_size=nprocs
     )
-    S = JLT(NROWS, S_SIZE, SketchContext(seed=13))
 
     kill_rank = int(os.environ.get("ELASTIC_KILL_RANK", "-1"))
     kill_after = int(os.environ.get("ELASTIC_KILL_AFTER_CHUNK", "-1"))
@@ -92,22 +121,57 @@ def main() -> int:
                 os.kill(os.getpid(), signal.SIGKILL)
 
     plan = KillPlan() if (proc_id == kill_rank and kill_after >= 0) else None
+    fault_rank = int(os.environ.get("ELASTIC_FAULT_RANK", "-1"))
+    if proc_id == fault_rank and plan is None:
+        host_knobs = {}
+        if os.environ.get("ELASTIC_DIE_AT_BATCH"):
+            host_knobs["die_at_batch"] = int(
+                os.environ["ELASTIC_DIE_AT_BATCH"]
+            )
+        if os.environ.get("ELASTIC_SLOW_AT_BATCH"):
+            host_knobs["slow_at_batch"] = int(
+                os.environ["ELASTIC_SLOW_AT_BATCH"]
+            )
+            host_knobs["slow_seconds"] = float(
+                os.environ.get("ELASTIC_SLOW_SECONDS", "0")
+            )
+        if os.environ.get("ELASTIC_TORN_LEDGER") == "1":
+            host_knobs["torn_ledger"] = True
+        if host_knobs:
+            plan = HostFaultPlan(**host_knobs)
+
+    timeout_env = os.environ.get("ELASTIC_COLLECTIVE_TIMEOUT_S")
     params = ElasticParams(
-        checkpoint_dir=root, checkpoint_every=1, resume=resume, prefetch=0
+        checkpoint_dir=root, checkpoint_every=1, resume=resume, prefetch=0,
+        resume_policy=os.environ.get("ELASTIC_RESUME_POLICY", "strict"),
+        collective_timeout_s=float(timeout_env) if timeout_env else None,
     )
-    x, info = distributed_sketch_least_squares(
-        factory, S, ncols=NCOLS, partition=part, params=params,
-        fault_plan=plan,
-    )
+    try:
+        x, info = distributed_sketch_least_squares(
+            factory, S, ncols=NCOLS, partition=part, params=params,
+            fault_plan=plan,
+        )
+    except CollectiveTimeoutError as e:
+        print(
+            f"ELASTIC-TIMEOUT phase={e.phase} "
+            f"stragglers={e.stragglers}",
+            flush=True,
+        )
+        # The blocked collective still owns a daemon thread inside the
+        # runtime; a clean interpreter shutdown would hang on it.
+        os._exit(110)
+    except StaleEpochError:
+        print("ELASTIC-STALE-EPOCH", flush=True)
+        os._exit(111)
     np.save(os.path.join(out_dir, f"x-{proc_id}.npy"), np.asarray(x))
+    keys = ("rows", "batches", "local_batches", "world_size", "rank")
+    dump = {k: info[k] for k in keys}
+    if info.get("replay") is not None:
+        dump["replay"] = info["replay"]
     with open(
         os.path.join(out_dir, f"info-{proc_id}.json"), "w", encoding="utf-8"
     ) as fh:
-        json.dump(
-            {k: info[k] for k in
-             ("rows", "batches", "local_batches", "world_size", "rank")},
-            fh,
-        )
+        json.dump(dump, fh)
     print("ELASTIC-OK", flush=True)
     jax.distributed.shutdown()
     return 0

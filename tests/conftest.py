@@ -42,6 +42,10 @@ TELEMETRY_TIMEOUT_S = 120
 # back-to-back (reference run, kill-one-rank run, resume run), each with
 # its own formation timeout — the alarm must cover the worst-case sum.
 DISTRIBUTED_STREAMING_TIMEOUT_S = 900
+# Host-level chaos tests (rank death, stragglers, stale-epoch writers,
+# repartitioned resumes) simulated in ONE process; real multi-process
+# chaos rides the distributed_streaming slow tier instead.
+CHAOS_TIMEOUT_S = 120
 
 _TIMEOUT_MARKS = {
     "faults": FAULTS_TIMEOUT_S,
@@ -49,6 +53,7 @@ _TIMEOUT_MARKS = {
     "guard": GUARD_TIMEOUT_S,
     "telemetry": TELEMETRY_TIMEOUT_S,
     "distributed_streaming": DISTRIBUTED_STREAMING_TIMEOUT_S,
+    "chaos": CHAOS_TIMEOUT_S,
 }
 
 
@@ -89,6 +94,12 @@ def pytest_configure(config):
         "(kill-one-rank resume over real jax.distributed worlds); slow "
         f"tier, guarded by a per-test {DISTRIBUTED_STREAMING_TIMEOUT_S}s "
         "timeout",
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: host-level chaos tests (rank death, stragglers, stale-"
+        "epoch fencing, repartition-on-resume) simulated in one process; "
+        f"tier-1, guarded by a per-test {CHAOS_TIMEOUT_S}s timeout",
     )
 
 

@@ -1,0 +1,600 @@
+"""Host-level chaos + repartition-on-resume tests (single-process tier).
+
+Every multi-host failure mode that can be simulated inside one process
+is exercised here: the repartition planner's range/assignment
+arithmetic, shrink (4→2) and grow (2→4) kill-and-resume through
+``replan_resume`` + ``execute_rank_plan``, the driver-level repartition
+resume (including ``info["replay"]`` accounting and the strict-policy
+code-109 guarantee), collective watchdog deadlines (code 110),
+stale-epoch fencing (code 111), checkpoint-slot epoch rejection, and
+the :class:`HostFaultPlan` chaos knobs themselves.  REAL multi-process
+chaos (rank SIGKILL, stragglers over a live ``jax.distributed`` world)
+lives in ``tests/test_distributed.py`` (slow tier).
+
+Bitwise assertions here are deliberate, not optimistic: the matrices
+are integer-valued and the sketches are CWT (±1 hash values), so every
+partial sum is exact integer arithmetic in float64 — associativity
+holds bitwise, and a repartitioned resume (different summation
+grouping!) must reproduce the uninterrupted run EXACTLY.
+"""
+
+import json
+import os
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libskylark_tpu import sketch as sk
+from libskylark_tpu import streaming
+from libskylark_tpu.core import SketchContext
+from libskylark_tpu.parallel import CollectiveWatchdog
+from libskylark_tpu.plans import accumulate_slice
+from libskylark_tpu.resilient import (
+    FaultPlan,
+    HostFaultPlan,
+    SimulatedPreemption,
+    corrupt_checkpoint,
+    corrupt_manifest,
+    tear_ledger_tail,
+)
+from libskylark_tpu.sketch.base import Dimension
+from libskylark_tpu.streaming import (
+    ElasticParams,
+    RowPartition,
+    elastic_run_stream,
+    host_dir,
+    read_epoch,
+    read_progress,
+    replan_resume,
+    skip_batches,
+)
+from libskylark_tpu.streaming.elastic import MANIFEST_NAME, PROGRESS_NAME
+from libskylark_tpu.streaming.repartition import (
+    PlanRef,
+    _assign,
+    complement_ranges,
+    load_plan,
+    merge_ranges,
+    scan_coverage,
+)
+from libskylark_tpu.utils.checkpoint import CheckpointStore
+from libskylark_tpu.utils.exceptions import (
+    CollectiveTimeoutError,
+    InvalidParameters,
+    StaleEpochError,
+    WorldMismatchError,
+)
+
+pytestmark = pytest.mark.chaos
+
+N, M, S_OUT = 60, 5, 16
+BATCH = 7  # 60/7 -> 9 batches, last one ragged (4 rows)
+KIND = "distributed_streaming_sketch"
+
+
+def int_matrix(rng, n=N, m=M):
+    """Integer-valued float64: with a CWT sketch (±1 values) every fold
+    is exact, so bitwise identity survives ANY summation regrouping."""
+    return jnp.asarray(rng.integers(-9, 10, size=(n, m)).astype(np.float64))
+
+
+def blocks_of(*arrays, batch=BATCH):
+    n = arrays[0].shape[0]
+    out = []
+    for lo in range(0, n, batch):
+        sl = tuple(a[lo : lo + batch] for a in arrays)
+        out.append(sl[0] if len(arrays) == 1 else sl)
+    return out
+
+
+def factory_of(*arrays, batch=BATCH):
+    def factory(start):
+        it = iter(blocks_of(*arrays, batch=batch))
+        return skip_batches(it, start) if start else it
+
+    return factory
+
+
+def make_cwt(seed=31):
+    return sk.CWT(N, S_OUT, SketchContext(seed=seed))
+
+
+def rank_fold(A, S, part, rank, root, *, fault_plan=None,
+              checkpoint_every=1):
+    """One simulated rank's elastic fold into the shared root (the
+    ``test_elastic.py`` idiom, CWT-exact here)."""
+    r0, _ = part.row_range(rank)
+    init = {
+        "sa": jnp.zeros((S.s, M), jnp.float64),
+        "row": np.asarray(r0, np.int64),
+    }
+
+    def step(acc, block, index):
+        row = int(acc["row"])
+        return {
+            "sa": accumulate_slice(S, acc["sa"], block, row),
+            "row": np.asarray(row + block.shape[0], np.int64),
+        }
+
+    params = ElasticParams(
+        rank=rank, world_size=part.world_size, checkpoint_dir=str(root),
+        checkpoint_every=checkpoint_every, prefetch=0,
+    )
+    return elastic_run_stream(
+        factory_of(A), step, init, part, params, kind=KIND,
+        fault_plan=fault_plan,
+    )
+
+
+def execute_all_ranks(plan, A, S, root, *, fault_plans=None):
+    """Run every rank's share of ``plan`` in-process and sum the
+    partials (the psum a real world would do)."""
+    world = plan.partition.world_size
+
+    def init_at(row0):
+        return {
+            "sa": jnp.zeros((S.s, M), jnp.float64),
+            "row": np.asarray(row0, np.int64),
+        }
+
+    def step(acc, block, index):
+        row = int(acc["row"])
+        return {
+            "sa": accumulate_slice(S, acc["sa"], block, row),
+            "row": np.asarray(row + block.shape[0], np.int64),
+        }
+
+    total, info = None, None
+    for rank in range(world):
+        params = ElasticParams(
+            rank=rank, world_size=world, checkpoint_dir=str(root),
+            checkpoint_every=1, prefetch=0,
+        )
+        partial, info = streaming.execute_rank_plan(
+            plan, factory_of(A), params=params, root=str(root),
+            init_at=init_at, step_fn=step, kind=KIND,
+            fault_plan=(fault_plans or {}).get(rank),
+        )
+        total = (
+            partial["sa"]
+            if total is None
+            else total + np.asarray(partial["sa"])
+        )
+    return np.asarray(total), info
+
+
+# ---------------------------------------------------------------------------
+# Plan arithmetic
+# ---------------------------------------------------------------------------
+
+
+class TestPlanArithmetic:
+    def test_merge_ranges_coalesces(self):
+        assert merge_ranges([(3, 5), (0, 2), (1, 3), (7, 7)]) == [(0, 5)]
+        assert merge_ranges([]) == []
+        assert merge_ranges([(2, 4), (6, 8)]) == [(2, 4), (6, 8)]
+
+    def test_complement_ranges(self):
+        assert complement_ranges([(2, 4), (6, 8)], 9) == [
+            (0, 2), (4, 6), (8, 9)
+        ]
+        assert complement_ranges([], 3) == [(0, 3)]
+        assert complement_ranges([(0, 3)], 3) == []
+
+    def test_assign_partitions_the_residual_exactly(self):
+        refs = [
+            PlanRef(directory=f"host-0000{i}/ckpt", step=2, start=2 * i,
+                    end=2 * i + 2, epoch=0)
+            for i in range(3)
+        ]
+        residual = [(6, 13)]
+        for world in (1, 2, 4):
+            a = _assign(refs, residual, world)
+            b = _assign(refs, residual, world)
+            # deterministic: same inputs, same plan — this is what lets
+            # every rank derive the plan without communication
+            assert {r: x.to_json() for r, x in a.items()} == {
+                r: x.to_json() for r, x in b.items()
+            }
+            got_refs = sorted(
+                (r.start, r.end) for x in a.values() for r in x.refs
+            )
+            assert got_refs == [(0, 2), (2, 4), (4, 6)]
+            segs = merge_ranges(
+                s for x in a.values() for s in x.segments
+            )
+            assert segs == [(6, 13)]
+            # quota-balanced: no rank re-folds more than ceil(total/world)
+            quota = -(-7 // world)
+            for x in a.values():
+                assert sum(e - s for s, e in x.segments) <= quota
+
+
+# ---------------------------------------------------------------------------
+# Repartitioned resumes: shrink, grow, corrupt hosts — all bitwise
+# ---------------------------------------------------------------------------
+
+
+class TestRepartitionResume:
+    def test_shrink_4_to_2_bitwise(self, rng, tmp_path):
+        A = int_matrix(rng)
+        S = make_cwt()
+        part4 = RowPartition(nrows=N, batch_rows=BATCH, world_size=4)
+        # ranks 0, 1 finish; rank 2 dies after ONE durable batch; rank 3
+        # never starts (dead host, no directory at all)
+        rank_fold(A, S, part4, 0, tmp_path)
+        rank_fold(A, S, part4, 1, tmp_path)
+        with pytest.raises(SimulatedPreemption):
+            rank_fold(A, S, part4, 2, tmp_path,
+                      fault_plan=FaultPlan(preempt_after_chunk=0))
+
+        part2 = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        plan = replan_resume(tmp_path, part2, kind=KIND)
+        # world-4 split of 9 batches: [0,3) [3,5) [5,7) [7,9); rank 2
+        # committed 1 of its 2 batches, rank 3 contributed nothing
+        assert plan.completed == [(0, 6)]
+        assert plan.residual == [(6, 9)]
+        total, info = execute_all_ranks(plan, A, S, tmp_path)
+        want = np.asarray(S.apply(A, Dimension.COLUMNWISE))
+        assert np.array_equal(total, want)
+        assert info["replayed"] == [[6, 9]]
+        assert info["replayed_batches"] == 3
+        assert info["from_world"] == 4 and info["to_world"] == 2
+        # the epoch marker now fences the old world out
+        assert read_epoch(tmp_path)["epoch"] == 1
+
+    def test_grow_2_to_4_bitwise(self, rng, tmp_path):
+        A = int_matrix(rng)
+        S = make_cwt()
+        part2 = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        rank_fold(A, S, part2, 0, tmp_path)  # [0, 5) complete
+        with pytest.raises(SimulatedPreemption):  # [5, 6) durable of [5, 9)
+            rank_fold(A, S, part2, 1, tmp_path,
+                      fault_plan=FaultPlan(preempt_after_chunk=0))
+
+        part4 = RowPartition(nrows=N, batch_rows=BATCH, world_size=4)
+        plan = replan_resume(tmp_path, part4, kind=KIND)
+        assert plan.completed == [(0, 6)]
+        assert plan.residual == [(6, 9)]
+        total, info = execute_all_ranks(plan, A, S, tmp_path)
+        want = np.asarray(S.apply(A, Dimension.COLUMNWISE))
+        assert np.array_equal(total, want)
+        assert info["replayed"] == [[6, 9]]
+        assert info["to_world"] == 4
+
+    def test_corrupt_manifest_host_is_dropped_and_refolded(self, rng,
+                                                           tmp_path):
+        A = int_matrix(rng)
+        S = make_cwt()
+        part2 = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        rank_fold(A, S, part2, 0, tmp_path)
+        rank_fold(A, S, part2, 1, tmp_path)  # finishes... then goes hostile
+        corrupt_manifest(host_dir(tmp_path, 1))
+
+        scan = scan_coverage(tmp_path, kind=KIND)
+        assert scan["lost_hosts"] == [1]
+        part1 = RowPartition(nrows=N, batch_rows=BATCH, world_size=1)
+        plan = replan_resume(tmp_path, part1, kind=KIND)
+        # the hostile host's WHOLE range re-folds: its stores are not
+        # trusted even though they exist
+        assert plan.completed == [(0, 5)]
+        assert plan.residual == [(5, 9)]
+        assert plan.lost_hosts == [1]
+        total, _ = execute_all_ranks(plan, A, S, tmp_path)
+        assert np.array_equal(
+            total, np.asarray(S.apply(A, Dimension.COLUMNWISE))
+        )
+
+    def test_plan_persists_and_reloads_identically(self, rng, tmp_path):
+        A = int_matrix(rng)
+        S = make_cwt()
+        part2 = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        rank_fold(A, S, part2, 0, tmp_path)
+        part1 = RowPartition(nrows=N, batch_rows=BATCH, world_size=1)
+        plan = replan_resume(tmp_path, part1, kind=KIND)
+        again = load_plan(tmp_path, plan.epoch)
+        assert again is not None
+        assert again.signature() == plan.signature()
+        assert again.to_json() == plan.to_json()
+
+    def test_nrows_change_is_not_a_repartition(self, rng, tmp_path):
+        # Coverage beyond the new partition's batch count means the
+        # PROBLEM changed, not just the world — typed 109, not garbage.
+        A = int_matrix(rng)
+        S = make_cwt()
+        part2 = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        rank_fold(A, S, part2, 0, tmp_path)
+        rank_fold(A, S, part2, 1, tmp_path)
+        smaller = RowPartition(nrows=N - 2 * BATCH, batch_rows=BATCH,
+                               world_size=2)
+        with pytest.raises(WorldMismatchError):
+            replan_resume(tmp_path, smaller, kind=KIND)
+
+
+# ---------------------------------------------------------------------------
+# Driver-level repartition: the user-facing resume path
+# ---------------------------------------------------------------------------
+
+
+class TestDriverRepartition:
+    def _seed_world2(self, rng, tmp_path):
+        """World-2 run with rank 1 killed after one durable batch."""
+        A = int_matrix(rng)
+        S = make_cwt()
+        part2 = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        rank_fold(A, S, part2, 0, tmp_path)
+        with pytest.raises(SimulatedPreemption):
+            rank_fold(A, S, part2, 1, tmp_path,
+                      fault_plan=FaultPlan(preempt_after_chunk=0))
+        return A, S
+
+    def test_shrink_to_world_1_matches_uninterrupted_bitwise(self, rng,
+                                                             tmp_path):
+        A, S = self._seed_world2(rng, tmp_path)
+        part1 = RowPartition(nrows=N, batch_rows=BATCH, world_size=1)
+        params = ElasticParams(
+            resume=True, resume_policy="repartition",
+            checkpoint_dir=str(tmp_path), checkpoint_every=1, prefetch=0,
+        )
+        got = streaming.sketch(
+            factory_of(A), S, "columnwise", ncols=M, partition=part1,
+            params=params,
+        )
+        want = streaming.sketch(factory_of(A), S, "columnwise", ncols=M)
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+    def test_resume_is_idempotent(self, rng, tmp_path):
+        # A second resume against the already-recovered root re-executes
+        # the persisted plan (segment stores are complete, so nothing
+        # re-folds) and lands on the identical bits.
+        A, S = self._seed_world2(rng, tmp_path)
+        part1 = RowPartition(nrows=N, batch_rows=BATCH, world_size=1)
+        params = ElasticParams(
+            resume=True, resume_policy="repartition",
+            checkpoint_dir=str(tmp_path), checkpoint_every=1, prefetch=0,
+        )
+        first = streaming.sketch(
+            factory_of(A), S, "columnwise", ncols=M, partition=part1,
+            params=params,
+        )
+        second = streaming.sketch(
+            factory_of(A), S, "columnwise", ncols=M, partition=part1,
+            params=params,
+        )
+        assert np.array_equal(np.asarray(first), np.asarray(second))
+        assert read_epoch(tmp_path)["epoch"] == 1  # no epoch churn
+
+    def test_strict_policy_preserves_code_109(self, rng, tmp_path):
+        # The acceptance lock: --resume-policy strict keeps today's
+        # fail-fast behavior bit-for-bit — a world change is code 109.
+        A, S = self._seed_world2(rng, tmp_path)
+        part1 = RowPartition(nrows=N, batch_rows=BATCH, world_size=1)
+        params = ElasticParams(
+            resume=True, resume_policy="strict",
+            checkpoint_dir=str(tmp_path), checkpoint_every=1, prefetch=0,
+        )
+        with pytest.raises(WorldMismatchError) as ei:
+            streaming.sketch(
+                factory_of(A), S, "columnwise", ncols=M, partition=part1,
+                params=params,
+            )
+        assert ei.value.code == 109
+
+    def test_least_squares_reports_replay(self, rng, tmp_path):
+        A = int_matrix(rng)
+        b = jnp.asarray(
+            rng.integers(-9, 10, size=(N, 1)).astype(np.float64)
+        )
+        S = make_cwt()
+        part2 = RowPartition(nrows=N, batch_rows=BATCH, world_size=2)
+        lsq_kind = "distributed_streaming_lsq"
+
+        def fold(rank, fault_plan=None):
+            r0, _ = part2.row_range(rank)
+            init = {
+                "sa": jnp.zeros((S.s, M), jnp.float64),
+                "sb": jnp.zeros((S.s, 1), jnp.float64),
+                "row": np.asarray(r0, np.int64),
+            }
+
+            def step(acc, block, index):
+                ab, bb = block
+                row = int(acc["row"])
+                return {
+                    "sa": accumulate_slice(S, acc["sa"], ab, row),
+                    "sb": accumulate_slice(S, acc["sb"], bb, row),
+                    "row": np.asarray(row + ab.shape[0], np.int64),
+                }
+
+            params = ElasticParams(
+                rank=rank, world_size=2, checkpoint_dir=str(tmp_path),
+                checkpoint_every=1, prefetch=0,
+            )
+            return elastic_run_stream(
+                factory_of(A, b), step, init, part2, params,
+                kind=lsq_kind, fault_plan=fault_plan,
+            )
+
+        fold(0)
+        with pytest.raises(SimulatedPreemption):
+            fold(1, fault_plan=FaultPlan(preempt_after_chunk=0))
+
+        part1 = RowPartition(nrows=N, batch_rows=BATCH, world_size=1)
+        params = ElasticParams(
+            resume=True, resume_policy="repartition",
+            checkpoint_dir=str(tmp_path), checkpoint_every=1, prefetch=0,
+        )
+        x, info = streaming.sketch_least_squares(
+            factory_of(A, b), S, ncols=M, partition=part1, params=params,
+        )
+        # only the dead rank's unledgered batches replay: rank 1 owned
+        # [5, 9) and committed [5, 6)
+        assert info["replay"]["replayed"] == [[6, 9]]
+        assert info["replay"]["completed_batches"] == 6
+        x2, info2 = streaming.sketch_least_squares(
+            factory_of(A, b), S, ncols=M,
+        )
+        assert np.array_equal(np.asarray(x), np.asarray(x2))
+        assert "replay" not in info2 or info2.get("replay") is None
+
+    def test_bogus_policy_rejected(self):
+        with pytest.raises(InvalidParameters):
+            ElasticParams(resume_policy="optimistic")
+
+
+# ---------------------------------------------------------------------------
+# Collective watchdog: deadline, stragglers, stale peers
+# ---------------------------------------------------------------------------
+
+
+class TestCollectiveWatchdog:
+    def test_timeout_names_stragglers(self, tmp_path):
+        wd = CollectiveWatchdog(tmp_path, rank=0, world=3, epoch=0,
+                                deadline_s=0.4, poll_s=0.05)
+        # peer 1 arrives at the phase; peer 2 never does
+        CollectiveWatchdog(tmp_path, rank=1, world=3).beat("psum")
+        with pytest.raises(CollectiveTimeoutError) as ei:
+            wd.guard("psum", lambda: time.sleep(30))
+        assert ei.value.code == 110
+        assert ei.value.phase == "psum"
+        assert ei.value.stragglers == [2]
+
+    def test_fast_collective_passes_through(self, tmp_path):
+        wd = CollectiveWatchdog(tmp_path, rank=0, world=2, epoch=0,
+                                deadline_s=5.0, poll_s=0.05)
+        assert wd.guard("psum", lambda: 41 + 1) == 42
+
+    def test_no_deadline_runs_inline(self, tmp_path):
+        wd = CollectiveWatchdog(tmp_path, rank=0, world=2, epoch=0)
+        assert wd.deadline_s is None
+        assert wd.guard("psum", lambda: "inline") == "inline"
+
+    def test_env_var_sets_deadline(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("SKYLARK_COLLECTIVE_TIMEOUT_S", "0.25")
+        wd = CollectiveWatchdog(tmp_path, rank=0, world=2)
+        assert wd.deadline_s == 0.25
+
+    def test_worker_exception_propagates(self, tmp_path):
+        wd = CollectiveWatchdog(tmp_path, rank=0, world=1, epoch=0,
+                                deadline_s=5.0, poll_s=0.05)
+
+        def boom():
+            raise ValueError("collective blew up")
+
+        with pytest.raises(ValueError, match="blew up"):
+            wd.guard("psum", boom)
+
+    def test_stale_peer_epoch_fences_immediately(self, tmp_path):
+        # A peer heartbeat from a HIGHER epoch means the world moved on:
+        # code 111 right away, not a wasted deadline wait.
+        CollectiveWatchdog(tmp_path, rank=1, world=2, epoch=3).beat("psum")
+        wd = CollectiveWatchdog(tmp_path, rank=0, world=2, epoch=0,
+                                deadline_s=30.0, poll_s=0.05)
+        with pytest.raises(StaleEpochError) as ei:
+            wd.guard("psum", lambda: time.sleep(30))
+        assert ei.value.code == 111
+
+
+# ---------------------------------------------------------------------------
+# Epoch fencing + checkpoint-slot epoch rejection
+# ---------------------------------------------------------------------------
+
+
+class TestEpochFencing:
+    def test_stale_writer_is_fenced_mid_stream(self, rng, tmp_path):
+        # HostFaultPlan bumps the root epoch marker mid-fold (the rest
+        # of the world repartitioned); this host's very next ledger
+        # record must die with code 111, before any commit.
+        A = int_matrix(rng)
+        S = make_cwt()
+        part1 = RowPartition(nrows=N, batch_rows=BATCH, world_size=1)
+        with pytest.raises(StaleEpochError) as ei:
+            rank_fold(A, S, part1, 0, tmp_path,
+                      fault_plan=HostFaultPlan(bump_epoch_at=2))
+        assert ei.value.code == 111
+        # batches 0 and 1 were ledgered before the fence tripped
+        recs = read_progress(
+            os.path.join(host_dir(tmp_path, 0), PROGRESS_NAME)
+        )
+        batches = [r["attrs"]["batch"] for r in recs
+                   if r["attrs"].get("batch") is not None]
+        assert batches == [0, 1]
+
+    def test_store_rejects_slot_from_other_epoch(self, tmp_path):
+        store = CheckpointStore(tmp_path)
+        state = {"x": np.arange(4.0)}
+        store.save(state, step=1, metadata={"elastic": {"epoch": 0}})
+        with pytest.raises(StaleEpochError) as ei:
+            store.load_latest(expect_epoch=1)
+        assert ei.value.code == 111
+        # ... while the matching epoch loads normally
+        got, meta, step = store.load_latest(
+            like={"x": np.zeros(4)}, expect_epoch=0
+        )
+        assert step == 1 and np.array_equal(got["x"], state["x"])
+
+    def test_corrupt_newest_slot_still_falls_back(self, tmp_path):
+        # The epoch check must not break the corrupt-slot fallback: a
+        # corrupt NEWEST slot is skipped (CheckpointError internally),
+        # and the epoch gate applies to the slot actually loaded.
+        store = CheckpointStore(tmp_path, keep_last=3)
+        store.save({"x": np.arange(4.0)}, step=1,
+                   metadata={"elastic": {"epoch": 1}})
+        newest = store.save({"x": np.arange(4.0) * 2}, step=2,
+                            metadata={"elastic": {"epoch": 1}})
+        corrupt_checkpoint(newest)
+        got, meta, step = store.load_latest(expect_epoch=1)
+        assert step == 1
+        with pytest.raises(StaleEpochError):
+            store.load_latest(expect_epoch=2)
+
+
+# ---------------------------------------------------------------------------
+# HostFaultPlan knobs (the in-process halves; SIGKILL is exercised in
+# the multi-process tier)
+# ---------------------------------------------------------------------------
+
+
+class TestHostFaultPlan:
+    def test_slow_rank_sleeps_once(self):
+        naps = []
+        hp = HostFaultPlan(slow_at_batch=1, slow_seconds=2.5,
+                           sleep=naps.append)
+        hp.before_batch(0)
+        assert naps == []
+        hp.before_batch(1)
+        hp.before_batch(1)  # one-shot: a guard replay doesn't re-sleep
+        assert naps == [2.5]
+
+    def test_corrupt_manifest_at_fires_on_bound_host(self, tmp_path):
+        hdir = tmp_path / "host-00000"
+        hdir.mkdir()
+        (hdir / MANIFEST_NAME).write_text(
+            json.dumps({"kind": "x"}), encoding="utf-8"
+        )
+        hp = HostFaultPlan(corrupt_manifest_at=0)
+        hp.bind_host(hdir=str(hdir), root=str(tmp_path), epoch=0)
+        hp.before_batch(0)
+        # flipped bytes are not UTF-8, let alone JSON
+        with pytest.raises(ValueError):
+            json.loads((hdir / MANIFEST_NAME).read_bytes().decode("utf-8"))
+
+    def test_torn_ledger_tail_keeps_intact_prefix(self, tmp_path):
+        path = tmp_path / PROGRESS_NAME
+        path.write_text(
+            '{"ts": 1.0, "seq": 1, "kind": "elastic",'
+            ' "attrs": {"rank": 0, "epoch": 0}}\n',
+            encoding="utf-8",
+        )
+        tear_ledger_tail(path)
+        recs = read_progress(path)
+        assert [r["seq"] for r in recs] == [1]
+
+    def test_bump_epoch_advances_the_root_marker(self, tmp_path):
+        hp = HostFaultPlan(bump_epoch_at=0)
+        hp.bind_host(hdir=str(tmp_path / "h"), root=str(tmp_path), epoch=0)
+        assert read_epoch(tmp_path) is None
+        hp.before_batch(0)
+        assert read_epoch(tmp_path)["epoch"] == 1

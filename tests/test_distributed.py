@@ -151,8 +151,9 @@ def _spawn_elastic(nprocs, port, root, out_dir, *, resume, extra_env=None):
     env.pop("JAX_PLATFORMS", None)  # child pins cpu itself
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["XLA_FLAGS"] = ""
-    env.pop("ELASTIC_KILL_RANK", None)
-    env.pop("ELASTIC_KILL_AFTER_CHUNK", None)
+    for key in list(env):
+        if key.startswith("ELASTIC_"):  # no fault knobs leak across runs
+            del env[key]
     if extra_env:
         env.update(extra_env)
     script = os.path.join(_REPO, "tests", "_elastic_child.py")
@@ -347,3 +348,199 @@ def test_elastic_kill_one_rank_resume(nprocs, tmp_path):
             assert folded == []
         done = [rec for rec in new if rec["name"] == "done"]
         assert len(done) == 1 and done[0]["attrs"]["batches"] == nlocal
+
+
+# ---------------------------------------------------------------------------
+# repartition-on-resume: kill a rank, resume at a DIFFERENT world size
+# ---------------------------------------------------------------------------
+
+
+def _run_world_with_casualty(nprocs, root, out_dir, *, kill_rank,
+                             kill_after, extra_env=None):
+    """Run a world with one rank SIGKILLed mid-stream; wait for the
+    survivors to finish their LOCAL folds (ledger ``done``), then put
+    them down too.  Leaves the shared root exactly as a real preemption
+    would: survivors fully checkpointed, the victim partially."""
+    import time
+
+    from libskylark_tpu.streaming import host_dir, read_progress
+    from libskylark_tpu.streaming.elastic import PROGRESS_NAME
+
+    env = {
+        "ELASTIC_KILL_RANK": str(kill_rank),
+        "ELASTIC_KILL_AFTER_CHUNK": str(kill_after),
+    }
+    env.update(extra_env or {})
+    procs = _spawn_elastic(
+        nprocs, _free_port(), root, out_dir, resume=False, extra_env=env
+    )
+    try:
+        rc = procs[kill_rank].wait(timeout=_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.skip(
+            f"{nprocs}-process kill run did not start within {_TIMEOUT_S}s"
+        )
+    if rc != -9:  # died before the injected SIGKILL: env problem
+        _, err = procs[kill_rank].communicate()
+        for p in procs:
+            p.kill()
+            p.communicate()
+        if any(m in err for m in _SKIP_MARKERS):
+            pytest.skip(
+                "jax.distributed unsupported in this environment: "
+                + err.strip().splitlines()[-1][:300]
+            )
+        raise AssertionError(
+            f"killed rank exited rc={rc} before the injected SIGKILL:\n"
+            f"{err[-3000:]}"
+        )
+    survivors = [r for r in range(nprocs) if r != kill_rank]
+    deadline = time.monotonic() + _TIMEOUT_S
+    pending = set(survivors)
+    while pending and time.monotonic() < deadline:
+        for r in list(pending):
+            recs = read_progress(
+                os.path.join(host_dir(root, r), PROGRESS_NAME)
+            )
+            if any(rec["name"] == "done" for rec in recs) \
+                    or procs[r].poll() is not None:
+                pending.discard(r)
+        time.sleep(0.2)
+    assert not pending, (
+        f"survivor ranks {sorted(pending)} never finished their local "
+        "fold after the victim died"
+    )
+    for r in survivors:
+        procs[r].kill()
+        procs[r].communicate()
+
+
+def _resize_resume_scenario(tmp_path, *, old_world, new_world, kill_rank,
+                            kill_after):
+    """Kill one rank of an ``old_world`` run, resume on ``new_world``
+    ranks with ``resume_policy=repartition``: the merged ``x`` must be
+    bit-identical to an UNINTERRUPTED run at the new world size (exact
+    integer + CWT arithmetic makes that a hard equality), and
+    ``info["replay"]`` must show only the dead rank's unledgered batch
+    range re-folded."""
+    import json
+
+    import numpy as np
+
+    from libskylark_tpu.streaming import RowPartition
+
+    global _ENV_SKIP
+    if _ENV_SKIP is not None:
+        pytest.skip(_ENV_SKIP)
+    exact = {"ELASTIC_EXACT": "1"}
+
+    # -- reference: uninterrupted run at the NEW world size ---------------
+    out_ref = tmp_path / "out-ref"
+    out_ref.mkdir()
+    procs = _spawn_elastic(
+        new_world, _free_port(), tmp_path / "ck-ref", out_ref,
+        resume=False, extra_env=exact,
+    )
+    _communicate_or_skip(procs, new_world, "reference")
+
+    # -- casualty run at the OLD world size -------------------------------
+    root = tmp_path / "ck"
+    _run_world_with_casualty(
+        old_world, root, tmp_path, kill_rank=kill_rank,
+        kill_after=kill_after, extra_env=exact,
+    )
+
+    # -- resume at the NEW world size with repartition ---------------------
+    out_res = tmp_path / "out-res"
+    out_res.mkdir()
+    procs = _spawn_elastic(
+        new_world, _free_port(), root, out_res, resume=True,
+        extra_env={**exact, "ELASTIC_RESUME_POLICY": "repartition"},
+    )
+    _communicate_or_skip(procs, new_world, "repartition-resume")
+
+    # bit-identity at the new world size, on every rank
+    for r in range(new_world):
+        want = np.load(out_ref / f"x-{r}.npy")
+        got = np.load(out_res / f"x-{r}.npy")
+        np.testing.assert_array_equal(got, want)
+
+    # replay accounting: only the victim's unledgered range re-folds.
+    # mirrors _elastic_child.py's constants (tests/ is not a package)
+    old_part = RowPartition(nrows=96, batch_rows=4, world_size=old_world)
+    b0, b1 = old_part.batch_range(kill_rank)
+    want_replayed = [[b0 + kill_after + 1, b1]]
+    for r in range(new_world):
+        with open(out_res / f"info-{r}.json") as fh:
+            info = json.load(fh)
+        replay = info["replay"]
+        assert replay["replayed"] == want_replayed
+        assert replay["from_world"] == old_world
+        assert replay["to_world"] == new_world
+        assert replay["lost_hosts"] == []
+
+
+@pytest.mark.distributed_streaming
+def test_elastic_shrink_world_resume(tmp_path):
+    """4-host run loses a rank; the job comes back on 2 hosts."""
+    _resize_resume_scenario(
+        tmp_path, old_world=4, new_world=2, kill_rank=1, kill_after=1
+    )
+
+
+@pytest.mark.distributed_streaming
+def test_elastic_grow_world_resume(tmp_path):
+    """2-host run loses a rank; the job comes back on 4 hosts."""
+    _resize_resume_scenario(
+        tmp_path, old_world=2, new_world=4, kill_rank=1, kill_after=1
+    )
+
+
+@pytest.mark.distributed_streaming
+def test_elastic_hung_rank_raises_timeout(tmp_path):
+    """A straggler sleeping through its fold must NOT hang the world:
+    the healthy rank's deadline-bounded merge raises
+    ``CollectiveTimeoutError`` (code 110) naming the straggler."""
+    global _ENV_SKIP
+    if _ENV_SKIP is not None:
+        pytest.skip(_ENV_SKIP)
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    procs = _spawn_elastic(
+        2, _free_port(), tmp_path / "ck", out_dir, resume=False,
+        extra_env={
+            "ELASTIC_FAULT_RANK": "1",
+            "ELASTIC_SLOW_AT_BATCH": "0",
+            "ELASTIC_SLOW_SECONDS": "600",
+            "ELASTIC_COLLECTIVE_TIMEOUT_S": "15",
+        },
+    )
+    try:
+        rc0 = procs[0].wait(timeout=_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+            p.communicate()
+        pytest.skip(
+            f"timeout scenario did not complete within {_TIMEOUT_S}s "
+            "(distributed CPU runtime unavailable here)"
+        )
+    out0, err0 = procs[0].communicate()
+    procs[1].kill()  # still asleep in its injected stall
+    procs[1].communicate()
+    if rc0 != 110 and any(m in err0 for m in _SKIP_MARKERS):
+        reason = (
+            "jax.distributed unsupported in this environment: "
+            + err0.strip().splitlines()[-1][:300]
+        )
+        if any(m in err0 for m in _DETERMINISTIC_MARKERS):
+            _ENV_SKIP = reason
+        pytest.skip(reason)
+    assert rc0 == 110, (
+        f"healthy rank should exit 110 (CollectiveTimeoutError), got "
+        f"rc={rc0}\nstdout:\n{out0}\nstderr:\n{err0[-3000:]}"
+    )
+    assert "ELASTIC-TIMEOUT" in out0
+    assert "stragglers=[1]" in out0

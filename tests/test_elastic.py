@@ -432,3 +432,68 @@ class TestHostLedger:
 
     def test_missing_file_reads_empty(self, tmp_path):
         assert read_progress(tmp_path / "absent.jsonl") == []
+
+    def test_duplicate_seq_keeps_last_record(self, tmp_path):
+        # A rank that dies after write() but before its ledger line is
+        # acknowledged can replay the same batch and re-record the same
+        # seq on resume; the LAST occurrence is the authoritative one.
+        path = tmp_path / "progress.jsonl"
+        lines = [
+            '{"ts": 1.0, "seq": 1, "kind": "elastic",'
+            ' "attrs": {"rank": 0, "epoch": 0, "batch": 4}}',
+            '{"ts": 2.0, "seq": 1, "kind": "elastic",'
+            ' "attrs": {"rank": 0, "epoch": 0, "batch": 5}}',
+        ]
+        path.write_text("\n".join(lines) + "\n", encoding="utf-8")
+        recs = read_progress(path)
+        assert len(recs) == 1
+        assert recs[0]["attrs"]["batch"] == 5
+
+    def test_out_of_order_seq_returns_sorted(self, tmp_path):
+        # Buffered writes flushed by two racing incarnations can land
+        # out of order on shared storage; readers see seq order.
+        path = tmp_path / "progress.jsonl"
+        lines = [
+            '{"ts": 1.0, "seq": 3, "kind": "elastic",'
+            ' "attrs": {"rank": 0, "epoch": 0, "batch": 2}}',
+            '{"ts": 1.0, "seq": 1, "kind": "elastic",'
+            ' "attrs": {"rank": 0, "epoch": 0, "batch": 0}}',
+            '{"ts": 1.0, "seq": 2, "kind": "elastic",'
+            ' "attrs": {"rank": 0, "epoch": 0, "batch": 1}}',
+        ]
+        path.write_text("\n".join(lines) + "\n", encoding="utf-8")
+        recs = read_progress(path)
+        assert [r["seq"] for r in recs] == [1, 2, 3]
+        assert [r["attrs"]["batch"] for r in recs] == [0, 1, 2]
+        # and the next incarnation continues past the highest intact seq
+        led = HostLedger(path, rank=0)
+        assert led.record("done", batches=3) == 4
+        led.close()
+
+    def test_epoch_scopes_the_seq_space(self, tmp_path):
+        # Same seq under different epochs = different incarnation
+        # generations, NOT duplicates; both survive, epoch-major order.
+        path = tmp_path / "progress.jsonl"
+        lines = [
+            '{"ts": 1.0, "seq": 1, "kind": "elastic",'
+            ' "attrs": {"rank": 0, "epoch": 1, "batch": 9}}',
+            '{"ts": 1.0, "seq": 1, "kind": "elastic",'
+            ' "attrs": {"rank": 0, "epoch": 0, "batch": 0}}',
+        ]
+        path.write_text("\n".join(lines) + "\n", encoding="utf-8")
+        recs = read_progress(path)
+        assert [(r["attrs"]["epoch"], r["seq"]) for r in recs] == [
+            (0, 1), (1, 1)
+        ]
+
+    def test_non_dict_json_lines_are_skipped(self, tmp_path):
+        path = tmp_path / "progress.jsonl"
+        path.write_text(
+            '42\n'
+            '"noise"\n'
+            '{"ts": 1.0, "seq": 1, "kind": "elastic",'
+            ' "attrs": {"rank": 0, "epoch": 0}}\n',
+            encoding="utf-8",
+        )
+        recs = read_progress(path)
+        assert len(recs) == 1 and recs[0]["seq"] == 1
