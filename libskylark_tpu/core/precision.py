@@ -19,7 +19,31 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["bf16_split3"]
+__all__ = ["bf16_split3", "f32_accumulable"]
+
+
+def f32_accumulable(dtype, *, demote_f64: bool = False) -> bool:
+    """True when ``dtype`` may ride an f32-accumulating kernel with
+    casts at the boundary.  bf16/f16 qualify unconditionally — f32 is a
+    strict superset of both, so the cast in is exact and only the final
+    cast out rounds (no worse than accumulating natively in the narrow
+    type, and usually much better).  f64 qualifies only when the caller
+    explicitly accepts the demotion (``demote_f64=True``, i.e. a
+    force-enabled kernel): x64 parity runs must keep the XLA
+    full-precision lowering by default.  This is the shared dtype gate
+    of the Pallas scatter family (``sketch/pallas_scatter.py``,
+    ``sketch/pallas_window.py``) — the precision ladders hand out bf16
+    operands and previously forced every hash scatter back to XLA."""
+    dt = jnp.dtype(dtype)
+    if dt in (
+        jnp.dtype(jnp.float32),
+        jnp.dtype(jnp.bfloat16),
+        jnp.dtype(jnp.float16),
+    ):
+        return True
+    if dt == jnp.dtype(jnp.float64):
+        return bool(demote_f64)
+    return False
 
 
 def _mask_top(x):

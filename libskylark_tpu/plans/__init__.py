@@ -22,6 +22,7 @@ from .plan import (
     donating_jit,
     donation_enabled,
     enabled,
+    fused_enabled,
     pad_rows_to_bucket,
 )
 
@@ -37,6 +38,7 @@ __all__ = [
     "donating_jit",
     "donation_enabled",
     "enabled",
+    "fused_enabled",
     "SketchPlan",
     "PLAN_CACHE",
     "stats",

@@ -48,6 +48,7 @@ from .cache import PLAN_CACHE
 __all__ = [
     "enabled",
     "donation_enabled",
+    "fused_enabled",
     "SketchPlan",
     "apply",
     "accumulate_slice",
@@ -62,6 +63,29 @@ def enabled() -> bool:
     """Plans are on unless ``SKYLARK_NO_PLANS=1`` (checked per call so
     tests and operators can flip it at runtime)."""
     return os.environ.get("SKYLARK_NO_PLANS", "").lower() not in ("1", "true")
+
+
+def fused_enabled() -> bool:
+    """Fused stream-chunk steps (``apply_slice_kernel_acc`` traced as
+    the slice-plan body — the accumulator add folds into the sketch
+    kernel's emit where the transform supports it) are on unless
+    ``SKYLARK_NO_FUSED_CHUNKS=1``.  Checked per call; the flag also
+    discriminates the plan key, so flipping it at runtime re-plans
+    instead of hitting a stale executable."""
+    env = os.environ.get("SKYLARK_NO_FUSED_CHUNKS", "").lower()
+    return env not in ("1", "true")
+
+
+def _kernel_env_token() -> tuple:
+    """The env knobs that statically steer which scatter kernel a slice
+    trace bakes in (``hash._window_mode`` / ``_segment_sum``).  Folded
+    into the slice-plan key so a runtime flip re-traces rather than
+    serving an executable built under the old routing."""
+    return (
+        os.environ.get("SKYLARK_PALLAS_WINDOW", ""),
+        os.environ.get("SKYLARK_PALLAS_SCATTER", ""),
+        os.environ.get("SKYLARK_NO_PALLAS", "0"),
+    )
 
 
 def donation_enabled() -> bool:
@@ -236,7 +260,7 @@ def apply(S, A, dim: Dimension | str = Dimension.COLUMNWISE):
 
 def accumulate_slice(
     S, acc, block, start, *, donate: bool | None = None,
-    true_rows: int | None = None,
+    true_rows: int | None = None, fused: bool | None = None,
 ):
     """One streaming COLUMNWISE step, planned:
     ``acc + S.apply_slice(block, start)`` (cast to ``acc.dtype``) as a
@@ -250,6 +274,15 @@ def accumulate_slice(
     real row count as ``true_rows``.  Falls back to the eager step for
     sparse blocks, transforms without a jit-safe slice kernel, or when
     plans are off.
+
+    ``fused`` (default :func:`fused_enabled`) traces the step through
+    ``S.apply_slice_kernel_acc`` — the transform's fused chunk body,
+    which for the hash sketches folds the accumulator add into the
+    Pallas window kernel's emit (one launch per chunk).  Fused and
+    unfused are bitwise identical by the ``apply_slice_kernel_acc``
+    contract; ``fused=False`` keeps the explicit two-step composite as
+    the operator kill switch (``SKYLARK_NO_FUSED_CHUNKS=1`` process-
+    wide, or ``StreamParams(fused_chunks=False)`` per pass).
     """
     k = block.shape[0]
     if (
@@ -271,6 +304,8 @@ def accumulate_slice(
     block = pad_rows(block, kb)
     if donate is None:
         donate = donation_enabled()
+    if fused is None:
+        fused = fused_enabled()
     block = jnp.asarray(block)
     acc = jnp.asarray(acc)
     key = (
@@ -281,18 +316,26 @@ def accumulate_slice(
         acc.dtype.name,
         _sharding_key(acc),
         bool(donate),
+        bool(fused),
+        _kernel_env_token(),
     )
 
     def build():
-        def fn(acc_, block_, start_):
-            part = S.apply_slice_kernel(block_, start_)
-            return acc_ + part.astype(acc_.dtype)
+        if fused:
+            def fn(acc_, block_, start_):
+                return S.apply_slice_kernel_acc(acc_, block_, start_)
+        else:
+            def fn(acc_, block_, start_):
+                part = S.apply_slice_kernel(block_, start_)
+                return acc_ + part.astype(acc_.dtype)
 
         return SketchPlan(key, fn, donate_argnums=(0,) if donate else ())
 
     plan = PLAN_CACHE.get_or_build(key, build)
     if telemetry.enabled():
-        telemetry.event("plan", "slice", {"bucket": kb, "rows": k})
+        telemetry.event(
+            "plan", "slice", {"bucket": kb, "rows": k, "fused": bool(fused)}
+        )
     return plan(acc, block, jnp.asarray(int(start), jnp.int32))
 
 

@@ -214,6 +214,18 @@ class SketchTransform(abc.ABC):
             "streaming falls back to the eager apply_slice path"
         )
 
+    def apply_slice_kernel_acc(self, acc, A_block, start):
+        """One streaming chunk step as a single traced body:
+        ``acc + apply_slice_kernel(A_block, start)`` cast to
+        ``acc.dtype``.  This default composite is exactly what the plan
+        layer always compiled; engines with a device-fused kernel (the
+        hash sketches) override it to fold the accumulator add into the
+        kernel's emit — REQUIRED to stay bitwise equal to this
+        composite (a single IEEE add of the same partial), so the
+        planned≡eager contract never depends on which path won."""
+        part = self.apply_slice_kernel(A_block, start)
+        return acc + part.astype(acc.dtype)
+
     def finalize_slices(self, acc, dim: Dimension | str = Dimension.COLUMNWISE):
         """Turn the merged COLUMNWISE slice-sum into the final sketch
         (identity for linear transforms; feature maps apply their

@@ -31,13 +31,14 @@ import numpy as np
 from jax.experimental import sparse as jsparse
 
 from ..core.context import SketchContext
-from ..core.precision import bf16_split3
+from ..core.precision import bf16_split3, f32_accumulable
 from ..core.random import sample
-from . import pallas_scatter
+from . import pallas_scatter, pallas_window
 from .base import Dimension, SketchTransform, register_sketch
 
 
 _KERNEL_COMPILES: bool | None = None
+_WINDOW_COMPILES: bool | None = None
 
 
 def _kernel_compiles() -> bool:
@@ -109,12 +110,18 @@ def _segment_sum(addends, key, num_segments: int):
     ``SKYLARK_PALLAS_SCATTER=1`` forces the kernel, ``=interpret`` runs
     it in interpret mode (CPU tests), ``SKYLARK_NO_PALLAS=1`` forces the
     XLA path.  The TPU-default branch only engages after a one-time
-    compiled probe confirms Mosaic can lower the kernel (ADVICE r4)."""
-    ok = addends.dtype == jnp.float32 and pallas_scatter.supported(
-        addends.shape[0], num_segments
-    )  # f64 (x64 parity runs) must keep XLA's full-precision path
+    compiled probe confirms Mosaic can lower the kernel (ADVICE r4).
+
+    Dtype gate: f32 natively; bf16/f16 ride the kernel's f32-accumulate
+    boundary cast (``precision.f32_accumulable``); f64 engages the
+    (demoting) cast only under a forced mode — x64 parity runs keep
+    XLA's full-precision lowering by default."""
     mode = os.environ.get("SKYLARK_PALLAS_SCATTER", "")
-    if ok and mode in ("1", "interpret"):
+    forced = mode in ("1", "interpret")
+    ok = f32_accumulable(
+        addends.dtype, demote_f64=forced
+    ) and pallas_scatter.supported(addends.shape[0], num_segments)
+    if ok and forced:
         return pallas_scatter.segment_sum_flat(
             addends, key, num_segments, interpret=(mode == "interpret")
         )
@@ -126,6 +133,102 @@ def _segment_sum(addends, key, num_segments: int):
     ):
         return pallas_scatter.segment_sum_flat(addends, key, num_segments)
     return jax.ops.segment_sum(addends, key, num_segments=num_segments)
+
+
+def _window_compiles() -> bool:
+    """One-time compiled self-test of the Pallas WINDOW kernel on the
+    default backend — same probe discipline (and the same shared
+    validator + cached-verdict rationale) as :func:`_kernel_compiles`:
+    the scalar-indexed vector RMW is the piece Mosaic may refuse on
+    some TPU generations, and callers sit inside jit traces, so the
+    first verdict is baked into their executables either way."""
+    global _WINDOW_COMPILES
+    for attempt in range(3):
+        if _WINDOW_COMPILES is not None:
+            break
+        import warnings
+
+        try:
+            with jax.ensure_compile_time_eval():
+                err = pallas_window.self_check()
+            _WINDOW_COMPILES = err < 1e-5
+            if not _WINDOW_COMPILES:
+                warnings.warn(
+                    "Pallas window kernel compiled but miscomputed "
+                    f"(rel err {err:g} vs segment_sum); falling back to "
+                    "jax.ops.segment_sum for this process",
+                    RuntimeWarning,
+                    stacklevel=2,
+                )
+        except Exception as e:  # noqa: BLE001 — any lowering failure → XLA
+            msg = repr(e)
+            transient = any(
+                tok in msg
+                for tok in ("UNAVAILABLE", "DEADLINE", "RESOURCE_EXHAUSTED")
+            )
+            if transient and attempt < 2:
+                import time
+
+                time.sleep(3.0)
+                continue
+            warnings.warn(
+                "Pallas window kernel probe failed; falling back to "
+                f"jax.ops.segment_sum for this process: {msg[:300]}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            _WINDOW_COMPILES = False
+    return _WINDOW_COMPILES
+
+
+def _window_mode(k: int, m: int, num_segments: int, dtype) -> str:
+    """STATIC routing decision for the windowed row scatter-add — shape,
+    dtype, env, and the one-time probe only, never values.  Returns
+    ``"xla"``, ``"kernel"``, or ``"interpret"``.  Because every input is
+    static, the eager apply_slice path and the planned slice-kernel path
+    of the same (shape, dtype) block resolve to the SAME branch — the
+    bitwise planned≡eager contract holds by construction, whichever
+    kernel wins.  ``SKYLARK_PALLAS_WINDOW=1`` forces the kernel,
+    ``=interpret`` runs it in interpret mode (CPU tests), ``=0`` (or
+    ``SKYLARK_NO_PALLAS=1``) forces the XLA path."""
+    mode = os.environ.get("SKYLARK_PALLAS_WINDOW", "")
+    forced = mode in ("1", "interpret")
+    ok = f32_accumulable(
+        dtype, demote_f64=forced
+    ) and pallas_window.supported(k, num_segments, m)
+    if not ok or mode == "0":
+        return "xla"
+    if forced:
+        return "interpret" if mode == "interpret" else "kernel"
+    if (
+        jax.default_backend() == "tpu"
+        and pallas_window.worthwhile(k, num_segments, m)
+        and _window_compiles()
+    ):
+        return "kernel"
+    return "xla"
+
+
+def _segment_sum_rows(A_block, b, v, num_segments: int, mode: str, acc=None):
+    """Row scatter-add ``out[b[i], :] += v[i] * A_block[i, :]`` — the
+    windowed analogue of :func:`_segment_sum`, and the ONE dispatcher
+    both the eager ``_apply_slice_columnwise`` and the jit-safe
+    ``apply_slice_kernel`` call (with ``mode`` decided up front by
+    :func:`_window_mode`), so the plans slice path and the eager path
+    pick the same kernel by construction.  ``v`` must carry the caller's
+    compute dtype on the XLA branch and f32 on the kernel branches (the
+    value realization dtype is part of the routing decision, not of this
+    function).  ``acc`` (f32, kernel modes only) folds the streaming
+    accumulator add into the kernel's emit — the fused stream-chunk
+    path.  Kernel output is f32; the caller casts at the boundary."""
+    if mode == "xla":
+        return jax.ops.segment_sum(
+            v[:, None] * A_block, b, num_segments=num_segments
+        )
+    return pallas_window.scatter_rows(
+        A_block, b, v, num_segments, acc=acc,
+        interpret=(mode == "interpret"),
+    )
 
 __all__ = ["HashSketch", "CWT", "MMT", "WZT", "SJLT"]
 
@@ -265,38 +368,79 @@ class HashSketch(SketchTransform):
                 ).astype(dtype).reshape(self.s, m)
             return out
         A_block = A_block.astype(dtype)
+        mode = _window_mode(k, A_block.shape[1], self.s, dtype)
+        vdt = dtype if mode == "xla" else jnp.float32
         for h in range(self.nnz):
             b = self.buckets(h * self.n + start, k)
-            v = self.values(dtype, h * self.n + start, k)
-            out = out + jax.ops.segment_sum(
-                v[:, None] * A_block, b, num_segments=self.s
-            )
+            v = self.values(vdt, h * self.n + start, k)
+            out = out + _segment_sum_rows(
+                A_block, b, v, self.s, mode
+            ).astype(dtype)
         return out
 
     supports_slice_kernel = True
 
-    def apply_slice_kernel(self, A_block, start):
-        """jit-safe COLUMNWISE partial with TRACED ``start``: the same
-        per-hash windowed ``segment_sum`` as ``_apply_slice_columnwise``
-        (the ``(static, traced)`` window split keeps the 64-bit counter
-        base exact), with values past the sketch domain zeroed — an
-        out-of-domain counter stream can hold non-finite draws (WZT's
-        1/Exp), and inf·0 from a padded row would poison the sum."""
+    def _slice_kernel_impl(self, A_block, start, acc):
+        """Shared body of :meth:`apply_slice_kernel` (``acc=None``) and
+        :meth:`apply_slice_kernel_acc`: the per-hash windowed row
+        scatter-add with TRACED ``start`` (the ``(static, traced)``
+        window split keeps the 64-bit counter base exact) and values
+        past the sketch domain zeroed — an out-of-domain counter stream
+        can hold non-finite draws (WZT's 1/Exp), and inf·0 from a
+        padded row would poison the sum.
+
+        When an ``acc`` is given and the single-launch gate admits
+        (nnz=1, f32 block and f32 accumulator, window kernel engaged),
+        the accumulator add is folded into the kernel's emit — one
+        launch per stream chunk, bitwise equal to the unfused
+        ``acc + part`` composite (a single IEEE add of the same
+        partial, so the plan layer's planned≡eager contract holds)."""
         k = A_block.shape[0]
         dtype = A_block.dtype
         if not jnp.issubdtype(dtype, jnp.floating):
             dtype = jnp.float32
-        valid = start + jnp.arange(k, dtype=jnp.int32) < self.n
-        out = jnp.zeros((self.s, A_block.shape[1]), dtype)
         A_block = A_block.astype(dtype)
+        m = A_block.shape[1]
+        mode = _window_mode(k, m, self.s, dtype)
+        vdt = dtype if mode == "xla" else jnp.float32
+        valid = start + jnp.arange(k, dtype=jnp.int32) < self.n
+        fuse = (
+            acc is not None
+            and mode != "xla"
+            and self.nnz == 1
+            and dtype == jnp.float32
+            and acc.dtype == jnp.float32
+        )
+        out = jnp.zeros((self.s, m), dtype)
         for h in range(self.nnz):
             b = self.buckets((h * self.n, start), k)
-            v = self.values(dtype, (h * self.n, start), k)
-            v = jnp.where(valid, v, jnp.zeros((), dtype))
-            out = out + jax.ops.segment_sum(
-                v[:, None] * A_block, b, num_segments=self.s
-            )
+            v = self.values(vdt, (h * self.n, start), k)
+            v = jnp.where(valid, v, jnp.zeros((), vdt))
+            if fuse:
+                return _segment_sum_rows(
+                    A_block, b, v, self.s, mode, acc=acc
+                )
+            out = out + _segment_sum_rows(
+                A_block, b, v, self.s, mode
+            ).astype(dtype)
+        if acc is not None:
+            return acc + out.astype(acc.dtype)
         return out
+
+    def apply_slice_kernel(self, A_block, start):
+        """jit-safe COLUMNWISE partial with TRACED ``start`` — the same
+        per-hash windowed scatter-add as ``_apply_slice_columnwise``,
+        routed through the same :func:`_segment_sum_rows` dispatcher so
+        the plans slice path and the eager path pick the same kernel
+        (bitwise-identical by construction)."""
+        return self._slice_kernel_impl(A_block, start, None)
+
+    def apply_slice_kernel_acc(self, acc, A_block, start):
+        """Fused streaming chunk step: ``acc + apply_slice_kernel``
+        folded into a single kernel launch when the gate in
+        :meth:`_slice_kernel_impl` admits; the base composite (same
+        bits) otherwise."""
+        return self._slice_kernel_impl(A_block, start, acc)
 
     # Above this many (S·N) entries the materialized one-hot hashing
     # matrix no longer pays for itself; fall back to scatter-add.
@@ -359,6 +503,14 @@ class HashSketch(SketchTransform):
             if dim is Dimension.COLUMNWISE:
                 return M.T @ A.astype(dtype)
             return A.astype(dtype) @ M
+        if dim is Dimension.COLUMNWISE and self.nnz == 1:
+            # Single hash: the scatter-add IS the windowed row scatter,
+            # so the full dense apply rides the same dispatcher (and the
+            # same Pallas kernel, when engaged) as the streaming slices.
+            mode = _window_mode(self.n, A.shape[1], self.s, dtype)
+            b1 = self.buckets()
+            v1 = self.values(dtype if mode == "xla" else jnp.float32)
+            return _segment_sum_rows(A, b1, v1, self.s, mode).astype(dtype)
         b = self.buckets().reshape(self.nnz, self.n)
         v = self.values(dtype).reshape(self.nnz, self.n)
         if dim is Dimension.COLUMNWISE:

@@ -115,14 +115,20 @@ def _partition_kernel(
     pid = jnp.minimum(keys // V, PP - 1)  # padding keys -> tail partition
     iota_p = jax.lax.broadcasted_iota(jnp.int32, (C, PP), 1)
     onehot = (pid[:, None] == iota_p).astype(jnp.int32)
-    counts_row = jnp.sum(onehot, axis=0, keepdims=True)  # (1, PP)
+    # dtype pinned: under x64 (interpret-mode CPU tests) jnp.sum would
+    # promote int32 to int64, which the int32 refs reject.
+    counts_row = jnp.sum(
+        onehot, axis=0, keepdims=True, dtype=jnp.int32
+    )  # (1, PP)
     cnt_ref[0, :] = counts_row[0, :]
     # exclusive start of each partition's span within the sorted chunk,
     # plus each entry's rank among same-pid entries before it
     pstart_row = _excl_cumsum_lanes(counts_row)  # (1, PP)
     inc = _cumsum_sublanes(onehot)  # (C, PP)
-    rank = jnp.sum(onehot * inc, axis=1) - 1  # (C,)
-    dest_ref[0, :] = jnp.sum(onehot * pstart_row, axis=1) + rank
+    rank = jnp.sum(onehot * inc, axis=1, dtype=jnp.int32) - 1  # (C,)
+    dest_ref[0, :] = (
+        jnp.sum(onehot * pstart_row, axis=1, dtype=jnp.int32) + rank
+    )
 
     def body(i, c):
         d = dest_ref[0, i]
@@ -212,13 +218,24 @@ def self_check(
 def segment_sum_flat(vals, keys, num_segments: int, interpret: bool = False):
     """``out[t] = sum(vals[keys == t])`` for flat int32 keys in
     [0, num_segments).  Caller gates with :func:`supported`; ``vals``
-    and ``keys`` are 1-D and equal length."""
+    and ``keys`` are 1-D and equal length.
+
+    Non-f32 floating ``vals`` (bf16/f16/f64) take the f32-accumulate
+    boundary cast: exact on the way in for the narrow types, one
+    rounding on the way out — so the precision ladders
+    (``core/precision.py``) no longer force the XLA scatter lowering.
+    Callers gate the f64 demotion through
+    ``precision.f32_accumulable(demote_f64=...)``."""
     # Accumulate mode: "scalar" (1 scalar RMW/entry — needs dynamic-lane
     # addressing) or "lanemask" (vector RMW, no dynamic lanes).  Read
     # OUTSIDE the jitted impl so a mode switch is a fresh trace, not a
     # stale cache hit.
     lanemask = os.environ.get("SKYLARK_SCATTER_ACCUM", "scalar") == "lanemask"
-    return _segment_sum_impl(vals, keys, num_segments, interpret, lanemask)
+    out_dtype = vals.dtype
+    out = _segment_sum_impl(vals, keys, num_segments, interpret, lanemask)
+    if out_dtype != jnp.float32 and jnp.issubdtype(out_dtype, jnp.floating):
+        return out.astype(out_dtype)
+    return out
 
 
 @partial(

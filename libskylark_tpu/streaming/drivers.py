@@ -135,7 +135,8 @@ def sketch(
         block, k = _unwrap(block)
         return {
             "sa": plans.accumulate_slice(
-                S, acc["sa"], block, row, true_rows=k
+                S, acc["sa"], block, row, true_rows=k,
+                fused=params.fused_chunks,
             ),
             "row": np.asarray(row + k, np.int64),
         }
@@ -240,8 +241,12 @@ def sketch_least_squares(
         row = int(acc["row"])
         b2 = b_b[:, None] if getattr(b_b, "ndim", 1) == 1 else b_b
         return {
-            "sa": plans.accumulate_slice(S, acc["sa"], A_b, row),
-            "sb": plans.accumulate_slice(S, acc["sb"], b2, row),
+            "sa": plans.accumulate_slice(
+                S, acc["sa"], A_b, row, fused=params.fused_chunks
+            ),
+            "sb": plans.accumulate_slice(
+                S, acc["sb"], b2, row, fused=params.fused_chunks
+            ),
             "row": np.asarray(row + A_b.shape[0], np.int64),
         }
 

@@ -718,7 +718,10 @@ def distributed_sketch(
         row = int(acc["row"])
         block, k = _unwrap(block)
         return {
-            "sa": accumulate_slice(S, acc["sa"], block, row, true_rows=k),
+            "sa": accumulate_slice(
+                S, acc["sa"], block, row, true_rows=k,
+                fused=getattr(params, "fused_chunks", None),
+            ),
             "row": np.asarray(row + k, np.int64),
         }
 
@@ -827,8 +830,14 @@ def distributed_sketch_least_squares(
         row = int(acc["row"])
         b2 = b_b[:, None] if getattr(b_b, "ndim", 1) == 1 else b_b
         return {
-            "sa": accumulate_slice(S, acc["sa"], A_b, row),
-            "sb": accumulate_slice(S, acc["sb"], b2, row),
+            "sa": accumulate_slice(
+                S, acc["sa"], A_b, row,
+                fused=getattr(params, "fused_chunks", None),
+            ),
+            "sb": accumulate_slice(
+                S, acc["sb"], b2, row,
+                fused=getattr(params, "fused_chunks", None),
+            ),
             "row": np.asarray(row + A_b.shape[0], np.int64),
         }
 

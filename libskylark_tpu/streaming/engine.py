@@ -39,16 +39,25 @@ __all__ = ["StreamParams", "as_block_factory", "run_stream"]
 class StreamParams(ResilientParams):
     """Runtime knobs of a streaming pass — the resilient runner's params
     (checkpointing, retries, divergence) plus the pipeline's:
-    ``prefetch`` staged batches (0 disables the pipeline thread) and the
-    staging ``placer`` (host→device by default).
+    ``prefetch`` staged batches (0 disables the pipeline thread), the
+    staging ``placer`` (host→device by default), and ``fused_chunks``
+    — whether planned accumulate steps trace the transform's fused
+    chunk body (``apply_slice_kernel_acc``: one kernel launch per
+    chunk where supported; bitwise equal to the two-step composite
+    either way).  ``None`` defers to the process default
+    (``plans.fused_enabled`` / ``SKYLARK_NO_FUSED_CHUNKS``).
 
     ``checkpoint_every`` counts BATCHES per checkpoint round here.
     """
 
-    def __init__(self, *, prefetch: int = 2, placer=device_placer, **kw):
+    def __init__(
+        self, *, prefetch: int = 2, placer=device_placer,
+        fused_chunks: bool | None = None, **kw,
+    ):
         super().__init__(**kw)
         self.prefetch = int(prefetch)
         self.placer = placer
+        self.fused_chunks = fused_chunks
 
 
 def as_block_factory(source):

@@ -242,6 +242,38 @@ def guard_pallas_scatter_compiled():
     assert err < 1e-5, f"pallas scatter diverged on hardware: {err}"
 
 
+def guard_pallas_window_compiled():
+    """The windowed row scatter-add kernel must compile (Mosaic) and
+    match segment_sum on hardware — its scalar-indexed VECTOR
+    read-modify-write on the VMEM scratch is exactly the construct
+    Mosaic may refuse on some TPU generations, and interpret-mode CPU
+    parity cannot see that.  Also pins the fused-chunk contract on
+    hardware: the acc-folded emit must be BITWISE equal to kernel +
+    separate add (one IEEE add either way)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from libskylark_tpu.sketch.pallas_window import (
+        scatter_rows,
+        self_check,
+        supported,
+    )
+
+    k, s, m = 65_536, 1024, 256
+    assert supported(k, s, m)
+    err = self_check(k, s, m)
+    assert err < 1e-5, f"pallas window kernel diverged on hardware: {err}"
+    kb, kv, ka, kacc = jax.random.split(jax.random.PRNGKey(17), 4)
+    b = jax.random.randint(kb, (k,), 0, s, jnp.int32)
+    v = jax.random.normal(kv, (k,), jnp.float32)
+    A = jax.random.normal(ka, (k, m), jnp.float32)
+    acc = jax.random.normal(kacc, (s, m), jnp.float32)
+    fused = scatter_rows(A, b, v, s, acc=acc)
+    unfused = acc + scatter_rows(A, b, v, s)
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(unfused))
+
+
 def guard_fjlt_sampled_compiled():
     """The fused sampled-FJLT kernel (round 5: selection + rescale in
     the epilogue) must either pass its compiled probe AND match the
@@ -286,6 +318,7 @@ def guard_fjlt_sampled_compiled():
 GUARDS = [
     ("rfut_rowwise_compiled", guard_rfut_rowwise_compiled),
     ("pallas_scatter_compiled", guard_pallas_scatter_compiled),
+    ("pallas_window_compiled", guard_pallas_window_compiled),
     ("fjlt_sampled_compiled", guard_fjlt_sampled_compiled),
     ("bf16_split_accuracy", guard_bf16_split_accuracy),
     ("wht_f32_accuracy", guard_wht_f32_accuracy),

@@ -46,6 +46,11 @@ DISTRIBUTED_STREAMING_TIMEOUT_S = 900
 # repartitioned resumes) simulated in ONE process; real multi-process
 # chaos rides the distributed_streaming slow tier instead.
 CHAOS_TIMEOUT_S = 120
+# Pallas kernel tests run in interpret mode on CPU CI (the compiled
+# kernels only exist on TPU); interpret mode executes the kernel body
+# as traced jax ops, so a mis-sized grid or a runaway scalar loop
+# would otherwise stall the tier-1 run.
+KERNELS_TIMEOUT_S = 120
 
 _TIMEOUT_MARKS = {
     "faults": FAULTS_TIMEOUT_S,
@@ -54,6 +59,7 @@ _TIMEOUT_MARKS = {
     "telemetry": TELEMETRY_TIMEOUT_S,
     "distributed_streaming": DISTRIBUTED_STREAMING_TIMEOUT_S,
     "chaos": CHAOS_TIMEOUT_S,
+    "kernels": KERNELS_TIMEOUT_S,
 }
 
 
@@ -100,6 +106,12 @@ def pytest_configure(config):
         "chaos: host-level chaos tests (rank death, stragglers, stale-"
         "epoch fencing, repartition-on-resume) simulated in one process; "
         f"tier-1, guarded by a per-test {CHAOS_TIMEOUT_S}s timeout",
+    )
+    config.addinivalue_line(
+        "markers",
+        "kernels: Pallas kernel tests (window/flat scatter, fused "
+        "stream chunks) in interpret mode on CPU CI; tier-1, guarded "
+        f"by a per-test {KERNELS_TIMEOUT_S}s timeout",
     )
 
 

@@ -25,6 +25,7 @@ _TIMEOUT_S = 510
 _GUARD_NAMES = [
     "rfut_rowwise_compiled",
     "pallas_scatter_compiled",
+    "pallas_window_compiled",
     "fjlt_sampled_compiled",
     "bf16_split_accuracy",
     "wht_f32_accuracy",
