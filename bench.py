@@ -935,6 +935,122 @@ def bench_telemetry(on_tpu, table):
     )
 
 
+def bench_policy(on_tpu, table):
+    """Adaptive-policy submetric (docs/autotuning.md): the same guarded
+    sketch-and-solve LS pass run cold (empty profile store + empty plan
+    cache) and warm (after ``policy.warm_start`` replays the persisted
+    hot plans), reporting the plan-compile seconds each pass pays, plus
+    the profile-learned sketch-dimension ratio once the store matures.
+    Warm < cold is the warm-start contract of ISSUE 9; the dim ratio
+    shows the autotuner actually shrinking toward the smallest
+    certified-OK size.  First capture: vs_baseline fixed at 1.0."""
+    import shutil
+    import tempfile
+
+    from libskylark_tpu import plans, policy
+    from libskylark_tpu.linalg import approximate_least_squares
+
+    if on_tpu:
+        m, n = 65_536, 256
+    else:
+        m, n = 4096, 64
+    A = jax.random.normal(jax.random.PRNGKey(31), (m, n), jnp.float32)
+    b = jax.random.normal(jax.random.PRNGKey(32), (m,), jnp.float32)
+
+    env_keys = ("SKYLARK_POLICY", "SKYLARK_POLICY_DIR",
+                "SKYLARK_POLICY_MIN_SAMPLES", "SKYLARK_GUARD")
+    saved = {k: os.environ.get(k) for k in env_keys}
+    tmp = tempfile.mkdtemp(prefix="skylark-bench-policy-")
+    os.environ["SKYLARK_POLICY"] = "1"
+    os.environ["SKYLARK_GUARD"] = "1"
+    os.environ.pop("SKYLARK_POLICY_DIR", None)
+    os.environ["SKYLARK_POLICY_MIN_SAMPLES"] = "3"
+    try:
+        prev_xla_cache = jax.config.jax_compilation_cache_dir
+    except Exception:  # noqa: BLE001 — knob absent on old jax
+        prev_xla_cache = False  # sentinel: don't restore
+    try:
+        policy.configure(tmp)
+        policy.reset()
+        policy.invalidate_cache()
+
+        # -- cold: empty store, empty plan cache; the pass pays every
+        # plan trace+compile itself.  A fresh same-seed context per call
+        # keeps the sketch (and so the plan keys) bitwise identical
+        # between the cold and warm passes.
+        plans.clear()
+        plans.reset_stats()
+        approximate_least_squares(A, b, SketchContext(seed=41))
+        cold = plans.stats()["compile_seconds"]
+        policy.flush()  # persist the profile + hot-plan records
+
+        # -- warm: new "process" (cleared plan cache + merged-view
+        # reload), replay the recorded plans, then run the same pass.
+        # Its compile seconds are what warm start did NOT save.
+        plans.clear()
+        policy.invalidate_cache()
+        ws = policy.warm_start(tmp)
+        plans.reset_stats()
+        approximate_least_squares(A, b, SketchContext(seed=41))
+        st = plans.stats()
+        warm = st["compile_seconds"]
+        if ws["plans_replayed"] < 1 or st["hits"] < 1:
+            raise RuntimeError(
+                f"warm start replayed {ws['plans_replayed']} plans, "
+                f"{st['hits']} hits; cold/warm split is not trustworthy"
+            )
+        _emit(
+            f"policy cold LS pass {m}x{n} plan-compile",
+            cold * 1e3, "ms", 1.0, table,
+            contention=None,  # single-shot by construction
+        )
+        _emit(
+            f"policy warm LS pass {m}x{n} plan-compile (after replay)",
+            warm * 1e3, "ms",
+            # compile seconds warm start removed; a perfect replay pays
+            # 0.0 warm, so the speedup is floored at the 1ms resolution
+            # the compile timer can meaningfully distinguish.
+            cold / max(warm, 1e-3),
+            table,
+            contention=None,
+        )
+
+        # -- autotuned sketch dimension: mature the profile past
+        # min_samples and read the decided/default ratio of the next
+        # pass (shrink-toward-smallest-certified-OK, decide.py).
+        for k in range(3):
+            approximate_least_squares(A, b, SketchContext(seed=41))
+        policy.flush()
+        policy.invalidate_cache()
+        _, info = approximate_least_squares(
+            A, b, SketchContext(seed=41), return_info=True
+        )
+        dec = info["policy"]
+        _emit(
+            f"policy sketch-dim ratio LS {m}x{n} (decided/default, "
+            f"source={dec['source']})",
+            dec["sketch_size"] / min(4 * n, m), "ratio", 1.0, table,
+            contention=None,  # a decision, not a timing
+        )
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        policy.configure(None)
+        policy.reset()
+        policy.invalidate_cache()
+        if prev_xla_cache is not False:
+            # warm_start fills the XLA cache knob when unset; put back
+            # whatever the process had (tmp is about to be deleted).
+            try:
+                jax.config.update("jax_compilation_cache_dir", prev_xla_cache)
+            except Exception:  # noqa: BLE001
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def bench_elastic_resume(on_tpu, table):
     """Elastic-resume submetric (docs/fault_tolerance.md): a world=1
     partitioned streaming fold is preempted mid-pass right after a chunk
@@ -1358,6 +1474,10 @@ def main() -> None:
         # Telemetry ratios ride with the never-captured rows: cheap, and
         # they certify the observability layer on real hardware.
         ("telemetry", 60, lambda: bench_telemetry(on_tpu, table)),
+        # Adaptive-policy cold/warm rides with the never-captured rows:
+        # the round-9 warm-start contract (docs/autotuning.md) — plan
+        # compile seconds with and without the profile-store replay.
+        ("policy", 60, lambda: bench_policy(on_tpu, table)),
         # Elastic resume latency rides with them: the round-7
         # fault-tolerance measurement (docs/fault_tolerance.md), world=1
         # dry-run scale so it costs seconds, not minutes.

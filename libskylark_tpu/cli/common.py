@@ -7,14 +7,17 @@ import numpy as np
 __all__ = [
     "FILE_FORMATS",
     "add_perf_args",
+    "add_policy_args",
     "add_telemetry_args",
     "load_classes",
     "load_dataset",
     "print_perf_report",
+    "print_policy_report",
     "print_telemetry_report",
     "print_test_metrics",
     "scan_dims",
     "setup_perf",
+    "setup_policy",
     "setup_telemetry",
     "stream_dataset",
 ]
@@ -75,6 +78,65 @@ def print_perf_report(args) -> None:
         f"{st['size']}/{st['max_size']} plans resident"
         + (f", {st['evictions']} evicted" if st["evictions"] else "")
     )
+
+def add_policy_args(p) -> None:
+    """The shared adaptive-policy flags (every driver;
+    docs/autotuning.md)."""
+    g = p.add_mutually_exclusive_group()
+    g.add_argument(
+        "--policy", dest="policy", action="store_true", default=None,
+        help="enable the adaptive execution policy (the default; "
+             "profile-driven routing/autotuning once --policy-dir or "
+             "SKYLARK_POLICY_DIR points at a profile store)",
+    )
+    g.add_argument(
+        "--no-policy", dest="policy", action="store_false",
+        help="disable the policy layer (sets SKYLARK_POLICY=0): "
+             "default routes, no profile reads or writes, no warm start",
+    )
+    p.add_argument(
+        "--policy-dir", default=None,
+        help="profile-store directory (profile-<pid>.json per process); "
+             "enables persistent autotuning profiles and warm-start "
+             "plan/XLA-cache replay across runs",
+    )
+
+
+def setup_policy(args) -> None:
+    """Apply the policy flags and warm-start the process.  Call AFTER
+    :func:`setup_perf` so an explicit ``--xla-cache-dir`` wins over the
+    profile store's remembered one."""
+    import os
+
+    from .. import policy
+
+    if getattr(args, "policy", None) is False:
+        os.environ["SKYLARK_POLICY"] = "0"
+        return
+    if getattr(args, "policy", None) is True:
+        os.environ["SKYLARK_POLICY"] = "1"
+    if getattr(args, "policy_dir", None):
+        policy.configure(args.policy_dir)
+    ws = policy.warm_start()
+    if ws["enabled"] and (ws["plans_replayed"] or ws["xla_cache_dir"]):
+        print(
+            f"policy warm start: {ws['plans_replayed']} plans replayed "
+            f"({ws['plans_skipped']} skipped), "
+            f"xla cache {ws['xla_cache_dir'] or 'unset'}, "
+            f"{ws['seconds']:.3f}s"
+        )
+
+
+def print_policy_report(args) -> None:
+    """Close out a policy run: the decision counters, when any fired."""
+    if getattr(args, "policy", None) is False:
+        return
+    from .. import telemetry
+
+    counters = telemetry.snapshot()["policy"]
+    if counters:
+        print(f"policy: {counters}")
+
 
 def add_telemetry_args(p) -> None:
     """The shared telemetry flags (every driver; docs/observability.md)."""

@@ -16,10 +16,13 @@ import numpy as np
 from .common import (
     FILE_FORMATS,
     add_perf_args,
+    add_policy_args,
     add_telemetry_args,
     print_perf_report,
+    print_policy_report,
     print_telemetry_report,
     setup_perf,
+    setup_policy,
     setup_telemetry,
 )
 
@@ -83,6 +86,7 @@ def main(argv=None) -> int:
     p.add_argument("--batch-rows", type=int, default=4096,
                    help="rows per streamed batch (with --stream)")
     add_perf_args(p)
+    add_policy_args(p)
     add_telemetry_args(p)
     args = p.parse_args(argv)
 
@@ -91,6 +95,7 @@ def main(argv=None) -> int:
     if args.x64:
         jax.config.update("jax_enable_x64", True)
     setup_perf(args)
+    setup_policy(args)  # after setup_perf: explicit --xla-cache-dir wins
     setup_telemetry(args)
     import jax.numpy as jnp
 
@@ -170,6 +175,7 @@ def main(argv=None) -> int:
         Xtj = Xt if is_sparse else jnp.asarray(Xt)
         print_test_metrics(model, Xtj, yt, args.regression)
     print_perf_report(args)
+    print_policy_report(args)
     print_telemetry_report(args)
     return 0
 
@@ -230,6 +236,7 @@ def _stream_main(args, is_sparse: bool) -> int:
         Xtj = Xt if is_sparse else jnp.asarray(Xt)
         print_test_metrics(model, Xtj, yt, args.regression)
     print_perf_report(args)
+    print_policy_report(args)
     print_telemetry_report(args)
     return 0
 

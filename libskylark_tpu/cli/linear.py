@@ -12,10 +12,13 @@ import numpy as np
 
 from .common import (
     add_perf_args,
+    add_policy_args,
     add_telemetry_args,
     print_perf_report,
+    print_policy_report,
     print_telemetry_report,
     setup_perf,
+    setup_policy,
     setup_telemetry,
 )
 
@@ -26,7 +29,11 @@ def main(argv=None) -> int:
     p.add_argument("--solution", default="solution.npy")
     p.add_argument("--seed", type=int, default=38734)
     p.add_argument("--solver", default="accelerated",
-                   choices=["exact", "sketched", "accelerated", "lsrn"])
+                   choices=["exact", "sketched", "accelerated", "lsrn",
+                            "auto"],
+                   help="'auto' lets the adaptive policy route between "
+                        "sketch-and-solve, Blendenpik, LSRN, and exact "
+                        "from the profile store (docs/autotuning.md)")
     p.add_argument("--sparse", action="store_true")
     p.add_argument("--x64", action="store_true")
     p.add_argument("--shard", action="store_true",
@@ -71,6 +78,7 @@ def main(argv=None) -> int:
                         "hanging forever (default: no deadline, or "
                         "SKYLARK_COLLECTIVE_TIMEOUT_S)")
     add_perf_args(p)
+    add_policy_args(p)
     add_telemetry_args(p)
     args = p.parse_args(argv)
 
@@ -79,6 +87,7 @@ def main(argv=None) -> int:
     if args.x64:
         jax.config.update("jax_enable_x64", True)
     setup_perf(args)
+    setup_policy(args)  # after setup_perf: explicit --xla-cache-dir wins
     setup_telemetry(args)
     import jax.numpy as jnp
 
@@ -122,6 +131,7 @@ def main(argv=None) -> int:
     np.save(args.solution, x)
     print(f"Solution -> {args.solution}")
     print_perf_report(args)
+    print_policy_report(args)
     print_telemetry_report(args)
     return 0
 
@@ -201,6 +211,7 @@ def _stream_main(args) -> int:
     np.save(args.solution, x)
     print(f"Solution -> {args.solution}")
     print_perf_report(args)
+    print_policy_report(args)
     print_telemetry_report(args)
     return 0
 

@@ -15,8 +15,14 @@ import numpy as np
 
 from .common import (
     FILE_FORMATS,
+    add_perf_args,
+    add_policy_args,
     add_telemetry_args,
+    print_perf_report,
+    print_policy_report,
     print_telemetry_report,
+    setup_perf,
+    setup_policy,
     setup_telemetry,
 )
 
@@ -65,6 +71,8 @@ def main(argv=None) -> int:
     p.add_argument("--resume", action="store_true",
                    help="resume training from the newest valid checkpoint "
                         "in --checkpoint-dir")
+    add_perf_args(p)
+    add_policy_args(p)
     add_telemetry_args(p)
     args = p.parse_args(argv)
 
@@ -73,6 +81,8 @@ def main(argv=None) -> int:
     if args.x64:
         jax.config.update("jax_enable_x64", True)
     setup_telemetry(args)
+    setup_perf(args)
+    setup_policy(args)  # after setup_perf: explicit --xla-cache-dir wins
     import jax.numpy as jnp
 
     from ..core.context import SketchContext
@@ -212,6 +222,8 @@ def main(argv=None) -> int:
             )
             Xtj = Xt if is_sparse else jnp.asarray(Xt)
             print_test_metrics(model, Xtj, yt, args.regression)
+    print_perf_report(args)
+    print_policy_report(args)
     print_telemetry_report(args)
     return 0
 

@@ -20,7 +20,17 @@ import time
 
 import numpy as np
 
-from .common import add_telemetry_args, print_telemetry_report, setup_telemetry
+from .common import (
+    add_perf_args,
+    add_policy_args,
+    add_telemetry_args,
+    print_perf_report,
+    print_policy_report,
+    print_telemetry_report,
+    setup_perf,
+    setup_policy,
+    setup_telemetry,
+)
 
 
 def main(argv=None) -> int:
@@ -82,6 +92,8 @@ def main(argv=None) -> int:
         help="with --profile: stream row panels of this size instead of "
         "materializing A (memory-bounded; any M divisible by BLOCK_ROWS)",
     )
+    add_perf_args(p)
+    add_policy_args(p)
     add_telemetry_args(p)
     args = p.parse_args(argv)
 
@@ -90,6 +102,8 @@ def main(argv=None) -> int:
     if args.x64:
         jax.config.update("jax_enable_x64", True)
     setup_telemetry(args)
+    setup_perf(args)
+    setup_policy(args)  # after setup_perf: explicit --xla-cache-dir wins
     import jax.numpy as jnp
 
     from ..core.context import SketchContext
@@ -128,6 +142,8 @@ def main(argv=None) -> int:
         print(f"Rank-{args.rank} streaming SVD of {m}x{n} in {dt:.3f}s "
               f"({m // args.stream} panels; U factored, not saved)")
         print(f"Leading singular values: {np.asarray(s)[: min(5, len(s))]}")
+        print_perf_report(args)
+        print_policy_report(args)
         print_telemetry_report(args)
         return 0
 
@@ -196,6 +212,8 @@ def main(argv=None) -> int:
         print(f"Rank-{args.rank} symmetric SVD of {Ad.shape[0]}"
               f"x{Ad.shape[1]} in {dt:.3f}s")
         print(f"Leading eigenvalues: {np.asarray(lam)[: min(5, len(lam))]}")
+        print_perf_report(args)
+        print_policy_report(args)
         print_telemetry_report(args)
         return 0
 
@@ -220,6 +238,8 @@ def main(argv=None) -> int:
     write(".V", V)
     print(f"Rank-{args.rank} SVD of {U.shape[0]}x{V.shape[0]} in {dt:.3f}s")
     print(f"Leading singular values: {np.asarray(s)[: min(5, len(s))]}")
+    print_perf_report(args)
+    print_policy_report(args)
     print_telemetry_report(args)
     return 0
 

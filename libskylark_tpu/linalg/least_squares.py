@@ -123,6 +123,7 @@ def approximate_least_squares(
     params: LeastSquaresParams | None = None,
     alg: str = "qr",
     *,
+    route: str | None = None,
     fault_plan=None,
     return_info: bool = False,
 ):
@@ -142,7 +143,26 @@ def approximate_least_squares(
     attempt index).  With ``return_info=True`` returns ``(x, info)`` where
     ``info["recovery"]`` is the :class:`~libskylark_tpu.guard.
     RecoveryReport` dict (``guarded=False`` under ``SKYLARK_GUARD=0``).
+
+    Routing (``SKYLARK_POLICY``, on by default): the call consults
+    :func:`~libskylark_tpu.policy.choose_route` with the problem's
+    signature.  With no matured profile entry the decision is exactly the
+    defaults above (bit-parity contract, ``tests/test_policy.py``); a
+    matured entry may reroute to ``blendenpik``/``lsrn``/``exact``,
+    shrink the sketch dimension toward the smallest certified-OK size, or
+    sketch bf16-first (escalating back to the input dtype when attempt
+    0's certificate is not OK).  ``route`` pins the route explicitly
+    (one of ``"sketch"``, ``"blendenpik"``, ``"lsrn"``, ``"exact"``);
+    pinned ``params`` fields always win.  ``info["policy"]`` carries the
+    decision.
     """
+    from .. import policy
+    from ..policy.decide import LS_ROUTES
+
+    if route is not None and route not in LS_ROUTES:
+        raise ValueError(
+            f"unknown least-squares route {route!r}; one of {LS_ROUTES}"
+        )
     params = params or LeastSquaresParams()
     is_sparse = hasattr(A, "todense")
     if not is_sparse:
@@ -152,12 +172,63 @@ def approximate_least_squares(
     if squeeze:
         B = B[:, None]
     m, n = A.shape
-    s = params.sketch_size or min(4 * n, m)
-    stype = params.sketch_type or ("CWT" if is_sparse else "FJLT")
+    guard_on = guard.enabled() and not guard.is_traced(A, B)
+    decision = policy.consult(
+        "ls",
+        m=m,
+        n=n,
+        targets=B.shape[1],
+        dtype=(A.data.dtype.name if is_sparse else A.dtype.name),
+        sparse=is_sparse,
+        route=route,
+        sketch_type=params.sketch_type,
+        sketch_size=params.sketch_size,
+        guard_on=guard_on,
+    )
+    s = decision.sketch_size
+    stype = decision.sketch_type
+    default_size = min(4 * n, m)
+
+    # -- profile-learned reroutes (never taken on an empty store) ------------
+    if decision.route == "exact":
+        A_dense = A.todense() if is_sparse else A
+        X = exact_least_squares(A_dense, B, alg="svd")
+        report = (
+            guard.RecoveryReport(stage="sketch_and_solve_ls")
+            if guard_on
+            else guard.RecoveryReport.disabled("sketch_and_solve_ls")
+        )
+        if guard_on:
+            guard.check_finite(X, "exact_ls", report=report)
+        out = X[:, 0] if squeeze else X
+        info = {"recovery": report.to_dict(), "policy": decision.to_dict()}
+        policy.observe(decision, info, default_size=default_size)
+        telemetry.run_summary("sketch_and_solve_ls", info)
+        return (out, info) if return_info else out
+    if decision.route in ("blendenpik", "lsrn"):
+        from ..solvers.accelerated import (
+            FasterLeastSquaresParams,
+            faster_least_squares,
+            lsrn_least_squares,
+        )
+
+        fls = FasterLeastSquaresParams(sketch_type=params.sketch_type)
+        solver = (
+            faster_least_squares
+            if decision.route == "blendenpik"
+            else lsrn_least_squares
+        )
+        X, rinfo = solver(A, B, context, fls)
+        out = X[:, 0] if squeeze else X
+        info = dict(rinfo)
+        info["policy"] = decision.to_dict()
+        policy.observe(decision, info, default_size=default_size)
+        telemetry.run_summary("sketch_and_solve_ls", info)
+        return (out, info) if return_info else out
 
     # Under an enclosing jit trace the host-side certificate reads and
     # ladder control flow cannot run — emit the plain unguarded graph.
-    if not guard.enabled() or guard.is_traced(A, B):
+    if not guard_on:
         S = create_sketch(stype, m, s, context)
         # Plan-cached applies: repeated sketch-and-solve calls at the same
         # shape (parameter sweeps, restarts) reuse one fused executable.
@@ -169,39 +240,66 @@ def approximate_least_squares(
         out = X[:, 0] if squeeze else X
         if return_info:
             report = guard.RecoveryReport.disabled("sketch_and_solve_ls")
-            info = {"recovery": report.to_dict()}
+            info = {
+                "recovery": report.to_dict(),
+                "policy": decision.to_dict(),
+            }
             telemetry.run_summary("sketch_and_solve_ls", info)
             return out, info
         return out
 
-    def attempt(ctx, s_i, i):
-        S = create_sketch(stype, m, s_i, ctx)
-        SA = plans.apply(S, A, Dimension.COLUMNWISE)
-        SB = plans.apply(S, B, Dimension.COLUMNWISE)
-        if fault_plan is not None:
-            SA = fault_plan.corrupt_sketch(i, SA)
-        cert = guard.certify_sketch(SA, stage="sketch_and_solve_ls")
-        if not cert.ok:
-            return None, cert
-        X = exact_least_squares(SA, SB, alg=alg)
-        if not guard.tree_all_finite(X):
-            cert = replace(
-                cert,
-                verdict=guard.RESKETCH,
-                detail="non-finite small-problem solution",
-            )
-            return None, cert
-        return X, cert
+    def run_guarded(A_in, cast_solve):
+        """One trip up the guard ladder; ``cast_solve`` lifts the (narrow)
+        sketch output back to B's dtype before certification + solve (the
+        small s×n problem always solves at full precision)."""
 
-    def fallback():
-        A_dense = A.todense() if is_sparse else A
-        return exact_least_squares(A_dense, B, alg="svd")
+        def attempt(ctx, s_i, i):
+            S = create_sketch(stype, m, s_i, ctx)
+            SA = plans.apply(S, A_in, Dimension.COLUMNWISE)
+            SB = plans.apply(S, B, Dimension.COLUMNWISE)
+            if cast_solve:
+                SA = SA.astype(B.dtype)
+            if fault_plan is not None:
+                SA = fault_plan.corrupt_sketch(i, SA)
+            cert = guard.certify_sketch(SA, stage="sketch_and_solve_ls")
+            if not cert.ok:
+                return None, cert
+            X = exact_least_squares(SA, SB, alg=alg)
+            if not guard.tree_all_finite(X):
+                cert = replace(
+                    cert,
+                    verdict=guard.RESKETCH,
+                    detail="non-finite small-problem solution",
+                )
+                return None, cert
+            return X, cert
 
-    X, report = guard.run_ladder(
-        "sketch_and_solve_ls", context, s, m, attempt, fallback
-    )
+        def fallback():
+            A_dense = A.todense() if is_sparse else A
+            return exact_least_squares(A_dense, B, alg="svd")
+
+        return guard.run_ladder(
+            "sketch_and_solve_ls", context, s, m, attempt, fallback
+        )
+
+    bf16_note = None
+    if decision.compute_dtype == "bfloat16":
+        # bf16-first: the MXU-heavy sketch runs at bf16 (the
+        # f32-accumulable kernel entry points make it nearly free); the
+        # guard certificate checks the lifted sketch and a non-OK attempt
+        # 0 escalates the whole solve back to the input dtype.
+        X, report = run_guarded(A.astype(jnp.bfloat16), True)
+        attempts = report.to_dict().get("attempts") or []
+        ok0 = bool(attempts) and attempts[0].get("verdict") == guard.OK
+        if not ok0:
+            decision.escalated = True
+            bf16_note = "fail"
+            X, report = run_guarded(A, False)
+    else:
+        X, report = run_guarded(A, False)
     out = X[:, 0] if squeeze else X
-    info = {"recovery": report.to_dict()}
+    info = {"recovery": report.to_dict(), "policy": decision.to_dict()}
+    policy.observe(decision, info, default_size=default_size, bf16=bf16_note)
     telemetry.run_summary("sketch_and_solve_ls", info)
     if return_info:
         return out, info
@@ -246,13 +344,41 @@ def streaming_least_squares(
     folds only its own row range, and the merged ``(x, info)`` comes
     back identical on every rank (``docs/distributed_streaming.md``).
     """
-    from .. import streaming
+    from .. import policy, streaming
 
     params = params or LeastSquaresParams()
-    s = params.sketch_size or min(4 * ncols, nrows)
-    stype = params.sketch_type or ("CWT" if sparse else "JLT")
+    decision = policy.consult(
+        "ls_stream",
+        m=nrows,
+        n=ncols,
+        targets=targets,
+        dtype="float32",
+        sparse=sparse,
+        sketch_type=params.sketch_type,
+        sketch_size=params.sketch_size,
+        guard_on=guard.enabled(),
+    )
+    s = decision.sketch_size
+    stype = decision.sketch_type
     S = create_sketch(stype, nrows, s, context)
-    return streaming.sketch_least_squares(
+    # The decision rides INTO the driver so info["policy"] is present in
+    # the ledgered run_summary payload, not appended after it fired (the
+    # telemetry acceptance contract: ledgered info keys == returned info
+    # keys, and run_summary is the run's terminal ledger event).
+    x, info = streaming.sketch_least_squares(
         source, S, ncols=ncols, targets=targets, alg=alg,
         params=stream_params, fault_plan=fault_plan, partition=partition,
+        policy_decision=decision.to_dict(),
     )
+    seconds = info.get("seconds") or 0.0
+    policy.observe(
+        decision,
+        info,
+        default_size=min(4 * ncols, nrows),
+        rows_per_s=(info.get("rows", 0) / seconds) if seconds else None,
+        batches=info.get("batches"),
+    )
+    # The driver's own run_summary fired before this observation existed;
+    # flush again so the throughput lands in this run's profile write.
+    policy.flush("streaming_lsq", info)
+    return x, info

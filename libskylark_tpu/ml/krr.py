@@ -133,6 +133,14 @@ def approximate_kernel_ridge(
     (singular/indefinite-by-rounding regularized Gram) falls back to the
     eigh pseudoinverse solve, the coefficients pass a finiteness
     sentinel, and ``model.info["recovery"]`` records the attempts.
+
+    Policy (``SKYLARK_POLICY``, on by default): a matured profile entry
+    for this (backend, dtype, shape-class) may run the feature Gram
+    bf16-first (the MXU-heavy ops; ``_psd_gram`` still accumulates
+    exactly in f32), escalating back to the feature dtype when the bf16
+    attempt trips the guard fallback — the decision lands in
+    ``model.info["policy"]``.  With an empty store the solve is bitwise
+    identical to the unrouted library.
     """
     params = params or KrrParams()
     X = _maybe_sparse(X)
@@ -143,29 +151,65 @@ def approximate_kernel_ridge(
         return _solve_sketched_ridge(S, Z, Y2, lam, s, context, params)
     # Host-side sentinel reads cannot run under an enclosing jit trace.
     guarded = guard.enabled() and not guard.is_traced(Z, Y2)
-    report = (
-        guard.RecoveryReport(stage="approximate_krr")
-        if guarded
-        else guard.RecoveryReport.disabled("approximate_krr")
+    from .. import policy
+
+    decision = policy.consult(
+        "krr",
+        m=X.shape[0],
+        n=int(s),
+        targets=Y2.shape[1],
+        dtype=Z.dtype.name,
+        sparse=hasattr(X, "todense"),
+        guard_on=guarded,
     )
-    G = fully_replicated(_psd_gram(Z.T, Z) + lam * jnp.eye(s, dtype=Z.dtype))
-    # Factor/solve in _psd_gram's ≥f32 accumulator dtype; the model's
-    # coefficient dtype stays the feature dtype (API contract — bf16
-    # features must not silently return an f32 model).
-    c, low = cho_factor(G, lower=True)
-    if guarded and not guard.tree_all_finite(c):
-        W = guard.pinv_psd_solve(G, Z.T @ Y2).astype(Z.dtype)
-        report.record(
-            "fallback", verdict=guard.FALLBACK,
-            detail="non-finite Cholesky factor; eigh pseudoinverse solve",
+
+    def ridge_solve(Zs):
+        report = (
+            guard.RecoveryReport(stage="approximate_krr")
+            if guarded
+            else guard.RecoveryReport.disabled("approximate_krr")
         )
-        report.recovered = True
+        G = fully_replicated(
+            _psd_gram(Zs.T, Zs) + lam * jnp.eye(s, dtype=Zs.dtype)
+        )
+        # Factor/solve in _psd_gram's ≥f32 accumulator dtype; the model's
+        # coefficient dtype stays the feature dtype (API contract — bf16
+        # features must not silently return an f32 model).
+        c, low = cho_factor(G, lower=True)
+        fellback = False
+        if guarded and not guard.tree_all_finite(c):
+            W = guard.pinv_psd_solve(G, Zs.T @ Y2).astype(Zs.dtype)
+            report.record(
+                "fallback", verdict=guard.FALLBACK,
+                detail="non-finite Cholesky factor; eigh pseudoinverse solve",
+            )
+            report.recovered = True
+            fellback = True
+        else:
+            W = cho_solve((c, low), Zs.T @ Y2).astype(Zs.dtype)
+        if guarded:
+            guard.check_finite(W, "approximate_krr", report=report)
+        return W, report, fellback
+
+    bf16_note = None
+    if decision.compute_dtype == "bfloat16":
+        from ..utils.exceptions import NumericalHealthError
+
+        try:
+            W, report, fellback = ridge_solve(Z.astype(jnp.bfloat16))
+        except NumericalHealthError:
+            W, fellback = None, True
+        if fellback:
+            decision.escalated = True
+            bf16_note = "fail"
+            W, report, _ = ridge_solve(Z)
+        else:
+            W = W.astype(Z.dtype)
     else:
-        W = cho_solve((c, low), Z.T @ Y2).astype(Z.dtype)
-    if guarded:
-        guard.check_finite(W, "approximate_krr", report=report)
+        W, report, _ = ridge_solve(Z)
     model = FeatureMapModel([S], W)
-    model.info = {"recovery": report.to_dict()}
+    model.info = {"recovery": report.to_dict(), "policy": decision.to_dict()}
+    policy.observe(decision, model.info, bf16=bf16_note)
     telemetry.run_summary("approximate_krr", model.info)
     return model
 

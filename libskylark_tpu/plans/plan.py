@@ -246,6 +246,9 @@ def apply(S, A, dim: Dimension | str = Dimension.COLUMNWISE):
         A.dtype.name,
         _sharding_key(A),
     )
+    from .. import policy
+
+    policy.note_plan("apply", S, dim=dim.value, shape=A.shape, dtype=A.dtype.name)
     plan = PLAN_CACHE.get_or_build(
         key, lambda: SketchPlan(key, lambda A_: S.apply(A_, dim))
     )
@@ -319,6 +322,15 @@ def accumulate_slice(
         bool(fused),
         _kernel_env_token(),
     )
+    from .. import policy
+
+    policy.note_plan(
+        "slice",
+        S,
+        shape=(kb,) + tuple(block.shape[1:]),
+        dtype=block.dtype.name,
+        acc_dtype=acc.dtype.name,
+    )
 
     def build():
         if fused:
@@ -389,6 +401,11 @@ def apply_rowwise_bucketed(
         _sharding_key(block),
         bool(pad_out),
         spec is not None,
+    )
+    from .. import policy
+
+    policy.note_plan(
+        "rowwise", S, shape=block.shape, dtype=block.dtype.name
     )
 
     def build():

@@ -1,0 +1,82 @@
+"""Adaptive execution policy: telemetry-driven autotuning and routing.
+
+The decision layer that turns three passive layers — ``plans`` (what
+compiles cost), ``guard`` (what the certificates said), ``telemetry``
+(what the run ledger measured) — into a self-tuning runtime, ≙ the
+reference's ``algorithms/`` problem-tag dispatch upgraded to decide
+from measured evidence:
+
+- **Profiles** (:mod:`~libskylark_tpu.policy.profile`): per-(backend,
+  dtype, shape-class) summaries persisted to a JSON store under
+  ``SKYLARK_POLICY_DIR``, one CRC-guarded file per writer process,
+  merged last-writer-wins — the telemetry-ledger discipline applied to
+  learned state.  Written at ``run_summary`` time.
+- **Routing** (:mod:`~libskylark_tpu.policy.decide`): ``choose_route``
+  picks sketch family + dimension (shrinking toward the smallest
+  certified-OK size), solver route (sketch-and-solve vs Blendenpik vs
+  LSRN vs exact), and precision (bf16-first with guard certification,
+  f32 as the escalation rung).  Decisions are pure functions of
+  (profile, signature) — deterministic and identical on every rank of
+  an elastic world — and the empty-store decision is bitwise the
+  historical default.
+- **Warm start** (:mod:`~libskylark_tpu.policy.warmstart`): replay the
+  store's hot (sketch, signature) plan keys through the live
+  ``PlanCache`` and re-apply the persisted XLA compilation-cache dir
+  before first traffic, collapsing cold-start compile seconds.
+
+Consulted by ``linalg.approximate_least_squares`` /
+``streaming_least_squares``, ``ml.approximate_kernel_ridge``, and
+``solvers.solve_regression(solver="auto")``; gated by
+``SKYLARK_POLICY`` (default on — explicit ``route=`` / params overrides
+always win).  See ``docs/autotuning.md``.
+"""
+
+from .config import (
+    bf16_allowed,
+    configure,
+    enabled,
+    min_samples,
+    policy_dir,
+    warm_plans,
+)
+from .decide import Decision, ProblemSignature, choose_route
+from .profile import (
+    ProfileStore,
+    invalidate_cache,
+    load_entries,
+    profile_key,
+    shape_class,
+)
+from .record import (
+    consult,
+    flush,
+    note_plan,
+    observe,
+    recording_active,
+    reset,
+)
+from .warmstart import warm_start
+
+__all__ = [
+    "enabled",
+    "policy_dir",
+    "configure",
+    "min_samples",
+    "warm_plans",
+    "bf16_allowed",
+    "Decision",
+    "ProblemSignature",
+    "choose_route",
+    "ProfileStore",
+    "profile_key",
+    "shape_class",
+    "load_entries",
+    "invalidate_cache",
+    "consult",
+    "observe",
+    "note_plan",
+    "flush",
+    "recording_active",
+    "reset",
+    "warm_start",
+]

@@ -86,8 +86,14 @@ def solve_regression(
 ):
     """Dispatch ≙ the regression_solver_t specializations.
 
-    solver ∈ {"exact", "sketched", "accelerated", "lsrn"}.
+    solver ∈ {"exact", "sketched", "accelerated", "lsrn", "auto"}.
     Returns X (and (X, info) for iterative solvers).
+
+    ``"auto"`` hands the l2 route to the policy layer: the sketched
+    entrypoint consults :func:`~libskylark_tpu.policy.choose_route`
+    against the profile store (``SKYLARK_POLICY_DIR``) and a matured
+    entry may reroute to Blendenpik/LSRN/exact — with an empty store it
+    IS ``"sketched"`` (the historical default, bit-identical).
     """
     A = problem.A
     if problem.regularization == "ridge" and problem.lam > 0:
@@ -109,11 +115,22 @@ def solve_regression(
 
     if solver == "exact":
         return exact_least_squares(A, B, alg=alg)
+    if solver == "auto":
+        if context is None:
+            raise ValueError("auto solver needs a SketchContext")
+        # Route is left open: approximate_least_squares consults the
+        # policy layer and may land on sketch / blendenpik / lsrn / exact.
+        return approximate_least_squares(
+            A, B, context, params or LeastSquaresParams(), alg=alg
+        )
     if solver == "sketched":
         if context is None:
             raise ValueError("sketched solver needs a SketchContext")
+        # "sketched" means sketch-and-solve by name: pin the route so a
+        # matured profile cannot reroute it (that is "auto"'s privilege).
         return approximate_least_squares(
-            A, B, context, params or LeastSquaresParams(), alg=alg
+            A, B, context, params or LeastSquaresParams(), alg=alg,
+            route="sketch",
         )
     if solver == "accelerated":
         if context is None:

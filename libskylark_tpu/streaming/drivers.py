@@ -22,6 +22,8 @@ All three accept either an iterable or a re-openable factory
 
 from __future__ import annotations
 
+import time
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -196,10 +198,17 @@ def sketch_least_squares(
     params: StreamParams | None = None,
     fault_plan=None,
     partition=None,
+    policy_decision: dict | None = None,
 ):
     """Streaming sketch-and-solve least squares: accumulate the sketched
     system ``(S·A, S·b)`` over ``(A_block, b_block)`` batches in one
     pass, then solve the small (s, n) problem exactly.
+
+    ``policy_decision`` (the adaptive policy's
+    ``RouteDecision.to_dict()``, threaded down by
+    ``linalg.streaming_least_squares``) lands in ``info["policy"]``
+    *before* the terminal ``telemetry.run_summary`` — the ledgered
+    summary and the returned ``info`` must carry identical keys.
 
     ``partition`` (a :class:`~libskylark_tpu.streaming.RowPartition`)
     routes to the multi-host elastic path: each process of the
@@ -212,7 +221,8 @@ def sketch_least_squares(
     the sketch applies decomposed over row blocks — A never resident.
     ``S`` must be a LINEAR sketch (JLT/CT/CWT/SJLT/MMT/WZT/FJLT-free
     slices...); a feature map (RFT) would not preserve the LS geometry.
-    Returns ``(x, info)`` with ``info = {"rows", "batches", "recovery"}``;
+    Returns ``(x, info)`` with
+    ``info = {"rows", "batches", "seconds", "recovery"}``;
     ``info["recovery"]`` is the guard layer's recovery report (chunk
     replays, sketch certification, small-solve fallback — see
     ``docs/numerical_health.md``), ``{"guarded": False}``-shaped when
@@ -226,7 +236,7 @@ def sketch_least_squares(
         return distributed_sketch_least_squares(
             source, S, ncols=int(ncols), partition=partition,
             targets=targets, alg=alg, dtype=dtype, params=params,
-            fault_plan=fault_plan,
+            fault_plan=fault_plan, policy_decision=policy_decision,
         )
     params = params or StreamParams()
     dt = _result_dtype(dtype)
@@ -256,6 +266,7 @@ def sketch_least_squares(
         if guarded
         else guard.RecoveryReport.disabled("streaming_lsq")
     )
+    t0 = time.perf_counter()
     acc, nbatches = run_stream(
         source, step, init, params, kind="streaming_lsq",
         fault_plan=fault_plan, report=report,
@@ -288,8 +299,12 @@ def sketch_least_squares(
     if guarded:
         guard.check_finite(X, "streaming_lsq", report=report)
     x = X[:, 0] if targets == 1 else X
+    seconds = time.perf_counter() - t0
     info = {"rows": rows, "batches": nbatches,
+            "seconds": round(seconds, 6),
             "recovery": report.to_dict()}
+    if policy_decision is not None:
+        info["policy"] = policy_decision
     telemetry.run_summary("streaming_lsq", info)
     return x, info
 

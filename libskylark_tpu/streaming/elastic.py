@@ -778,6 +778,7 @@ def distributed_sketch_least_squares(
     params: ElasticParams | None = None,
     fault_plan=None,
     epoch: int = 0,
+    policy_decision: dict | None = None,
 ):
     """Distributed streaming sketch-and-solve least squares.
 
@@ -938,5 +939,11 @@ def distributed_sketch_least_squares(
         # epoch transition — "only the dead hosts' batches replayed".
         "replay": replay,
     }
+    if policy_decision is not None:
+        # Threaded down by linalg.streaming_least_squares so the ledgered
+        # run_summary and the returned info carry identical keys; the
+        # decision is deterministic given the (shared) profile store, so
+        # world-determinism of info is preserved when ranks share one.
+        info["policy"] = policy_decision
     telemetry.run_summary(kind, info)
     return x, info

@@ -3,7 +3,9 @@
 ``snapshot()`` is the single picture the four private status channels
 used to be: the registry's metrics plus ``plans.stats()``, the prefetch
 overlap ratio (from the counters the streaming engine folds in when a
-pass closes), and the guard / checkpoint counter groups.
+pass closes), and the guard / checkpoint / policy counter groups (the
+policy group covers decisions made, escalations, and profile
+hits/misses — ``docs/autotuning.md``).
 
 ``report()`` is the multi-process reduction, and deliberately REUSES
 ``utils.timer.timer_report``'s gather contract: with
@@ -50,6 +52,11 @@ def snapshot() -> dict:
         for k, v in counters.items()
         if k.startswith("checkpoint.")
     }
+    snap["policy"] = {
+        k.split(".", 1)[1]: v
+        for k, v in counters.items()
+        if k.startswith("policy.")
+    }
     return snap
 
 
@@ -62,7 +69,16 @@ def run_summary(name: str, info: dict | None = None, **attrs):
     a run carries the recovery ledger, the row/batch accounting, AND the
     registry + plan-cache counters to correlate them against.  Returns
     the event's ``seq`` (None when disabled).
+
+    This is also the policy layer's persistence point: pending profile
+    observations flush to the ``SKYLARK_POLICY_DIR`` store here — BEFORE
+    the telemetry gate, so profiles persist even with telemetry off
+    (``policy.flush`` is an allocation-free no-op when the policy layer
+    is disabled or storeless).
     """
+    from .. import policy
+
+    policy.flush(name, info)
     if not config.enabled():
         return None
     payload = dict(attrs)

@@ -51,6 +51,10 @@ CHAOS_TIMEOUT_S = 120
 # as traced jax ops, so a mis-sized grid or a runaway scalar loop
 # would otherwise stall the tier-1 run.
 KERNELS_TIMEOUT_S = 120
+# Adaptive-policy tests run real (small) guarded solves to mature
+# profile stores, plus subprocess determinism checks; a wedged store
+# merge or a hung subprocess must not stall the tier-1 run.
+POLICY_TIMEOUT_S = 120
 
 _TIMEOUT_MARKS = {
     "faults": FAULTS_TIMEOUT_S,
@@ -60,6 +64,7 @@ _TIMEOUT_MARKS = {
     "distributed_streaming": DISTRIBUTED_STREAMING_TIMEOUT_S,
     "chaos": CHAOS_TIMEOUT_S,
     "kernels": KERNELS_TIMEOUT_S,
+    "policy": POLICY_TIMEOUT_S,
 }
 
 
@@ -112,6 +117,12 @@ def pytest_configure(config):
         "kernels: Pallas kernel tests (window/flat scatter, fused "
         "stream chunks) in interpret mode on CPU CI; tier-1, guarded "
         f"by a per-test {KERNELS_TIMEOUT_S}s timeout",
+    )
+    config.addinivalue_line(
+        "markers",
+        "policy: adaptive execution-policy tests (profile store, routing "
+        "decisions, warm start, bit-parity contract); tier-1, guarded by "
+        f"a per-test {POLICY_TIMEOUT_S}s timeout",
     )
 
 

@@ -267,3 +267,34 @@ def test_disabled_telemetry_registers_no_atexit_hooks():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "ZERO-ATEXIT-OK" in out.stdout
+
+
+@pytest.mark.policy
+def test_snapshot_folds_policy_counter_group():
+    """Static contract check (ISSUE PR 9): ``telemetry.snapshot()`` must
+    fold the ``policy.*`` counters into a ``"policy"`` group, and the
+    terminal ``run_summary`` must flush the policy store BEFORE its own
+    enabled gate — profiles persist even with telemetry off."""
+    import importlib
+    import inspect
+
+    # the telemetry package exports a report() *function*; reach the
+    # module itself through importlib
+    report = importlib.import_module("libskylark_tpu.telemetry.report")
+
+    snap_src = inspect.getsource(report.snapshot)
+    assert '"policy"' in snap_src and "policy." in snap_src, (
+        "telemetry.snapshot() no longer folds the policy.* counter "
+        'group into snap["policy"] (docs/autotuning.md contract)'
+    )
+    rs_src = inspect.getsource(report.run_summary)
+    flush_at = rs_src.find("policy.flush")
+    gate_at = rs_src.find("config.enabled()")
+    assert flush_at != -1, (
+        "telemetry.run_summary() no longer flushes the policy profile "
+        "store (warm-start profiles would silently stop persisting)"
+    )
+    assert gate_at == -1 or flush_at < gate_at, (
+        "policy.flush must run before run_summary's telemetry-enabled "
+        "gate: profiles persist even with SKYLARK_TELEMETRY off"
+    )
