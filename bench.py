@@ -55,6 +55,23 @@ _FALLBACK_MARGIN_S = float(
     os.environ.get("SKYLARK_BENCH_FALLBACK_MARGIN_S", "120")
 )
 
+# Smoke mode (``SKYLARK_BENCH_SMOKE=1``): tiny dims and minimal pooling,
+# so a subprocess regression test can drive the WHOLE artifact path —
+# init, fallback, headline, final line — in seconds.  The numbers are
+# meaningless; the contract (valid JSON rows, no -1 when a CPU exists)
+# is what's under test.
+_SMOKE = os.environ.get("SKYLARK_BENCH_SMOKE") == "1"
+
+# Config filter (``SKYLARK_BENCH_ONLY=<substring>``): non-headline
+# configs whose name does not contain the substring emit an explicit
+# ``skipped: filter`` row instead of running.  The headline always runs
+# — the final-line artifact contract does not bend to the filter.
+_ONLY = os.environ.get("SKYLARK_BENCH_ONLY") or None
+
+
+def _selected(name: str) -> bool:
+    return _ONLY is None or _ONLY in name
+
 
 def _remaining() -> float:
     """Seconds left in the global bench budget."""
@@ -100,6 +117,10 @@ def _rep_diff(build, A, r1=4, r2=16, rounds=25, max_bursts=4) -> float:
     """
     global _LAST_CONTENTION
     _LAST_CONTENTION = None  # a failed config must not inherit a stale value
+    if _SMOKE:
+        # one burst, few rounds, small rep spread: enough that t2 > t1
+        # holds on a quiet CPU, cheap enough for a subprocess test
+        r1, r2, rounds, max_bursts = 2, 8, 3, 1
     args = A if isinstance(A, tuple) else (A,)
     f1, f2 = build(r1), build(r2)
     _timed(f1, *args), _timed(f2, *args)  # compile both
@@ -167,8 +188,16 @@ _TRANSIENT_TOKENS = ("UNAVAILABLE", "DEADLINE", "RESOURCE_EXHAUSTED")
 
 def _backend_died(e: BaseException) -> bool:
     """True when an exception looks like the accelerator backend dying
-    under us (as opposed to a bug in the config being benched)."""
-    return any(t in f"{type(e).__name__}: {e}" for t in _TRANSIENT_TOKENS)
+    under us (as opposed to a bug in the config being benched).  PJRT's
+    "Unable to initialize backend" wrapper counts too: a first jax op
+    that lazily initializes a dead plugin raises it WITHOUT any of the
+    gRPC tokens in some plugin versions, and treating it as a config bug
+    left the headline a -1 FAILED row on hosts with a healthy CPU."""
+    msg = f"{type(e).__name__}: {e}"
+    return (
+        "Unable to initialize backend" in msg
+        or any(t in msg for t in _TRANSIENT_TOKENS)
+    )
 
 
 def _emit(metric, value, unit, vs_baseline, table, contention="auto"):
@@ -206,6 +235,8 @@ def bench_jlt(on_tpu, table):
 
     if on_tpu:
         m, n, s, dtype = 262_144, 4096, 1024, jnp.bfloat16
+    elif _SMOKE:
+        m, n, s, dtype = 8_192, 512, 128, jnp.float32
     else:
         m, n, s, dtype = 16_384, 1024, 256, jnp.float32
 
@@ -788,6 +819,88 @@ def bench_admm(on_tpu, table):
     )
 
 
+def bench_serve(on_tpu, table):
+    """Serving SLO (docs/serving.md): sustained single-row QPS through
+    the cross-request coalescing server vs the SAME server pinned serial
+    (``max_coalesce=1``), for LS-solve and KRR-predict, with client-side
+    p50/p99 submetrics.  The coalescing claim is throughput-shaped — N
+    concurrent single-row requests ride ONE fused plan dispatch instead
+    of N — so the row to watch is the coalesced/serial QPS ratio
+    (``vs_baseline``; the SLO contract targets >= 3x)."""
+    import concurrent.futures as cf
+
+    from libskylark_tpu import serve
+    from libskylark_tpu.ml.kernels import GaussianKernel
+    from libskylark_tpu.ml.model import FeatureMapModel
+
+    m, n = (8192, 64) if on_tpu else (512, 16)
+    d, feats = 24, 64
+    total = 64 if _SMOKE else 256
+    workers = 16
+    rng = np.random.default_rng(11)
+    A = rng.standard_normal((m, n))
+    maps = [GaussianKernel(d, 1.3).create_rft(
+        feats, "regular", SketchContext(seed=31)
+    )]
+    model = FeatureMapModel(
+        maps, rng.standard_normal((feats, 4)), scale_maps=True
+    )
+    rhs = [rng.standard_normal(m) for _ in range(8)]
+    xs = [rng.standard_normal(d) for _ in range(8)]
+
+    def drive(make_req, max_coalesce):
+        params = serve.ServeParams(
+            max_coalesce=max_coalesce, max_queue=4 * total,
+            warm_start=False, prime=True,
+        )
+        srv = serve.Server(params, seed=13)
+        srv.registry.register_system(
+            "sys", A, context=SketchContext(seed=29)
+        )
+        srv.registry.register_model("mdl", model)
+        srv.start()
+
+        def one(i):
+            t0 = time.perf_counter()
+            r = srv.call(make_req(i))
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            if not r["ok"]:
+                raise RuntimeError(r["error"]["message"])
+            return dt_ms
+
+        with cf.ThreadPoolExecutor(max_workers=workers) as pool:
+            list(pool.map(one, range(workers)))  # warm every rung first
+            t0 = time.perf_counter()
+            lat = sorted(pool.map(one, range(total)))
+        wall = time.perf_counter() - t0
+        srv.stop()
+        return (
+            total / wall,
+            lat[len(lat) // 2],
+            lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+        )
+
+    cases = [
+        ("LS-solve",
+         lambda i: serve.make_request("ls_solve", system="sys",
+                                      b=rhs[i % len(rhs)])),
+        ("KRR-predict",
+         lambda i: serve.make_request("predict", model="mdl",
+                                      x=xs[i % len(xs)])),
+    ]
+    for op, mk in cases:
+        qps_s, p50_s, p99_s = drive(mk, 1)
+        qps_c, p50_c, p99_c = drive(mk, 32)
+        _emit(f"serve {op} serial QPS", qps_s, "req/s", 1.0, table,
+              contention=None)
+        _emit(f"serve {op} coalesced QPS", qps_c, "req/s", qps_c / qps_s,
+              table, contention=None)
+        _emit(f"serve {op} coalesced p50", p50_c, "ms", p50_s / p50_c,
+              table, contention=None)
+        _emit(f"serve {op} coalesced p99", p99_c, "ms", p99_s / p99_c,
+              table, contention=None)
+
+
 def bench_plan_cache(on_tpu, table):
     """Plan-cache cold vs warm: what one compiled sketch-apply plan costs
     to build (trace + compile + first exec) against what the cached
@@ -1141,6 +1254,10 @@ def _print_final() -> None:
     print(json.dumps(_FINAL), flush=True)
 
 
+class _FilteredOut(Exception):
+    """Control-flow marker: the config was deselected by SKYLARK_BENCH_ONLY."""
+
+
 class _BackendUnavailable:
     """Sentinel returned by :func:`_init_backend` when the init budget is
     exhausted; carries the last error string for the FAILED artifact."""
@@ -1225,6 +1342,43 @@ def _init_backend():
         delay = min(delay * 1.7, 60.0)
 
 
+def _reexec_cpu(reason: str) -> str | None:
+    """Replace this interpreter with a fresh ``JAX_PLATFORMS=cpu`` one —
+    the rescue of last resort when in-process recovery can't purge
+    poisoned plugin-registry state (clear_backends() brings the cached
+    init failure straight back).  The loop guard env var keeps a
+    genuinely CPU-less host from exec-looping, and the REMAINING global
+    budget rides along so the new process doesn't restart the clock.
+    Returns an error string ONLY if the exec could not happen (guard
+    tripped or execvpe itself failed); on success it never returns."""
+    if os.environ.get("SKYLARK_BENCH_CPU_REEXEC") == "1":
+        return "re-exec loop guard: already running as the cpu re-exec"
+    env = dict(os.environ)
+    env["SKYLARK_BENCH_CPU_REEXEC"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["SKYLARK_BENCH_BUDGET_S"] = str(round(max(60.0, _remaining()), 1))
+    print(
+        json.dumps(
+            {
+                "metric": "backend fallback re-exec",
+                "value": round(_remaining(), 1),
+                "unit": "s-remaining",
+                "vs_baseline": 0,
+                "error": reason[:500],
+            }
+        ),
+        file=sys.stderr,
+        flush=True,
+    )
+    sys.stderr.flush()
+    sys.stdout.flush()
+    try:
+        os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
+    except OSError as e:  # noqa: BLE001 — caller falls back to sentinel
+        return f"execvpe: {type(e).__name__}: {e}"
+    return None  # unreachable
+
+
 def _cpu_fallback(sentinel: _BackendUnavailable):
     """Accelerator init exhausted its retry budget: drop to host CPU so
     the round still records REAL numbers (tagged ``"backend":
@@ -1245,57 +1399,21 @@ def _cpu_fallback(sentinel: _BackendUnavailable):
     # the reason vanished into the truncated error field).
     errors: list[str] = []
     dev = None
-    for attempt in range(3):
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception as e:  # noqa: BLE001 — best-effort; env var rules
-            errors.append(f"config: {type(e).__name__}: {e}")
-        try:
-            import jax.extend.backend as _eb
-
-            _eb.clear_backends()  # drop the cached accelerator-init failure
-        except Exception as e:  # noqa: BLE001 — best-effort
-            errors.append(f"clear: {type(e).__name__}: {e}")
-        try:
-            dev = jax.devices("cpu")[0]
-            break
-        except Exception as e:  # noqa: BLE001 — retry; CPU init is local
-            errors.append(f"devices[{attempt}]: {type(e).__name__}: {e}")
-            time.sleep(2.0)
-    if dev is None and not was_cpu and (
-        os.environ.get("SKYLARK_BENCH_CPU_REEXEC") != "1"
+    if (
+        os.environ.get("SKYLARK_BENCH_SIM_POISON") == "1"
+        and os.environ.get("SKYLARK_BENCH_CPU_REEXEC") != "1"
     ):
-        # In-process rescue failed even though the host has a CPU: the
-        # plugin registry can hold poisoned state that clear_backends()
-        # cannot purge (the axon sitecustomize re-registers the plugin on
-        # every config update, so the cached init failure comes straight
-        # back).  Re-exec the interpreter with JAX_PLATFORMS=cpu so the
-        # fresh process never loads the broken plugin at all.  The loop
-        # guard keeps a genuinely CPU-less host from exec-looping, and
-        # the REMAINING global budget rides along so the new process
-        # doesn't restart the clock it already spent on init retries.
-        env = dict(os.environ)
-        env["SKYLARK_BENCH_CPU_REEXEC"] = "1"
-        env["SKYLARK_BENCH_BUDGET_S"] = str(round(max(60.0, _remaining()), 1))
-        print(
-            json.dumps(
-                {
-                    "metric": "backend fallback re-exec",
-                    "value": round(_remaining(), 1),
-                    "unit": "s-remaining",
-                    "vs_baseline": 0,
-                    "error": (sentinel.error + "; " + " | ".join(errors))[:500],
-                }
-            ),
-            file=sys.stderr,
-            flush=True,
-        )
-        sys.stderr.flush()
-        sys.stdout.flush()
-        try:
-            os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
-        except OSError as e:  # noqa: BLE001 — fall through to the sentinel
-            errors.append(f"execvpe: {type(e).__name__}: {e}")
+        # Test hook: pretend the in-process rescue cannot revive CPU
+        # (poisoned plugin registry), forcing the re-exec path below —
+        # the only way a regression test can exercise execvpe without a
+        # real broken plugin install.
+        errors.append("sim-poison: in-process cpu rescue suppressed")
+    else:
+        dev = _cpu_attempts(errors)
+    if dev is None and not was_cpu:
+        exec_err = _reexec_cpu(sentinel.error + "; " + " | ".join(errors))
+        if exec_err:
+            errors.append(exec_err)
     if dev is None:
         sentinel.error += "; cpu-fallback failed: " + " | ".join(errors)
         return sentinel
@@ -1315,6 +1433,28 @@ def _cpu_fallback(sentinel: _BackendUnavailable):
         flush=True,
     )
     return dev
+
+
+def _cpu_attempts(errors: list[str]):
+    """The in-process slice of the CPU rescue: three firewalled attempts
+    to re-point jax at host CPU.  Returns the device or ``None``."""
+    for attempt in range(3):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception as e:  # noqa: BLE001 — best-effort; env var rules
+            errors.append(f"config: {type(e).__name__}: {e}")
+        try:
+            import jax.extend.backend as _eb
+
+            _eb.clear_backends()  # drop the cached accelerator-init failure
+        except Exception as e:  # noqa: BLE001 — best-effort
+            errors.append(f"clear: {type(e).__name__}: {e}")
+        try:
+            return jax.devices("cpu")[0]
+        except Exception as e:  # noqa: BLE001 — retry; CPU init is local
+            errors.append(f"devices[{attempt}]: {type(e).__name__}: {e}")
+            time.sleep(2.0)
+    return None
 
 
 def main() -> None:
@@ -1384,7 +1524,16 @@ def main() -> None:
         init-exhausted branch.  Configs already measured keep their
         accelerator rows; the backend tag marks the switch point."""
         nonlocal on_tpu, peak
-        if _BACKEND_TAG is not None or not _backend_died(e):
+        if not _backend_died(e):
+            return False
+        if _BACKEND_TAG is not None:
+            # Already on the in-process CPU fallback and the backend
+            # STILL died: poisoned plugin-registry state survived
+            # clear_backends().  Escalate to the fresh-interpreter
+            # re-exec (loop-guarded — a process that already IS the
+            # re-exec gets the guard string back and degrades to a
+            # FAILED row instead of exec-looping).
+            _reexec_cpu(f"mid-run on fallback: {type(e).__name__}: {e}")
             return False
         dev2 = _cpu_fallback(
             _BackendUnavailable(f"mid-run: {type(e).__name__}: {e}")
@@ -1440,7 +1589,14 @@ def main() -> None:
     _FINAL = dict(headline_row, submetrics=table)
 
     try:
+        if not _selected("streaming KRR"):
+            raise _FilteredOut
         bench_streaming_krr(on_tpu, table)
+    except _FilteredOut:
+        _emit(
+            "streaming KRR (skipped: filter)", -1, "skipped", 0, table,
+            contention=None,
+        )
     except Exception as e:  # noqa: BLE001 — report, don't abort
         if _mid_run_rescue(e):
             try:
@@ -1478,6 +1634,10 @@ def main() -> None:
         # the round-9 warm-start contract (docs/autotuning.md) — plan
         # compile seconds with and without the profile-store replay.
         ("policy", 60, lambda: bench_policy(on_tpu, table)),
+        # Serving SLO rides with the never-captured rows: the round-10
+        # throughput contract (docs/serving.md) — coalesced vs serial
+        # QPS with p50/p99 for single-row LS-solve and KRR-predict.
+        ("serve SLO", 90, lambda: bench_serve(on_tpu, table)),
         # Elastic resume latency rides with them: the round-7
         # fault-tolerance measurement (docs/fault_tolerance.md), world=1
         # dry-run scale so it costs seconds, not minutes.
@@ -1502,6 +1662,12 @@ def main() -> None:
         ("ADMM", 160, lambda: bench_admm(on_tpu, table)),
     ]
     for name, est_s, fn in secondaries:
+        if not _selected(name):
+            _emit(
+                f"{name} (skipped: filter)", -1, "skipped", 0, table,
+                contention=None,
+            )
+            continue
         if on_tpu and _remaining() < 0.6 * est_s:
             _emit(
                 f"{name} (skipped: budget)", -1, "skipped", 0, table,

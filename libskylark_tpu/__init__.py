@@ -20,6 +20,7 @@ from . import (
     parallel,
     plans,
     resilient,
+    serve,
     sketch,
     solvers,
     streaming,
@@ -38,6 +39,7 @@ __all__ = [
     "parallel",
     "plans",
     "resilient",
+    "serve",
     "sketch",
     "solvers",
     "streaming",

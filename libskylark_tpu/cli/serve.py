@@ -1,0 +1,148 @@
+"""skylark-serve: the long-lived multi-tenant sketch-serving daemon.
+
+Front ends (both speak the exact ``serve/protocol.py`` JSON frames —
+the ``native/`` parity interchange; docs/serving.md has the schema):
+
+- default: JSON-lines stdio — one request per stdin line, one response
+  per stdout line, in order (inetd/systemd-socket style);
+- ``--http PORT``: loopback HTTP — ``POST /`` with one request object
+  or a list (a list is submitted concurrently and rides the
+  cross-request coalescer), ``GET /stats``, ``GET /healthz``.
+
+Warm state is loaded ONCE at startup: ``--model NAME=PATH`` JSON models
+(``ml/model.py`` save format) and ``--system NAME=PATH`` least-squares
+operators (``.npy``) become device-resident before the first request.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+from .common import (
+    add_perf_args,
+    add_policy_args,
+    add_telemetry_args,
+    print_perf_report,
+    print_policy_report,
+    print_telemetry_report,
+    setup_perf,
+    setup_policy,
+    setup_telemetry,
+)
+
+
+def _name_path(spec: str, flag: str) -> tuple[str, str]:
+    name, sep, path = spec.partition("=")
+    if not sep or not name or not path:
+        raise SystemExit(f"{flag} expects NAME=PATH, got {spec!r}")
+    return name, path
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="skylark-serve")
+    p.add_argument(
+        "--model", action="append", default=[], metavar="NAME=PATH",
+        help="register a saved model (ml/model.py JSON) under NAME; "
+             "repeatable",
+    )
+    p.add_argument(
+        "--system", action="append", default=[], metavar="NAME=PATH",
+        help="register a least-squares operator A (.npy, tall 2-D) "
+             "under NAME; its sketch + QR are precomputed at startup; "
+             "repeatable",
+    )
+    p.add_argument("--sketch-type", default="FJLT",
+                   help="sketch registry name for --system operators")
+    p.add_argument("--sketch-size", type=int, default=None,
+                   help="sketch rows for --system operators "
+                        "(default: min(m, max(4n, n+16)))")
+    p.add_argument("--seed", type=int, default=38734,
+                   help="server SketchContext seed (fresh-sketch requests "
+                        "reserve counters from it deterministically)")
+    p.add_argument("--http", type=int, default=None, metavar="PORT",
+                   help="serve HTTP on 127.0.0.1:PORT (0 picks a free "
+                        "port) instead of JSON-lines stdio")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="admission bound: requests beyond this depth are "
+                        "shed with code 112")
+    p.add_argument("--max-coalesce", type=int, default=16,
+                   help="max requests fused into one dispatch")
+    p.add_argument("--coalesce-window-ms", type=float, default=0.0,
+                   help="linger this long after the first request of a "
+                        "batch to let coalesce-mates arrive")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="default per-request deadline; requests whose "
+                        "queue wait exceeds it are shed with code 113")
+    p.add_argument("--no-prime", dest="prime", action="store_false",
+                   help="skip the startup priming dispatches that compile "
+                        "the first-rung executables before traffic")
+    p.add_argument("--x64", action="store_true")
+    add_perf_args(p)
+    add_policy_args(p)
+    add_telemetry_args(p)
+    args = p.parse_args(argv)
+
+    if args.x64:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+
+    setup_telemetry(args)
+    setup_perf(args)
+    setup_policy(args)  # warm-starts the process (plan + XLA cache replay)
+
+    from .. import serve
+    from ..core import SketchContext
+
+    params = serve.ServeParams(
+        max_queue=args.max_queue,
+        max_coalesce=args.max_coalesce,
+        coalesce_window_ms=args.coalesce_window_ms,
+        default_deadline_ms=args.deadline_ms,
+        warm_start=False,  # setup_policy above already replayed
+        prime=args.prime,
+    )
+    server = serve.Server(params, seed=args.seed)
+    for spec in args.model:
+        name, path = _name_path(spec, "--model")
+        server.registry.load_model(name, path)
+        print(f"model {name!r} <- {path}", file=sys.stderr)
+    for spec in args.system:
+        name, path = _name_path(spec, "--system")
+        A = np.load(path)
+        server.registry.register_system(
+            name, A,
+            context=SketchContext(seed=args.seed + 1),
+            sketch_type=args.sketch_type,
+            sketch_size=args.sketch_size,
+        )
+        print(f"system {name!r} <- {path} {A.shape}", file=sys.stderr)
+
+    server.start()
+    try:
+        if args.http is not None:
+            httpd = serve.serve_http(server, port=args.http)
+            host, port = httpd.server_address[:2]
+            print(f"serving http://{host}:{port}", file=sys.stderr)
+            try:
+                httpd.serve_forever()
+            except KeyboardInterrupt:
+                pass
+            finally:
+                httpd.shutdown()
+        else:
+            served = serve.serve_stdio(server, sys.stdin, sys.stdout)
+            print(f"served {served} requests", file=sys.stderr)
+    finally:
+        server.stop()
+        print_perf_report(args)
+        print_policy_report(args)
+        print_telemetry_report(args)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -31,6 +31,40 @@ __all__ = ["FeatureMapModel", "KernelModel", "load_model"]
 _SERIAL_VERSION = 2  # tracks sketch.base.SERIAL_VERSION (stream revision)
 
 
+def _json_info(info):
+    """Best-effort JSON image of a model's ``info`` dict (the recovery /
+    policy ledgers attached by the training entrypoints).  Non-JSON
+    leaves degrade to ``str`` rather than dropping the whole ledger."""
+    if info is None:
+        return None
+    return json.loads(json.dumps(info, default=str))
+
+
+def _dtype_from_name(name):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        # Extension dtypes (bfloat16, float8_*) register with numpy only
+        # through ml_dtypes (a jax dependency) — resolve by attribute.
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _restore_dtype(arr, name):
+    """Undo the ``.npy`` container's extension-dtype erasure: ``np.save``
+    writes bfloat16 (and friends) as raw 2-byte void records, and
+    ``np.load`` hands back dtype ``|V2`` — unusable in any arithmetic.
+    The saved dtype name rides the model JSON; same-width void arrays
+    are re-viewed (bit-exact), anything else is a plain cast."""
+    if not name or str(arr.dtype) == name:
+        return arr
+    dt = _dtype_from_name(name)
+    if arr.dtype.kind == "V" and arr.dtype.itemsize == dt.itemsize:
+        return arr.view(dt)
+    return arr.astype(dt)
+
+
 class FeatureMapModel:
     """Coefficients W over concatenated feature-map outputs.
 
@@ -50,6 +84,9 @@ class FeatureMapModel:
         self.classes = None if classes is None else list(
             np.asarray(classes).tolist()
         )
+        # Training ledger (info["recovery"], info["policy"]) attached by
+        # the solver entrypoints; persists through save/load.
+        self.info = None
 
     def features(self, X):
         """Concatenated (n, D) feature matrix for X (n, d); BCOO inputs
@@ -95,6 +132,8 @@ class FeatureMapModel:
                         else np.asarray(self.classes).tolist()),
             "maps": [S.to_dict() for S in self.maps],
             "coef_shape": list(self.W.shape),
+            "coef_dtype": str(self.W.dtype),
+            "info": _json_info(self.info),
         }
 
     def save(self, path: str):
@@ -111,10 +150,12 @@ class FeatureMapModel:
             d = json.load(f)
         if d.get("model_type") != "feature_map":
             raise ValueError(f"not a feature_map model: {d.get('model_type')}")
-        W = np.load(cls._coef_path(path))
+        W = _restore_dtype(np.load(cls._coef_path(path)), d.get("coef_dtype"))
         maps = [sketch_from_dict(md) for md in d["maps"]]
-        return cls(maps, jnp.asarray(W), scale_maps=d.get("scale_maps", False),
-                   input_dim=d.get("input_dim"), classes=d.get("classes"))
+        model = cls(maps, jnp.asarray(W), scale_maps=d.get("scale_maps", False),
+                    input_dim=d.get("input_dim"), classes=d.get("classes"))
+        model.info = d.get("info")
+        return model
 
     @staticmethod
     def _coef_path(path):
@@ -156,6 +197,11 @@ class KernelModel:
             "classes": (None if self.classes is None
                         else np.asarray(self.classes).tolist()),
             "kernel": self.kernel.to_dict(),
+            "data_dtypes": {
+                "X_train": str(self.X_train.dtype),
+                "A": str(self.A.dtype),
+            },
+            "info": _json_info(self.info),
         }
         with open(path, "w") as f:
             json.dump(d, f, indent=1)
@@ -174,12 +220,15 @@ class KernelModel:
         if d.get("model_type") != "kernel":
             raise ValueError(f"not a kernel model: {d.get('model_type')}")
         data = np.load(os.fspath(path) + ".data.npz")
-        return cls(
+        dtypes = d.get("data_dtypes") or {}
+        model = cls(
             kernel_from_dict(d["kernel"]),
-            jnp.asarray(data["X_train"]),
-            jnp.asarray(data["A"]),
+            jnp.asarray(_restore_dtype(data["X_train"], dtypes.get("X_train"))),
+            jnp.asarray(_restore_dtype(data["A"], dtypes.get("A"))),
             classes=d.get("classes"),
         )
+        model.info = d.get("info")
+        return model
 
 
 _MODEL_TYPES = {

@@ -11,7 +11,7 @@ The perf layer between the sketch transforms and their consumers (see
 - ``SKYLARK_NO_PLANS=1`` turns the whole layer into a pass-through.
 """
 
-from .bucketing import bucket_ladder, bucket_rows, pad_rows
+from .bucketing import bucket_for, bucket_ladder, bucket_rows, pad_rows
 from .cache import PLAN_CACHE, clear, reset, reset_stats, set_cache_size, stats
 from .plan import (
     SketchPlan,
@@ -30,6 +30,7 @@ __all__ = [
     "apply",
     "accumulate_slice",
     "apply_rowwise_bucketed",
+    "bucket_for",
     "bucket_ladder",
     "bucket_rows",
     "pad_rows",

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["bucket_ladder", "bucket_rows", "pad_rows"]
+__all__ = ["bucket_for", "bucket_ladder", "bucket_rows", "pad_rows"]
 
 _BASES = (8, 12)
 _MAX_RUNG = 1 << 30
@@ -49,25 +49,42 @@ def bucket_ladder(max_rows: int | None = None) -> tuple[int, ...]:
     return tuple(out)
 
 
-def bucket_rows(k: int, gates: tuple[int, ...] = ()) -> int:
-    """Smallest rung >= ``k``.
+def bucket_for(n: int, gates: tuple[int, ...] = ()) -> int:
+    """Smallest rung >= ``n`` — THE public ladder lookup.
+
+    Every consumer that needs "which executable shape does a batch of
+    ``n`` land on" (the streaming placer, the planned apply paths, the
+    serve layer's cross-request coalescer) asks here, so the rung set
+    and its gate semantics live in exactly one place.
 
     ``gates`` are batch-size thresholds at which a transform switches
     algorithms (e.g. the hash sketches' one-hot-vs-scatter gate at 16
-    rows): when padding ``k`` up to the rung would cross a gate, the
+    rows): when padding ``n`` up to the rung would cross a gate, the
     batch is left unpadded so the planned batch takes the same algorithm
     — and produces the same bits — as the eager ragged apply.  The few
     in-between sizes cost one extra executable each, bounded by the gate
     count.
     """
-    k = int(k)
-    if k <= 0:
-        raise ValueError(f"bucket_rows needs a positive row count, got {k}")
-    kb = k if k > _MAX_RUNG else min(r for r in bucket_ladder() if r >= k)
+    n = int(n)
+    if n <= 0:
+        raise ValueError(f"bucket_for needs a positive row count, got {n}")
+    nb = n if n > _MAX_RUNG else min(r for r in bucket_ladder() if r >= n)
     for g in gates:
-        if k < g <= kb:
-            return k
-    return kb
+        if n < g <= nb:
+            return n
+    return nb
+
+
+def bucket_rows(k: int, gates: tuple[int, ...] = ()) -> int:
+    """Historical alias of :func:`bucket_for` (the streaming engine grew
+    it first under this name; kept so pre-serve callers don't churn).
+
+    ``gates`` are batch-size thresholds at which a transform switches
+    algorithms (e.g. the hash sketches' one-hot-vs-scatter gate at 16
+    rows): when padding ``k`` up to the rung would cross a gate, the
+    batch is left unpadded so the planned batch takes the same algorithm
+    """
+    return bucket_for(k, gates)
 
 
 def pad_rows(block, kb: int):

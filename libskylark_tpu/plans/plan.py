@@ -42,7 +42,7 @@ import jax.numpy as jnp
 
 from .. import telemetry
 from ..sketch.base import Dimension
-from .bucketing import bucket_rows, pad_rows
+from .bucketing import bucket_for, pad_rows
 from .cache import PLAN_CACHE
 
 __all__ = [
@@ -303,7 +303,7 @@ def accumulate_slice(
             block = block[:true_rows]
         part = S.apply_slice(block, int(start), Dimension.COLUMNWISE)
         return acc + part.astype(acc.dtype)
-    kb = bucket_rows(k)
+    kb = bucket_for(k)
     block = pad_rows(block, kb)
     if donate is None:
         donate = donation_enabled()
@@ -384,7 +384,7 @@ def apply_rowwise_bucketed(
         Z = S.apply_with_operands(ops, block, Dimension.ROWWISE)
         return (Z, k) if pad_out else Z
     gates = getattr(S, "batch_size_gates", ())
-    kb = bucket_rows(k, gates)
+    kb = bucket_for(k, gates)
     if block.shape[0] not in (k, kb):
         # Host-side padding that disagrees with this transform's gates
         # (e.g. a generic placer padding a thin hash batch): recover the
@@ -446,7 +446,7 @@ def donating_jit(fn, donate_argnums: tuple = ()):
 def pad_rows_to_bucket(block, gates: tuple = ()):
     """Convenience: ``(padded_block, true_rows)`` on the ladder."""
     k = int(block.shape[0])
-    return pad_rows(block, bucket_rows(k, gates)), k
+    return pad_rows(block, bucket_for(k, gates)), k
 
 
 def copy_for_donation(tree):

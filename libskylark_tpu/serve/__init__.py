@@ -1,0 +1,61 @@
+"""High-throughput sketch-serving: cross-request coalescing onto warm plans.
+
+The layer that makes the plan cache (``plans/``), policy warm start
+(``policy/``), and fused kernels pay rent under "millions of users"
+traffic (ROADMAP north-star): a long-lived, multi-tenant in-process
+solve service whose hot path coalesces concurrent requests that hash to
+the same (serialized sketch, abstract signature) key into ONE padded,
+plan-compiled dispatch — N single-row requests cost one executable
+launch instead of N — then de-pads and fans the results back out,
+bit-identical per request to serving them one at a time.
+
+Layout (see ``docs/serving.md``):
+
+- :mod:`.protocol` — the JSON frames (native-parity interchange);
+- :mod:`.admission` — bounded queue, depth/deadline shedding
+  (error codes 112/113 on the ``utils.exceptions`` ladder);
+- :mod:`.registry` — models + LS systems, loaded once, device-resident;
+- :mod:`.batcher` — the coalescing executors + solo-retry fault
+  isolation (code-108 structured degradation, batch-mates unaffected);
+- :mod:`.server` — the worker loop, warm start, telemetry;
+- :mod:`.transport` / :mod:`.client` — stdio + HTTP loopback fronts and
+  the Python client (``skylark-serve`` is the CLI wrapper).
+"""
+
+from .admission import AdmissionQueue, Entry
+from .client import Client
+from .protocol import (
+    decode,
+    encode,
+    error_payload,
+    error_response,
+    exception_for,
+    make_request,
+    ok_response,
+    raise_for_error,
+)
+from .registry import LSSystem, Registry
+from .server import ServeParams, Server, latency_percentiles, record_latency
+from .transport import serve_http, serve_stdio
+
+__all__ = [
+    "AdmissionQueue",
+    "Client",
+    "Entry",
+    "LSSystem",
+    "Registry",
+    "ServeParams",
+    "Server",
+    "decode",
+    "encode",
+    "error_payload",
+    "error_response",
+    "exception_for",
+    "latency_percentiles",
+    "make_request",
+    "ok_response",
+    "raise_for_error",
+    "record_latency",
+    "serve_http",
+    "serve_stdio",
+]

@@ -1,0 +1,133 @@
+"""Bounded admission queue: depth-capped FIFO with key-aware batch take.
+
+Admission control happens at the door (``offer``): a full queue rejects
+with :class:`~libskylark_tpu.utils.exceptions.AdmissionError` (code 112)
+instead of queueing unboundedly — under overload the tail latency of
+everything already admitted stays bounded, and shed requests carry a
+structured error their caller can back off on.
+
+Deadline shedding happens at *dispatch* (the server checks each taken
+entry's absolute deadline before executing): an expired request never
+burns device work, and its :class:`DeadlineExceededError` (code 113)
+carries how long it actually waited.
+
+``take_batch`` is the coalescing half: it removes the head-of-line entry
+plus every queued entry with the SAME coalesce key (FIFO order
+preserved) up to ``max_coalesce`` — requests for different plans never
+block each other's batch, and one hot key cannot starve others beyond
+its single batch per take.  Counter reservations for fresh-sketch
+requests run inside ``offer``'s lock (the ``on_admit`` callback), so the
+reservation order IS the admission order — deterministic and
+replayable regardless of how batches later form.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from ..utils.exceptions import AdmissionError
+
+__all__ = ["Entry", "AdmissionQueue"]
+
+
+class Entry:
+    """One admitted request riding the queue."""
+
+    __slots__ = (
+        "request", "future", "key", "op", "payload", "squeeze",
+        "t_admit", "deadline", "sketch", "counter_base", "trace",
+    )
+
+    def __init__(self, request, future, key, op, payload=None):
+        self.request = request
+        self.future = future
+        self.key = key
+        self.op = op
+        self.payload = payload
+        self.squeeze = False
+        self.t_admit = None
+        self.deadline = None
+        self.sketch = None
+        self.counter_base = None
+        self.trace = {"events": []}
+
+
+class AdmissionQueue:
+    def __init__(self, max_depth: int):
+        self.max_depth = int(max_depth)
+        self._q: deque[Entry] = deque()
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def offer(self, entry: Entry, on_admit=None) -> None:
+        """Admit or shed.  ``on_admit(entry)`` runs under the queue lock
+        after the depth check passes — the admission-ordered side effect
+        slot (fresh-sketch counter reservation)."""
+        with self._cond:
+            if self._closed:
+                raise AdmissionError("serve queue is shut down")
+            depth = len(self._q)
+            if depth >= self.max_depth:
+                raise AdmissionError(
+                    f"serve queue full ({depth}/{self.max_depth})",
+                    queue_depth=depth,
+                    max_depth=self.max_depth,
+                )
+            entry.t_admit = time.monotonic()
+            if on_admit is not None:
+                on_admit(entry)
+            self._q.append(entry)
+            self._cond.notify()
+
+    def _take_same_key(self, batch, max_coalesce):
+        key = batch[0].key
+        keep = deque()
+        while self._q and len(batch) < max_coalesce:
+            e = self._q.popleft()
+            if e.key == key:
+                batch.append(e)
+            else:
+                keep.append(e)
+        keep.extend(self._q)
+        self._q = keep
+
+    def take_batch(self, max_coalesce: int, window_s: float = 0.0):
+        """Block for work; return the head entry + all same-key entries
+        (up to ``max_coalesce``), or ``None`` once closed and drained.
+        ``window_s`` > 0 lingers briefly for same-key arrivals when the
+        batch is not yet full — latency traded for fuller batches."""
+        with self._cond:
+            while not self._q:
+                if self._closed:
+                    return None
+                self._cond.wait(timeout=0.1)
+            batch = [self._q.popleft()]
+            self._take_same_key(batch, max_coalesce)
+            if window_s > 0:
+                end = time.monotonic() + window_s
+                while len(batch) < max_coalesce and not self._closed:
+                    left = end - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cond.wait(timeout=left)
+                    self._take_same_key(batch, max_coalesce)
+            return batch
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def drain(self):
+        """Remove and return every queued entry (shutdown path)."""
+        with self._cond:
+            out = list(self._q)
+            self._q.clear()
+            return out

@@ -1,0 +1,267 @@
+"""Cross-request coalescing executors: N requests, one fused dispatch.
+
+A batch arrives here already grouped by coalesce key (same registered
+entity, same serialized sketch, same input signature — see
+``admission.take_batch``).  The executor stacks the requests' payloads,
+pads the stacked block up to the ``plans/bucketing.py`` geometric
+ladder, runs ONE planned ``SketchPlan`` call (plus one small jitted
+solve / matmul keyed on the same rung), then de-pads and fans results
+back out to the per-request futures.
+
+Bitwise isolation contract: every executor below is built exclusively
+from per-slot-pure operations — sketch applies (COLUMNWISE columns and
+ROWWISE rows are independent by the transform contract), matmuls and
+triangular solves whose output elements reduce only over the
+contraction dimension, and elementwise maps.  One subtlety makes this
+an engineering property rather than a free one: XLA's CPU gemm lowers
+REMAINDER columns (a batch width that is not a multiple of the vector
+tile) through a different micro-kernel with a different accumulation
+schedule, so a column's bits can depend on which tile class its slot
+landed in.  Columnwise dispatch widths are therefore restricted to the
+lane-uniform sub-ladder (:func:`_lane_bucket` — every rung a multiple
+of the base rung 8, i.e. the geometric ladder minus its lone 12-wide
+rung); rowwise blocks are safe on the full ladder because rows are
+never the contraction dimension.  Under that restriction a request's
+result is bit-identical whatever batch it rode in: alone (padded to
+the first rung), coalesced with 7 strangers, or on a different rung
+entirely.  ``tests/test_serve.py`` pins this for LS-solve and
+KRR-predict against the serial one-request-at-a-time path, across a
+rung boundary.
+
+Fault isolation: after every batch the per-request results are probed
+finite.  A failing request (or a batch-wide exception in a >1 batch)
+is re-run SOLO through the same executor — the serve-side recovery
+ladder rung — and only if the solo run still fails does that request
+get a structured ``NumericalHealthError`` (code 108) response; its
+batch-mates keep their (bit-unaffected) results.  Every retry/fallback
+lands in the request's ``trace["events"]``.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import plans, telemetry
+from ..utils.exceptions import NumericalHealthError, SkylarkError
+from . import protocol
+
+__all__ = ["run_batch"]
+
+
+@jax.jit
+def _qr_solve(Qt, R, SB):
+    """x̂ = R⁻¹ Qᵀ S b per column — the sketch-and-solve normal step."""
+    from jax.scipy.linalg import solve_triangular
+
+    return solve_triangular(R, Qt @ SB, lower=False)
+
+
+@jax.jit
+def _matmul(Z, W):
+    return Z @ W
+
+
+def _lane_bucket(k: int) -> int:
+    """Smallest ladder rung >= k that is a multiple of the base rung (8).
+
+    Coalesced COLUMNWISE widths must keep every request slot inside a
+    full vector tile: XLA's CPU gemm lowers remainder columns through a
+    different micro-kernel, so slots 8-11 of the 12-wide rung are NOT
+    bit-equal to the same column served solo.  Every other rung on the
+    ladder is a multiple of 8, so skipping the lone 12-wide rung (and
+    rounding over-ladder widths up to a multiple of 8) restores per-slot
+    purity.  Rowwise blocks don't need this: rows are never the
+    contraction dimension, and ``tests/test_serve.py`` pins both facts.
+    """
+    kb = plans.bucket_for(k)
+    while kb % 8:
+        kb = plans.bucket_for(kb + 1)
+    return kb
+
+
+def _pad_cols(Bt: np.ndarray) -> tuple[np.ndarray, int]:
+    """(k, m) stacked RHS rows -> transposed (m, kb) bucket block."""
+    kb = _lane_bucket(Bt.shape[0])
+    Bp = plans.pad_rows(Bt, kb)
+    return np.ascontiguousarray(Bp.T), kb
+
+
+def _execute_ls(registry, entries):
+    system = registry.get_system(entries[0].request["system"])
+    S = entries[0].sketch or system.S
+    Bt = np.stack([e.payload for e in entries])  # (k, m)
+    B, kb = _pad_cols(Bt)  # (m, kb)
+    if entries[0].sketch is not None:
+        # fresh-sketch slow path: the factorization is per-request
+        SA = plans.apply(S, system.A, "columnwise")
+        Q, R = jnp.linalg.qr(SA)
+        Qt = jnp.asarray(Q).T
+    else:
+        Qt, R = system.Qt, system.R
+    SB = plans.apply(S, jnp.asarray(B, system.A.dtype), "columnwise")
+    X = np.asarray(_qr_solve(Qt, R, SB))  # (n, kb)
+    return [X[:, i] for i in range(len(entries))], kb
+
+
+def _feature_map_predict(model, Xp, true_rows):
+    """model.features + the coefficient matmul, planned and SHAPE-STABLE:
+    ``Xp`` arrives padded to the rung, every map rides
+    ``apply_rowwise_bucketed(pad_out=True)`` (padded rows zeroed inside
+    the executable), and the concat + matmul are keyed on the rung shape
+    alone.  Shape stability is the latency contract: if any step here
+    saw the RAW batch size, every distinct coalesce width would compile
+    a fresh executable mid-traffic and stall the single worker queue —
+    ``Server.prime`` can only pre-compile rung shapes."""
+    kb = Xp.shape[0]
+    blocks = []
+    for S in model.maps:
+        Z, _ = plans.apply_rowwise_bucketed(
+            S, Xp, true_rows=true_rows, pad_out=True
+        )
+        if Z.shape[0] != kb:
+            # gate-mismatched map (its own rung ladder) or plans-off
+            # bypass: re-align to the batch rung off the hot path
+            Z = jnp.asarray(plans.pad_rows(Z[:true_rows], kb))
+        if model.scale_maps:
+            Z = Z * jnp.asarray(np.sqrt(Z.shape[-1] / Xp.shape[-1]), Z.dtype)
+        blocks.append(Z)
+    Z = jnp.concatenate(blocks, axis=-1) if blocks else jnp.asarray(Xp)
+    O = _matmul(Z, model.W.astype(Z.dtype))
+    return np.asarray(O)[:true_rows]
+
+
+def _kernel_jit(registry, name, model):
+    fn = registry.model_jits.get(name)
+    if fn is None:
+        def gram_predict(X):
+            return model.kernel.gram(X, model.X_train) @ model.A
+
+        fn = jax.jit(gram_predict)
+        registry.model_jits[name] = fn
+    return fn
+
+
+def _execute_predict(registry, entries):
+    name = entries[0].request["model"]
+    model = registry.get_model(name)
+    X = np.concatenate([e.payload for e in entries])  # (R, d)
+    R_tot = X.shape[0]
+    kb = plans.bucket_for(R_tot)
+    if hasattr(model, "maps"):
+        Xp = plans.pad_rows(X, kb)
+        O = _feature_map_predict(model, Xp, true_rows=R_tot)
+    else:
+        Xp = plans.pad_rows(X, kb)
+        O = np.asarray(_kernel_jit(registry, name, model)(jnp.asarray(Xp)))
+        O = O[:R_tot]
+    outs, at = [], 0
+    for e in entries:
+        r = e.payload.shape[0]
+        outs.append(O[at:at + r])
+        at += r
+    return outs, kb
+
+
+_EXECUTORS = {"ls_solve": _execute_ls, "predict": _execute_predict}
+
+
+def _decode(entry, out):
+    """Per-request post-processing AFTER the finite probe: label decode
+    for classification predicts, squeeze for single-row requests."""
+    if entry.op == "predict" and entry.request.get("labels"):
+        # classes snapshot onto the request at admission (server side)
+        classes = entry.request.get("_classes")
+        idx = np.argmax(out, axis=-1)
+        out = np.asarray(classes)[idx] if classes is not None else idx
+    if entry.squeeze and getattr(out, "ndim", 0) > 0 and entry.op == "predict":
+        out = out[0]
+    return out
+
+
+def _finish_ok(entry, out, batch_size, bucket, t_exec_ms):
+    entry.trace.update(
+        batch_size=batch_size,
+        bucket=bucket,
+        coalesced=batch_size > 1,
+        exec_ms=round(t_exec_ms, 4),
+    )
+    if entry.counter_base is not None:
+        entry.trace["counter_base"] = entry.counter_base
+    telemetry.inc("serve.ok")
+    entry.future.set_result(
+        protocol.ok_response(entry.request.get("id"), out, entry.trace)
+    )
+
+
+def _finish_error(entry, exc, batch_size):
+    entry.trace.update(batch_size=batch_size, coalesced=batch_size > 1)
+    entry.future.set_result(
+        protocol.error_response(entry.request.get("id"), exc, entry.trace)
+    )
+
+
+def run_batch(registry, entries) -> None:
+    """Execute one coalesced batch; every entry's future is resolved by
+    the time this returns (ok, degraded-solo, or structured error)."""
+    executor = _EXECUTORS[entries[0].op]
+    n = len(entries)
+    t0 = time.perf_counter()
+    try:
+        outs, bucket = executor(registry, entries)
+    except Exception as e:  # noqa: BLE001 — isolate, then solo-retry
+        if n == 1:
+            telemetry.inc("serve.errors")
+            if not isinstance(e, SkylarkError):
+                telemetry.event("serve", "batch_error", {"type": type(e).__name__})
+            entries[0].trace["events"].append(
+                {"kind": "error", "type": type(e).__name__}
+            )
+            _finish_error(entries[0], e, n)
+            return
+        # a poisoned batch: re-run each request alone so one bad payload
+        # cannot take its batch-mates down with it
+        telemetry.inc("serve.fallbacks")
+        for e2 in entries:
+            e2.trace["events"].append(
+                {"kind": "fallback", "reason": f"batch raised {type(e).__name__}"}
+            )
+            telemetry.inc("serve.solo_retries")
+            run_batch(registry, [e2])
+        return
+    t_ms = (time.perf_counter() - t0) * 1e3
+    for entry, out in zip(entries, outs):
+        if not np.isfinite(np.asarray(out, np.float64)).all():
+            if n > 1:
+                # this request's own data is bad (padding and batch-mates
+                # cannot leak in — slot purity): solo re-run confirms, and
+                # the solo path owns the structured verdict
+                telemetry.inc("serve.fallbacks")
+                telemetry.inc("serve.solo_retries")
+                entry.trace["events"].append(
+                    {"kind": "fallback", "reason": "non-finite in batch"}
+                )
+                telemetry.event(
+                    "serve", "fallback",
+                    {"op": entry.op, "id": entry.request.get("id")},
+                )
+                run_batch(registry, [entry])
+                continue
+            telemetry.inc("serve.errors")
+            entry.trace["events"].append(
+                {"kind": "fallback", "reason": "non-finite solo result"}
+            )
+            _finish_error(
+                entry,
+                NumericalHealthError(
+                    "served result is non-finite after solo re-run "
+                    "(request payload is numerically unhealthy)",
+                    stage=f"serve_{entry.op}",
+                ),
+                n,
+            )
+            continue
+        _finish_ok(entry, _decode(entry, out), n, bucket, t_ms)

@@ -1,0 +1,85 @@
+"""The Python client: in-process (zero-copy futures) or HTTP loopback.
+
+``Client(server)`` talks straight to a :class:`~.server.Server` in the
+same process — results come back as numpy arrays, and concurrent
+callers coalesce.  ``Client(url="http://127.0.0.1:PORT")`` speaks the
+:mod:`.transport` HTTP front end — results come back as the protocol's
+nested lists.
+
+Every convenience method returns the protocol response dict by default;
+``check=True`` unwraps ``result`` and re-raises structured errors as
+their :mod:`utils.exceptions` classes (code-mapped)."""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+
+from . import protocol
+
+__all__ = ["Client"]
+
+
+class Client:
+    def __init__(self, server=None, *, url: str | None = None,
+                 timeout: float = 60.0):
+        if (server is None) == (url is None):
+            raise ValueError("pass exactly one of server= or url=")
+        self._server = server
+        self._url = url.rstrip("/") if url else None
+        self._timeout = timeout
+
+    # -- transport ----------------------------------------------------------
+
+    def call(self, request: dict | None = None, /, **fields) -> dict:
+        req = dict(request or {}, **fields)
+        if self._server is not None:
+            return self._server.call(req)
+        data = protocol.encode(req).encode()
+        http_req = urllib.request.Request(
+            self._url + "/", data=data,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(http_req, timeout=self._timeout) as r:
+            return protocol.decode(r.read().decode())
+
+    def call_many(self, requests: list[dict]) -> list[dict]:
+        """Submit concurrently (the coalescing path for remote callers)."""
+        if self._server is not None:
+            futures = [self._server.submit(r) for r in requests]
+            return [f.result() for f in futures]
+        data = json.dumps(
+            requests, default=lambda o: o.tolist()
+        ).encode()
+        http_req = urllib.request.Request(
+            self._url + "/", data=data,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(http_req, timeout=self._timeout) as r:
+            return json.loads(r.read().decode())
+
+    # -- conveniences -------------------------------------------------------
+
+    @staticmethod
+    def _unwrap(response: dict, check: bool):
+        if not check:
+            return response
+        return protocol.raise_for_error(response)["result"]
+
+    def ls_solve(self, system: str, b, *, check: bool = False, **fields):
+        return self._unwrap(
+            self.call(op="ls_solve", system=system, b=b, **fields), check
+        )
+
+    def predict(self, model: str, x, *, labels: bool = False,
+                check: bool = False, **fields):
+        return self._unwrap(
+            self.call(op="predict", model=model, x=x, labels=labels, **fields),
+            check,
+        )
+
+    def ping(self) -> bool:
+        return bool(self.call(op="ping").get("ok"))
+
+    def stats(self) -> dict:
+        return protocol.raise_for_error(self.call(op="stats"))["result"]

@@ -1,0 +1,151 @@
+"""Model and solve-system registry: load once, serve device-resident.
+
+The registry is the serve layer's "load amplification" half: a model's
+weights (``ml/model.py`` JSON + binary sidecars) or an LS system's
+factorization are loaded/computed ONCE at registration and every request
+afterwards hits device-resident state — the per-request cost is one
+padded batch through an already-compiled plan.
+
+- :class:`LSSystem` — a registered least-squares design matrix with its
+  sketch and the QR factorization of ``S·A`` precomputed on device:
+  serving a request is one COLUMNWISE sketch-apply of the coalesced RHS
+  block plus one small triangular solve (sketch-and-solve, the same
+  math ``linalg.exact_least_squares(SA, SB, "qr")`` does eagerly).
+- Models are the ``ml/model.py`` classes verbatim (their arrays are
+  jnp/device-resident by construction); ``load`` goes through the same
+  polymorphic ``load_model`` dispatch the CLIs use, so the save→load
+  round-trip contract pinned in ``tests/test_ml.py`` is exactly the
+  serving contract.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import plans
+from ..core.context import SketchContext
+from ..sketch import base as sketch_base
+from ..utils.exceptions import InvalidParameters
+
+__all__ = ["LSSystem", "Registry"]
+
+
+class LSSystem:
+    """A registered (A, S) pair with its sketched QR cached on device."""
+
+    def __init__(self, name: str, A, S):
+        self.name = name
+        self.A = jnp.asarray(A)
+        if self.A.ndim != 2:
+            raise InvalidParameters(
+                f"system {name!r}: A must be 2-D, got shape {self.A.shape}"
+            )
+        self.m, self.n = (int(d) for d in self.A.shape)
+        if S.n != self.m:
+            raise InvalidParameters(
+                f"system {name!r}: sketch domain {S.n} != A rows {self.m}"
+            )
+        self.S = S
+        self.dtype = self.A.dtype
+        SA = plans.apply(S, self.A, "columnwise")
+        Q, R = jnp.linalg.qr(SA)
+        # Stored transposed: the per-batch solve consumes Qᵀ directly.
+        self.Qt = jnp.asarray(Q).T
+        self.R = R
+
+    def describe(self) -> dict:
+        return {
+            "shape": [self.m, self.n],
+            "dtype": str(self.dtype),
+            "sketch": type(self.S).__name__,
+            "sketch_size": int(self.S.s),
+        }
+
+
+class Registry:
+    def __init__(self):
+        self.models: dict[str, object] = {}
+        self.systems: dict[str, LSSystem] = {}
+        # per-model jitted predict closures, built lazily by the batcher
+        self.model_jits: dict[str, object] = {}
+
+    # -- models -------------------------------------------------------------
+
+    def register_model(self, name: str, model) -> None:
+        if not hasattr(model, "predict"):
+            raise InvalidParameters(
+                f"model {name!r} has no predict(); got {type(model).__name__}"
+            )
+        self.models[name] = model
+        self.model_jits.pop(name, None)
+
+    def load_model(self, name: str, path: str):
+        """Load a saved ``ml/model.py`` JSON model once; serve forever."""
+        from ..ml.model import load_model
+
+        model = load_model(path)
+        self.register_model(name, model)
+        return model
+
+    def get_model(self, name: str):
+        try:
+            return self.models[name]
+        except KeyError:
+            raise InvalidParameters(
+                f"unknown model {name!r}; registered: {sorted(self.models)}"
+            ) from None
+
+    # -- LS systems ---------------------------------------------------------
+
+    def register_system(
+        self,
+        name: str,
+        A,
+        *,
+        context: SketchContext,
+        sketch=None,
+        sketch_type: str = "FJLT",
+        sketch_size: int | None = None,
+    ) -> LSSystem:
+        """Register a least-squares design matrix.
+
+        ``sketch`` may be a live transform, a serialized-sketch JSON
+        string, or a dict (the ``native/`` interchange forms); absent,
+        a fresh ``sketch_type`` transform is drawn from ``context`` —
+        the server's counter stream, so registration order addresses it
+        deterministically.
+        """
+        A = jnp.asarray(A)
+        m = int(A.shape[0])
+        if isinstance(sketch, str):
+            sketch = sketch_base.from_json(sketch)
+        elif isinstance(sketch, dict):
+            sketch = sketch_base.from_dict(sketch)
+        if sketch is None:
+            n = int(A.shape[1]) if A.ndim == 2 else 1
+            s = int(sketch_size or min(m, max(4 * n, n + 16)))
+            sketch = sketch_base.create_sketch(sketch_type, m, s, context)
+        system = LSSystem(name, A, sketch)
+        self.systems[name] = system
+        return system
+
+    def get_system(self, name: str) -> LSSystem:
+        try:
+            return self.systems[name]
+        except KeyError:
+            raise InvalidParameters(
+                f"unknown system {name!r}; registered: {sorted(self.systems)}"
+            ) from None
+
+    def describe(self) -> dict:
+        models = {}
+        for name, model in self.models.items():
+            models[name] = {
+                "kind": type(model).__name__,
+                "input_dim": getattr(model, "input_dim", None),
+                "classes": getattr(model, "classes", None) is not None,
+            }
+        return {
+            "models": models,
+            "systems": {k: s.describe() for k, s in self.systems.items()},
+        }

@@ -1,0 +1,379 @@
+"""The long-lived in-process solve server.
+
+One :class:`Server` owns a :class:`~.registry.Registry` of models and
+LS systems, a bounded :class:`~.admission.AdmissionQueue`, and ONE
+worker thread that drains the queue in coalesced batches through
+``batcher.run_batch``.  Requests enter through :meth:`submit` (async,
+returns a future) or :meth:`call` (blocking); both always resolve to a
+protocol response dict — errors are structured envelopes, never raised
+across the serving boundary.
+
+Warm start: :meth:`start` replays the policy layer's hot-plan profiles
+(``policy.warm_start`` — XLA cache dir + plan re-trace) and then
+*primes* every registered system/model through its own executor at
+every ladder rung a coalesced batch can reach, so neither the first
+request nor the first full batch pays a trace+compile.
+
+Telemetry: every request lands counters under the ``serve.`` prefix
+(requests/ok/errors/sheds/batches/coalesced/fallbacks), queue-wait and
+latency histograms, and a bounded latency reservoir for the p50/p99
+that ``telemetry.snapshot()["serve"]`` folds.  All of it rides the
+``SKYLARK_TELEMETRY`` gate: disabled, a server run is bit-identical
+and allocation-free on the telemetry side (pinned in
+``tests/test_review_regressions.py``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import asdict, dataclass
+
+import numpy as np
+
+from .. import telemetry
+from ..core.context import SketchContext
+from ..utils.exceptions import (
+    DeadlineExceededError,
+    InvalidParameters,
+    SkylarkError,
+)
+from . import batcher, protocol
+from .admission import AdmissionQueue, Entry
+from .registry import Registry
+
+__all__ = ["ServeParams", "Server", "latency_percentiles", "record_latency"]
+
+# Process-wide latency reservoir (most recent completions) feeding the
+# p50/p99 in telemetry.snapshot()["serve"]; the registry's histograms
+# keep only streaming moments, so the tails need their own samples.
+# Appended ONLY when telemetry is enabled — a disabled run allocates
+# nothing here.
+_LATENCIES: deque[float] = deque(maxlen=4096)
+
+
+def record_latency(ms: float) -> None:
+    if telemetry.enabled():
+        _LATENCIES.append(float(ms))
+
+
+def latency_percentiles() -> dict:
+    if not _LATENCIES:
+        return {}
+    lat = np.sort(np.asarray(_LATENCIES))
+    return {
+        "latency_p50_ms": round(float(np.percentile(lat, 50)), 4),
+        "latency_p99_ms": round(float(np.percentile(lat, 99)), 4),
+    }
+
+
+@dataclass
+class ServeParams:
+    """Knobs of one server instance.
+
+    - ``max_queue``: admission depth cap; requests past it shed with
+      :class:`AdmissionError` (code 112).
+    - ``max_coalesce``: most requests one fused dispatch may carry
+      (``1`` disables coalescing — the serial-per-request reference the
+      bitwise tests and the bench SLO compare against).
+    - ``coalesce_window_ms``: optional linger after the head request is
+      taken, trading that much latency for fuller batches.
+    - ``default_deadline_ms``: deadline applied to requests that carry
+      none (``None`` = no deadline).
+    - ``warm_start`` / ``prime``: replay policy warm-start profiles /
+      pre-compile registered entities' first-rung executables at
+      :meth:`Server.start`.
+    """
+
+    max_queue: int = 256
+    max_coalesce: int = 16
+    coalesce_window_ms: float = 0.0
+    default_deadline_ms: float | None = None
+    warm_start: bool = True
+    prime: bool = True
+
+
+class Server:
+    def __init__(
+        self,
+        params: ServeParams | None = None,
+        *,
+        seed: int = 0,
+        context: SketchContext | None = None,
+    ):
+        self.params = params or ServeParams()
+        self.ctx = context if context is not None else SketchContext(seed=seed)
+        self.registry = Registry()
+        self.queue = AdmissionQueue(self.params.max_queue)
+        self.warm_summary: dict | None = None
+        self.primed: list[str] = []
+        self._thread: threading.Thread | None = None
+        self._fresh_seq = 0
+
+    # -- registration (delegates; the server's context is the default
+    #    counter stream, so registration order is deterministic) ------------
+
+    def register_model(self, name, model):
+        self.registry.register_model(name, model)
+
+    def load_model(self, name, path):
+        return self.registry.load_model(name, path)
+
+    def register_system(self, name, A, **kw):
+        kw.setdefault("context", self.ctx)
+        return self.registry.register_system(name, A, **kw)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "Server":
+        if self._thread is not None:
+            return self
+        if self.params.warm_start:
+            from .. import policy
+
+            self.warm_summary = policy.warm_start()
+        if self.params.prime:
+            self.prime()
+        self._thread = threading.Thread(
+            target=self._worker, name="skylark-serve-worker", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def prime(self) -> list[str]:
+        """Compile every executable a coalesced batch can reach, NOW.
+
+        Not just the first rung: a batch of k requests pads to the
+        k-dependent ladder rung, so a server primed only at rung 8 still
+        pays trace+compile for rung 16/24/32 batches MID-TRAFFIC — and
+        because one worker drains the queue, every request behind the
+        compiling batch eats that stall (the bench measured KRR-predict
+        coalesced slower than serial before this primed the ladder)."""
+        mc = max(1, self.params.max_coalesce)
+        for name, system in self.registry.systems.items():
+            widths = sorted({batcher._lane_bucket(k) for k in range(1, mc + 1)})
+            for w in widths:
+                entries = [
+                    Entry(
+                        {"op": "ls_solve", "system": name}, Future(), None,
+                        "ls_solve", payload=np.zeros(system.m),
+                    )
+                    for _ in range(w)
+                ]
+                batcher._execute_ls(self.registry, entries)
+            self.primed.append(f"system:{name}:{widths}")
+        from .. import plans
+
+        for name, model in self.registry.models.items():
+            d = getattr(model, "input_dim", None)
+            if not d:
+                continue
+            rungs = sorted({plans.bucket_for(k) for k in range(1, mc + 1)})
+            for r in rungs:
+                entries = [
+                    Entry(
+                        {"op": "predict", "model": name}, Future(), None,
+                        "predict", payload=np.zeros((1, int(d))),
+                    )
+                    for _ in range(r)
+                ]
+                batcher._execute_predict(self.registry, entries)
+            self.primed.append(f"model:{name}:{rungs}")
+        return self.primed
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        for e in self.queue.drain():  # anything the worker never reached
+            self._resolve_error(
+                e, SkylarkError("server stopped before dispatch")
+            )
+
+    def __enter__(self) -> "Server":
+        return self.start()
+
+    def __exit__(self, *exc_info) -> None:
+        self.stop()
+
+    # -- request path -------------------------------------------------------
+
+    def submit(self, request: dict) -> Future:
+        """Admit one request; ALWAYS returns a future resolving to a
+        protocol response dict (sheds and validation failures resolve
+        immediately with structured errors — nothing raises)."""
+        fut: Future = Future()
+        telemetry.inc("serve.requests")
+        try:
+            entry = self._validate(request, fut)
+        except SkylarkError as e:
+            telemetry.inc("serve.errors")
+            fut.set_result(
+                protocol.error_response(
+                    request.get("id"), e, {"events": []}
+                )
+            )
+            return fut
+        if entry is None:  # ping/stats answered inline
+            return fut
+        try:
+            self.queue.offer(entry, on_admit=self._on_admit)
+        except SkylarkError as e:  # AdmissionError
+            telemetry.inc("serve.shed_admission")
+            telemetry.inc("serve.errors")
+            fut.set_result(
+                protocol.error_response(request.get("id"), e, entry.trace)
+            )
+        return fut
+
+    def call(self, request: dict | None = None, /, **fields) -> dict:
+        req = dict(request or {}, **fields)
+        return self.submit(req).result()
+
+    def stats(self) -> dict:
+        counters = {
+            k.split(".", 1)[1]: v
+            for k, v in telemetry.REGISTRY.snapshot()["counters"].items()
+            if k.startswith("serve.")
+        }
+        return {
+            "queue_depth": len(self.queue),
+            "params": asdict(self.params),
+            "registry": self.registry.describe(),
+            "counters": counters,
+            "latency": latency_percentiles(),
+            "warm_start": self.warm_summary,
+            "primed": list(self.primed),
+        }
+
+    # -- internals ----------------------------------------------------------
+
+    def _validate(self, request: dict, fut: Future) -> Entry | None:
+        op = request.get("op")
+        if op == "ping":
+            fut.set_result(
+                protocol.ok_response(request.get("id"), "pong", {"events": []})
+            )
+            telemetry.inc("serve.ok")
+            return None
+        if op == "stats":
+            fut.set_result(
+                protocol.ok_response(
+                    request.get("id"), self.stats(), {"events": []}
+                )
+            )
+            telemetry.inc("serve.ok")
+            return None
+        if op == "ls_solve":
+            system = self.registry.get_system(request.get("system"))
+            b = np.asarray(request.get("b"), np.float64)
+            if b.ndim != 1 or b.shape[0] != system.m:
+                raise InvalidParameters(
+                    f"ls_solve b must be 1-D of length {system.m}, "
+                    f"got shape {b.shape} (coalesce multi-RHS as "
+                    "multiple requests)"
+                )
+            if request.get("fresh_sketch"):
+                self._fresh_seq += 1
+                key = ("ls", request["system"], "fresh", self._fresh_seq)
+            else:
+                key = ("ls", request["system"])
+            return Entry(request, fut, key, op, payload=b)
+        if op == "predict":
+            model = self.registry.get_model(request.get("model"))
+            dtype = np.dtype(request.get("dtype", "float64"))
+            x = np.asarray(request.get("x"), dtype)
+            squeeze = x.ndim == 1
+            if squeeze:
+                x = x[None, :]
+            d = getattr(model, "input_dim", None)
+            if x.ndim != 2 or (d and x.shape[1] != int(d)):
+                raise InvalidParameters(
+                    f"predict x must be (r, {d or '?'}) or ({d or '?'},), "
+                    f"got shape {np.asarray(request.get('x')).shape}"
+                )
+            if request.get("labels"):
+                request["_classes"] = getattr(model, "classes", None)
+            entry = Entry(
+                request, fut, ("predict", request["model"], str(dtype)),
+                op, payload=x,
+            )
+            entry.squeeze = squeeze
+            return entry
+        raise InvalidParameters(
+            f"unknown op {op!r}; supported: {list(protocol.OPS)}"
+        )
+
+    def _on_admit(self, entry: Entry) -> None:
+        """Admission-ordered side effects, under the queue lock: the
+        deadline stamp, and for fresh-sketch requests the counter
+        reservation — the server context advances HERE, in admission
+        order, so batching can never perturb the counter stream."""
+        dm = entry.request.get(
+            "deadline_ms", self.params.default_deadline_ms
+        )
+        if dm is not None:
+            entry.deadline = entry.t_admit + float(dm) / 1e3
+        if entry.op == "ls_solve" and entry.request.get("fresh_sketch"):
+            system = self.registry.get_system(entry.request["system"])
+            entry.counter_base = self.ctx.counter
+            entry.sketch = type(system.S)(system.m, system.S.s, self.ctx)
+
+    def _resolve_error(self, entry: Entry, e: SkylarkError) -> None:
+        telemetry.inc("serve.errors")
+        entry.future.set_result(
+            protocol.error_response(entry.request.get("id"), e, entry.trace)
+        )
+
+    def _worker(self) -> None:
+        while True:
+            batch = self.queue.take_batch(
+                self.params.max_coalesce,
+                self.params.coalesce_window_ms / 1e3,
+            )
+            if batch is None:
+                return
+            now = time.monotonic()
+            live = []
+            for e in batch:
+                waited_ms = (now - e.t_admit) * 1e3
+                e.trace["queue_ms"] = round(waited_ms, 4)
+                if e.deadline is not None and now > e.deadline:
+                    telemetry.inc("serve.shed_deadline")
+                    e.trace["events"].append({"kind": "deadline_shed"})
+                    self._resolve_error(
+                        e,
+                        DeadlineExceededError(
+                            "deadline expired before dispatch",
+                            deadline_ms=e.request.get(
+                                "deadline_ms",
+                                self.params.default_deadline_ms,
+                            ),
+                            waited_ms=round(waited_ms, 4),
+                        ),
+                    )
+                    continue
+                telemetry.observe("serve.queue_ms", waited_ms)
+                live.append(e)
+            if not live:
+                continue
+            telemetry.inc("serve.batches")
+            telemetry.observe("serve.batch_size", len(live))
+            if len(live) > 1:
+                telemetry.inc("serve.coalesced", len(live))
+            try:
+                batcher.run_batch(self.registry, live)
+            except Exception as e:  # noqa: BLE001 — the worker must survive
+                for entry in live:
+                    if not entry.future.done():
+                        self._resolve_error(
+                            entry, SkylarkError(f"serve worker error: {e}")
+                        )
+            done = time.monotonic()
+            for e in live:
+                ms = (done - e.t_admit) * 1e3
+                telemetry.observe("serve.latency_ms", ms)
+                record_latency(ms)

@@ -1,0 +1,100 @@
+"""Process-boundary transports: JSON-lines stdio and HTTP loopback.
+
+Both speak the exact :mod:`.protocol` frames — the serialized-sketch /
+JSON parity contract the ``native/`` C-API surface uses — so any
+language that can write a JSON line can drive a server.
+
+- :func:`serve_stdio` — one request per input line, one response per
+  output line, in order.  The systemd/inetd-style deployment: a parent
+  process owns the pipe pair.
+- :func:`serve_http` — a loopback ``ThreadingHTTPServer``: ``POST /``
+  with a request object (or a list of them — submitted concurrently,
+  answered as a list, which is how a remote caller reaches the
+  coalescer), ``GET /stats``, ``GET /healthz``.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import protocol
+
+__all__ = ["serve_stdio", "serve_http"]
+
+
+def serve_stdio(server, in_stream, out_stream) -> int:
+    """Drain ``in_stream`` line-by-line until EOF; returns the number of
+    requests served.  Malformed lines get a structured error response
+    (the stream stays usable)."""
+    served = 0
+    for line in in_stream:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            request = protocol.decode(line)
+        except Exception as e:  # noqa: BLE001 — bad frame, keep serving
+            out_stream.write(
+                protocol.encode(
+                    protocol.error_response(None, e, {"events": []})
+                ) + "\n"
+            )
+            out_stream.flush()
+            continue
+        response = server.call(request)
+        out_stream.write(protocol.encode(response) + "\n")
+        out_stream.flush()
+        served += 1
+    return served
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "skylark-serve"
+
+    def log_message(self, *args):  # quiet: telemetry owns observability
+        pass
+
+    def _send(self, code: int, obj) -> None:
+        body = protocol.encode(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        srv = self.server.skylark_server
+        if self.path == "/healthz":
+            self._send(200, {"ok": True})
+        elif self.path == "/stats":
+            self._send(200, srv.stats())
+        else:
+            self._send(404, {"ok": False, "error": {"message": "not found"}})
+
+    def do_POST(self):
+        srv = self.server.skylark_server
+        try:
+            n = int(self.headers.get("Content-Length", 0))
+            payload = json.loads(self.rfile.read(n) or b"{}")
+        except Exception as e:  # noqa: BLE001 — bad frame
+            self._send(
+                400, protocol.error_response(None, e, {"events": []})
+            )
+            return
+        if isinstance(payload, list):
+            # concurrent submission IS the point: a remote batch rides
+            # the same cross-request coalescer in-process callers hit
+            futures = [srv.submit(r) for r in payload]
+            self._send(200, [f.result() for f in futures])
+        else:
+            self._send(200, srv.call(payload))
+
+
+def serve_http(server, host: str = "127.0.0.1", port: int = 0):
+    """Bind a loopback HTTP front end; returns the ``ThreadingHTTPServer``
+    (``.server_address`` has the bound port; call ``serve_forever`` /
+    ``shutdown`` to run and stop it)."""
+    httpd = ThreadingHTTPServer((host, port), _Handler)
+    httpd.skylark_server = server
+    return httpd

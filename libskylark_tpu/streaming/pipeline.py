@@ -71,8 +71,7 @@ def bucketed_placer(gates: tuple = ()):
             and not hasattr(batch, "todense")
             and plans.enabled()
         ):
-            k = int(batch.shape[0])
-            padded = plans.pad_rows(batch, plans.bucket_rows(k, gates))
+            padded, k = plans.pad_rows_to_bucket(batch, gates)
             return BucketedBatch(jax.device_put(padded), k)
         return jax.device_put(batch)
 

@@ -57,6 +57,28 @@ def snapshot() -> dict:
         for k, v in counters.items()
         if k.startswith("policy.")
     }
+    snap["serve"] = {
+        k.split(".", 1)[1]: v
+        for k, v in counters.items()
+        if k.startswith("serve.")
+    }
+    if snap["serve"]:
+        # Derived serving SLOs: fraction of requests that rode a >1
+        # coalesced batch, and the latency percentiles from the serve
+        # layer's own reservoir (the registry's histograms keep only
+        # streaming moments).  The module lookup goes through
+        # sys.modules so a run that never imported the serve layer —
+        # or a disabled-telemetry run, whose counters stay empty and
+        # never reach this branch — folds nothing extra.
+        import sys as _sys
+
+        snap["serve"]["coalesce_ratio"] = _ratio(
+            counters.get("serve.coalesced", 0),
+            counters.get("serve.requests", 0),
+        )
+        srv = _sys.modules.get("libskylark_tpu.serve")
+        if srv is not None:
+            snap["serve"].update(srv.latency_percentiles())
     return snap
 
 

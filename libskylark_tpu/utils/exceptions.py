@@ -19,6 +19,8 @@ __all__ = [
     "WorldMismatchError",
     "CollectiveTimeoutError",
     "StaleEpochError",
+    "AdmissionError",
+    "DeadlineExceededError",
 ]
 
 
@@ -141,3 +143,34 @@ class StaleEpochError(SkylarkError):
         super().__init__(msg)
         self.expected = expected
         self.got = got
+
+
+class AdmissionError(SkylarkError):
+    """The serve layer's bounded request queue refused a request at
+    admission: accepting it would exceed the configured queue depth.
+    Load-shedding at the door keeps queue wait (and therefore tail
+    latency) bounded under overload — the caller should back off and
+    retry rather than pile on.  ``queue_depth``/``max_depth`` carry the
+    observed and configured depths."""
+
+    code = 112
+
+    def __init__(self, msg, queue_depth=None, max_depth=None):
+        super().__init__(msg)
+        self.queue_depth = queue_depth
+        self.max_depth = max_depth
+
+
+class DeadlineExceededError(SkylarkError):
+    """A served request's deadline expired before its batch dispatched
+    (or before admission completed).  Shedding at dispatch time — not
+    after compute — means an expired request never burns device work its
+    caller has already given up on.  ``deadline_ms`` is the budget the
+    request carried; ``waited_ms`` how long it actually sat queued."""
+
+    code = 113
+
+    def __init__(self, msg, deadline_ms=None, waited_ms=None):
+        super().__init__(msg)
+        self.deadline_ms = deadline_ms
+        self.waited_ms = waited_ms

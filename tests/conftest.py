@@ -55,6 +55,10 @@ KERNELS_TIMEOUT_S = 120
 # profile stores, plus subprocess determinism checks; a wedged store
 # merge or a hung subprocess must not stall the tier-1 run.
 POLICY_TIMEOUT_S = 120
+# Serve-layer tests run a real worker thread behind a blocking queue
+# (plus an HTTP loopback); a worker that never drains, a future that
+# never resolves, or a leaked socket must not stall the tier-1 run.
+SERVE_TIMEOUT_S = 120
 
 _TIMEOUT_MARKS = {
     "faults": FAULTS_TIMEOUT_S,
@@ -65,6 +69,7 @@ _TIMEOUT_MARKS = {
     "chaos": CHAOS_TIMEOUT_S,
     "kernels": KERNELS_TIMEOUT_S,
     "policy": POLICY_TIMEOUT_S,
+    "serve": SERVE_TIMEOUT_S,
 }
 
 
@@ -123,6 +128,13 @@ def pytest_configure(config):
         "policy: adaptive execution-policy tests (profile store, routing "
         "decisions, warm start, bit-parity contract); tier-1, guarded by "
         f"a per-test {POLICY_TIMEOUT_S}s timeout",
+    )
+    config.addinivalue_line(
+        "markers",
+        "serve: sketch-serving layer tests (cross-request coalescing, "
+        "bitwise request isolation, admission/deadline shedding, "
+        "transports); tier-1, guarded by a per-test "
+        f"{SERVE_TIMEOUT_S}s timeout",
     )
 
 

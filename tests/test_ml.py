@@ -503,3 +503,87 @@ class TestModelContainer:
         (tmp_path / "x.json").write_text(json.dumps({"model_type": "mystery"}))
         with pytest.raises(ValueError, match="mystery"):
             load_model(tmp_path / "x.json")
+
+
+class TestModelDtypeRoundTrip:
+    """ISSUE PR 10 satellite: the full save->load matrix over model kinds
+    x coefficient dtypes x attached info ledgers.  ``np.save`` erases
+    extension dtypes (bfloat16 comes back as raw ``|V2`` void records);
+    the dtype tags in the model JSON must restore the arrays BIT-exactly,
+    and ``info["recovery"]``/``info["policy"]`` must ride along."""
+
+    _INFO = {"recovery": {"attempts": 2, "verdict": "FALLBACK"},
+             "policy": {"route": "qr"}}
+
+    @staticmethod
+    def _dtype(name):
+        try:
+            return np.dtype(name)
+        except TypeError:
+            import ml_dtypes
+
+            return np.dtype(getattr(ml_dtypes, name))
+
+    @pytest.mark.parametrize("dtype_name", ["float32", "float64", "bfloat16"])
+    def test_feature_map_matrix(self, tmp_path, rng, dtype_name):
+        from libskylark_tpu.ml import FeatureMapModel, GaussianKernel, load_model
+
+        dt = self._dtype(dtype_name)
+        ctx = SketchContext(seed=21)
+        maps = [GaussianKernel(4, 1.0).create_rft(8, "regular", ctx)]
+        W = rng.standard_normal((8, 3)).astype(dt)
+        m = FeatureMapModel(maps, jnp.asarray(W), scale_maps=True,
+                            input_dim=4, classes=[5, 6, 7])
+        m.info = dict(self._INFO)
+        path = tmp_path / f"fm-{dtype_name}.json"
+        m.save(path)
+
+        m2 = load_model(path)
+        assert isinstance(m2, FeatureMapModel)
+        assert str(m2.W.dtype) == dtype_name
+        assert np.asarray(m2.W).tobytes() == W.tobytes()  # bit-exact
+        assert m2.classes == [5, 6, 7]
+        assert m2.info["recovery"]["verdict"] == "FALLBACK"
+        assert m2.info["policy"] == {"route": "qr"}
+        X = rng.standard_normal((6, 4))
+        assert (np.asarray(m2.predict(X)) == np.asarray(m.predict(X))).all()
+
+    @pytest.mark.parametrize("dtype_name", ["float32", "float64", "bfloat16"])
+    def test_kernel_matrix(self, tmp_path, rng, dtype_name):
+        from libskylark_tpu.ml import GaussianKernel, KernelModel, load_model
+
+        dt = self._dtype(dtype_name)
+        Xtr = rng.standard_normal((10, 3)).astype(dt)
+        Am = rng.standard_normal((10, 2)).astype(dt)
+        m = KernelModel(GaussianKernel(3, 1.5), jnp.asarray(Xtr),
+                        jnp.asarray(Am), classes=[0, 1])
+        m.info = dict(self._INFO)
+        path = tmp_path / f"km-{dtype_name}.json"
+        m.save(path)
+
+        m2 = load_model(path)
+        assert isinstance(m2, KernelModel)
+        assert str(m2.X_train.dtype) == dtype_name
+        assert str(m2.A.dtype) == dtype_name
+        assert np.asarray(m2.X_train).tobytes() == Xtr.tobytes()
+        assert np.asarray(m2.A).tobytes() == Am.tobytes()
+        assert m2.classes == [0, 1]
+        assert m2.info["recovery"]["attempts"] == 2
+        X = rng.standard_normal((4, 3))
+        assert (np.asarray(m2.predict(X)) == np.asarray(m.predict(X))).all()
+
+    def test_info_absent_stays_none(self, tmp_path, rng):
+        from libskylark_tpu.ml import FeatureMapModel, load_model
+
+        m = FeatureMapModel([], rng.standard_normal((5, 2)), input_dim=5)
+        m.save(tmp_path / "n.json")
+        assert load_model(tmp_path / "n.json").info is None
+
+    def test_non_json_info_leaves_degrade_to_str(self, tmp_path, rng):
+        from libskylark_tpu.ml import FeatureMapModel, load_model
+
+        m = FeatureMapModel([], rng.standard_normal((5, 2)), input_dim=5)
+        m.info = {"recovery": {"residual": np.float64(0.25)}}
+        m.save(tmp_path / "j.json")
+        info = load_model(tmp_path / "j.json").info
+        assert info["recovery"]["residual"] in (0.25, "0.25")

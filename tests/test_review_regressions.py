@@ -298,3 +298,78 @@ def test_snapshot_folds_policy_counter_group():
         "policy.flush must run before run_summary's telemetry-enabled "
         "gate: profiles persist even with SKYLARK_TELEMETRY off"
     )
+
+
+@pytest.mark.serve
+def test_snapshot_folds_serve_counter_group():
+    """Static contract check (ISSUE PR 10): ``telemetry.snapshot()`` must
+    fold the ``serve.*`` counters into a ``"serve"`` group (with the
+    derived coalesce ratio and latency percentiles) — the SLO surface
+    docs/serving.md points operators at."""
+    import importlib
+    import inspect
+
+    report = importlib.import_module("libskylark_tpu.telemetry.report")
+    snap_src = inspect.getsource(report.snapshot)
+    assert '"serve"' in snap_src and "serve." in snap_src, (
+        "telemetry.snapshot() no longer folds the serve.* counter "
+        'group into snap["serve"] (docs/serving.md contract)'
+    )
+    assert "coalesce_ratio" in snap_src, (
+        "snapshot()['serve'] no longer derives the coalesce ratio"
+    )
+
+
+@pytest.mark.serve
+def test_disabled_telemetry_server_is_pure_and_hookless():
+    """With ``SKYLARK_TELEMETRY`` unset/0, running a full serve
+    round-trip (admit -> coalesce -> execute -> respond) must add zero
+    atexit hooks AND return bit-identical results to a second same-seed
+    server in the same process — the telemetry fast path cannot perturb
+    the serve numerics or leave process-lifetime residue."""
+    import os
+    import subprocess
+    import sys
+
+    code = (
+        "import os\n"
+        "os.environ['SKYLARK_TELEMETRY'] = '0'\n"
+        "os.environ.pop('SKYLARK_TELEMETRY_DIR', None)\n"
+        "import atexit\n"
+        "import numpy as np\n"
+        "import libskylark_tpu\n"
+        "from libskylark_tpu import serve\n"
+        "from libskylark_tpu.core.context import SketchContext\n"
+        "rng = np.random.default_rng(0)\n"
+        "A = rng.standard_normal((48, 4))\n"
+        "bs = [rng.standard_normal(48) for _ in range(3)]\n"
+        "def run():\n"
+        "    p = serve.ServeParams(warm_start=False, prime=False)\n"
+        "    srv = serve.Server(p, seed=5)\n"
+        "    srv.registry.register_system('s', A,\n"
+        "                                 context=SketchContext(seed=2))\n"
+        "    futs = [srv.submit(serve.make_request('ls_solve', system='s',\n"
+        "                                          b=b)) for b in bs]\n"
+        "    srv.start()\n"
+        "    out = [np.asarray(f.result()['result']) for f in futs]\n"
+        "    srv.stop()\n"
+        "    return out\n"
+        "one = run()\n"
+        "base = atexit._ncallbacks()\n"
+        "two = run()\n"
+        "assert atexit._ncallbacks() == base, (base, atexit._ncallbacks())\n"
+        "assert all((a == b).all() for a, b in zip(one, two))\n"
+        "from libskylark_tpu import telemetry\n"
+        "assert telemetry.ledger_path() is None\n"
+        "print('SERVE-PURE-OK')\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=110,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "SERVE-PURE-OK" in out.stdout

@@ -1,0 +1,367 @@
+"""Sketch-serving layer (ISSUE PR 10): cross-request coalescing onto
+warm compiled plans.
+
+The load-bearing contract: a request's result is BITWISE identical
+whether it was served alone (the serial eager path, ``max_coalesce=1``)
+or coalesced with strangers into one padded fused dispatch on a
+different ladder rung entirely.  The tests below pin that for LS-solve
+and KRR-predict (both model kinds), plus the fresh-sketch counter
+reservation that makes randomized requests individually reproducible,
+the admission/deadline shedding codes (112/113), and the solo-retry
+fault ladder (code 108) that keeps one poisoned payload from taking
+its batch-mates down.
+
+Every comparison constructs fresh same-seed servers/contexts so
+bitwise equality is meaningful (``SketchContext`` is stateful).
+"""
+
+import io
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from libskylark_tpu import serve, telemetry
+from libskylark_tpu.core.context import SketchContext
+from libskylark_tpu.ml.kernels import GaussianKernel
+from libskylark_tpu.ml.model import FeatureMapModel, KernelModel
+from libskylark_tpu.serve import batcher
+from libskylark_tpu.sketch.rft import GaussianRFT
+from libskylark_tpu.utils import exceptions as ex
+
+pytestmark = pytest.mark.serve
+
+M, N = 64, 5
+_rng = np.random.default_rng(1234)
+A = _rng.standard_normal((M, N))
+RHS = [_rng.standard_normal(M) for _ in range(10)]
+XQ = [_rng.standard_normal(12) for _ in range(10)]
+
+
+def _params(max_coalesce):
+    return serve.ServeParams(
+        max_coalesce=max_coalesce, warm_start=False, prime=False
+    )
+
+
+def _ls_server(max_coalesce, seed=42):
+    srv = serve.Server(_params(max_coalesce), seed=seed)
+    srv.registry.register_system("sys", A, context=SketchContext(seed=9))
+    return srv
+
+
+def _feature_map_model():
+    ctx = SketchContext(seed=5)
+    S = GaussianRFT(12, 32, ctx, sigma=1.2)
+    W = np.random.default_rng(7).standard_normal((32, 3))
+    return FeatureMapModel([S], W, scale_maps=True)
+
+
+def _kernel_model():
+    rng = np.random.default_rng(8)
+    Xt = rng.standard_normal((24, 12))
+    Am = rng.standard_normal((24, 3))
+    return KernelModel(GaussianKernel(12, sigma=1.1), Xt, Am)
+
+
+def _run(srv, requests, coalesce):
+    """Serial path calls one-at-a-time; coalesced path queues everything
+    BEFORE the worker starts, so the whole set arrives as one batch."""
+    if coalesce:
+        futures = [srv.submit(r) for r in requests]
+        srv.start()
+        results = [f.result() for f in futures]
+    else:
+        srv.start()
+        results = [srv.call(r) for r in requests]
+    srv.stop()
+    return results
+
+
+# ---------------------------------------------------------------------------
+# the coalescing bitwise contract
+
+
+def test_ls_coalesced_bitwise_equals_serial():
+    reqs = [serve.make_request("ls_solve", system="sys", b=b) for b in RHS]
+    serial = _run(_ls_server(1), reqs, coalesce=False)
+    coal = _run(_ls_server(16), [dict(r) for r in reqs], coalesce=True)
+
+    assert all(r["ok"] for r in serial + coal)
+    # the batch really coalesced, across a rung boundary: 10 requests
+    # ride one 16-wide dispatch while each serial request rode an 8-wide
+    assert max(r["trace"]["batch_size"] for r in coal) == len(RHS)
+    assert {r["trace"]["bucket"] for r in coal} == {16}
+    assert {r["trace"]["bucket"] for r in serial} == {8}
+    for s, c in zip(serial, coal):
+        assert (np.asarray(s["result"]) == np.asarray(c["result"])).all()
+
+
+def test_lane_uniform_bucket_skips_remainder_rung():
+    # the 12-wide rung is the one ladder rung whose tail columns fall in
+    # a remainder vector tile (different gemm micro-kernel, different
+    # bits) — coalesced widths skip it
+    assert batcher._lane_bucket(1) == 8
+    assert batcher._lane_bucket(8) == 8
+    assert batcher._lane_bucket(9) == 16
+    assert batcher._lane_bucket(12) == 16
+    assert batcher._lane_bucket(17) == 24
+    assert batcher._lane_bucket(25) == 32
+    for k in range(1, 70):
+        assert batcher._lane_bucket(k) % 8 == 0
+
+
+@pytest.mark.parametrize("make_model", [_feature_map_model, _kernel_model],
+                         ids=["feature_map", "kernel"])
+def test_predict_coalesced_bitwise_equals_serial(make_model):
+    def server(max_coalesce):
+        srv = serve.Server(_params(max_coalesce), seed=3)
+        srv.registry.register_model("mdl", make_model())
+        return srv
+
+    reqs = [serve.make_request("predict", model="mdl", x=x) for x in XQ]
+    serial = _run(server(1), reqs, coalesce=False)
+    coal = _run(server(16), [dict(r) for r in reqs], coalesce=True)
+
+    assert all(r["ok"] for r in serial + coal)
+    assert max(r["trace"]["batch_size"] for r in coal) == len(XQ)
+    for s, c in zip(serial, coal):
+        assert (np.asarray(s["result"]) == np.asarray(c["result"])).all()
+
+
+def test_fresh_sketch_counter_reservation_isolation():
+    """fresh_sketch requests draw counters at ADMISSION (queue order),
+    so each request's randomness is pinned regardless of how the batch
+    later forms — serial and coalesced servers reserve the same bases
+    and produce bitwise-equal per-request results."""
+    def run(max_coalesce, coalesce):
+        srv = _ls_server(max_coalesce, seed=7)
+        reqs = [
+            serve.make_request("ls_solve", system="sys", b=b,
+                               fresh_sketch=True)
+            for b in RHS[:3]
+        ]
+        return _run(srv, reqs, coalesce)
+
+    serial = run(1, False)
+    coal = run(16, True)
+    bases_s = [r["trace"]["counter_base"] for r in serial]
+    bases_c = [r["trace"]["counter_base"] for r in coal]
+    assert bases_s == bases_c
+    assert bases_s == sorted(bases_s) and len(set(bases_s)) == 3
+    for s, c in zip(serial, coal):
+        assert (np.asarray(s["result"]) == np.asarray(c["result"])).all()
+
+
+# ---------------------------------------------------------------------------
+# admission control + shedding
+
+
+def test_admission_shed_code_112():
+    srv = serve.Server(
+        serve.ServeParams(max_queue=2, max_coalesce=16,
+                          warm_start=False, prime=False),
+        seed=1,
+    )
+    srv.registry.register_system("sys", A, context=SketchContext(seed=9))
+    f1 = srv.submit(serve.make_request("ls_solve", system="sys", b=RHS[0]))
+    f2 = srv.submit(serve.make_request("ls_solve", system="sys", b=RHS[1]))
+    shed = srv.call(op="ls_solve", system="sys", b=RHS[2])
+    assert not shed["ok"]
+    assert shed["error"]["code"] == 112
+    assert shed["error"]["queue_depth"] == 2
+    assert shed["error"]["max_depth"] == 2
+    with pytest.raises(ex.AdmissionError):
+        serve.raise_for_error(shed)
+    srv.start()
+    assert f1.result()["ok"] and f2.result()["ok"]
+    srv.stop()
+
+
+def test_deadline_shed_code_113():
+    srv = _ls_server(16, seed=1)
+    fd = srv.submit(
+        serve.make_request("ls_solve", system="sys", b=RHS[0], deadline_ms=1)
+    )
+    time.sleep(0.05)  # let the deadline lapse before the worker drains
+    srv.start()
+    shed = fd.result()
+    srv.stop()
+    assert not shed["ok"]
+    assert shed["error"]["code"] == 113
+    assert shed["error"]["deadline_ms"] == 1
+    assert shed["error"]["waited_ms"] > 1
+    with pytest.raises(ex.DeadlineExceededError):
+        serve.raise_for_error(shed)
+
+
+# ---------------------------------------------------------------------------
+# fault isolation: the serve-side recovery ladder
+
+
+def test_poisoned_request_isolated_from_batch_mates():
+    """Mid-traffic numerical-health fallback: the poisoned request gets a
+    structured code-108 verdict with the fallback events in ITS trace;
+    its batch-mates complete with bits identical to a clean serial run."""
+    reqs = [serve.make_request("ls_solve", system="sys", b=b)
+            for b in (RHS[0], RHS[1], RHS[2])]
+    serial = _run(_ls_server(1), [dict(r) for r in reqs], coalesce=False)
+
+    bad = RHS[1].copy()
+    bad[3] = np.nan
+    reqs[1] = serve.make_request("ls_solve", system="sys", b=bad)
+    srv = _ls_server(16)
+    res = _run(srv, reqs, coalesce=True)
+
+    assert [r["ok"] for r in res] == [True, False, True]
+    assert res[1]["error"]["code"] == 108
+    kinds = [e["kind"] for e in res[1]["trace"]["events"]]
+    assert "fallback" in kinds  # batch-level AND solo-retry visible
+    assert (np.asarray(res[0]["result"])
+            == np.asarray(serial[0]["result"])).all()
+    assert (np.asarray(res[2]["result"])
+            == np.asarray(serial[2]["result"])).all()
+    # the survivors' traces show they rode the poisoned batch
+    assert res[0]["trace"]["coalesced"] and res[2]["trace"]["coalesced"]
+
+
+# ---------------------------------------------------------------------------
+# registry + model loading
+
+
+def test_registry_unknown_names_are_structured():
+    srv = _ls_server(1)
+    srv.start()
+    r = srv.call(op="ls_solve", system="nope", b=RHS[0])
+    assert not r["ok"] and r["error"]["code"] == ex.InvalidParameters("x").code
+    assert "sys" in r["error"]["message"]
+    r = srv.call(op="predict", model="nope", x=XQ[0])
+    assert not r["ok"] and r["error"]["code"] == ex.InvalidParameters("x").code
+    srv.stop()
+
+
+def test_loaded_model_serves_labels(tmp_path):
+    model = _feature_map_model()
+    model.classes = [10, 20, 30]
+    path = str(tmp_path / "clf.json")
+    model.save(path)
+
+    srv = serve.Server(_params(16), seed=3)
+    srv.registry.load_model("clf", path)
+    srv.start()
+    client = serve.Client(srv)
+    labels = client.predict("clf", XQ[0], labels=True, check=True)
+    scores = client.predict("clf", XQ[0], check=True)
+    srv.stop()
+    assert labels in (10, 20, 30)
+    assert np.asarray(labels) == [10, 20, 30][int(np.argmax(scores))]
+
+
+# ---------------------------------------------------------------------------
+# protocol + transports
+
+
+def test_protocol_error_roundtrip():
+    for exc in (
+        ex.AdmissionError("full", queue_depth=4, max_depth=4),
+        ex.DeadlineExceededError("late", deadline_ms=5, waited_ms=9.5),
+        ex.NumericalHealthError("bad", stage="serve_ls_solve"),
+    ):
+        frame = serve.encode(serve.error_response("r1", exc, {"events": []}))
+        back = serve.exception_for(serve.decode(frame)["error"])
+        assert type(back) is type(exc)
+        assert back.code == exc.code
+
+
+def test_stdio_transport_round_trip():
+    srv = _ls_server(16)
+    srv.start()
+    lines = [
+        serve.encode(serve.make_request("ping")),
+        "this is not json",
+        serve.encode(serve.make_request("ls_solve", system="sys", b=RHS[0])),
+    ]
+    out = io.StringIO()
+    served = serve.serve_stdio(srv, io.StringIO("\n".join(lines) + "\n"), out)
+    srv.stop()
+    responses = [json.loads(s) for s in out.getvalue().splitlines()]
+    assert served == 2  # the malformed line is answered but not counted
+    assert responses[0]["ok"]
+    assert not responses[1]["ok"] and responses[1]["error"]["code"] == 100
+    assert responses[2]["ok"]
+    assert len(responses[2]["result"]) == N
+
+
+def test_http_loopback_and_batched_post():
+    srv = _ls_server(16)
+    srv.start()
+    httpd = serve.serve_http(srv, port=0)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        host, port = httpd.server_address[:2]
+        client = serve.Client(url=f"http://{host}:{port}")
+        assert client.ping()
+        x = client.ls_solve("sys", RHS[0], check=True)
+        assert len(x) == N
+        # a POSTed list is submitted concurrently -> rides the coalescer
+        many = client.call_many([
+            serve.make_request("ls_solve", system="sys", b=b.tolist())
+            for b in RHS[:4]
+        ])
+        assert all(r["ok"] for r in many)
+        stats = client.stats()
+        assert "sys" in stats["registry"]["systems"]
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/healthz", timeout=10
+        ) as r:
+            assert json.loads(r.read())["ok"]
+    finally:
+        httpd.shutdown()
+        srv.stop()
+    # the remote rows are bit-for-bit the in-process protocol encoding
+    serial = _run(_ls_server(1), [
+        serve.make_request("ls_solve", system="sys", b=b) for b in RHS[:4]
+    ], coalesce=False)
+    for remote, local in zip(many, serial):
+        assert remote["result"] == np.asarray(local["result"]).tolist()
+
+
+# ---------------------------------------------------------------------------
+# lifecycle + telemetry
+
+
+def test_prime_compiles_before_traffic_and_stats_report(monkeypatch):
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    telemetry.REGISTRY.reset()
+    srv = serve.Server(
+        serve.ServeParams(warm_start=False, prime=True), seed=2
+    )
+    srv.registry.register_system("sys", A, context=SketchContext(seed=9))
+    srv.registry.register_model("mdl", _feature_map_model())
+    srv.start()
+    assert srv.primed
+    r = srv.call(op="ls_solve", system="sys", b=RHS[0])
+    assert r["ok"]
+    stats = srv.stats()
+    srv.stop()
+    snap = telemetry.snapshot()
+    telemetry.REGISTRY.reset()
+    assert stats["params"]["max_coalesce"] == 16
+    assert stats["queue_depth"] == 0
+    assert stats["counters"].get("requests", 0) >= 1
+    # snapshot() folds the serve group with the derived coalesce ratio
+    assert snap["serve"]["requests"] >= 1
+    assert "coalesce_ratio" in snap["serve"]
+
+
+def test_stop_resolves_stranded_futures():
+    srv = _ls_server(16)
+    f = srv.submit(serve.make_request("ls_solve", system="sys", b=RHS[0]))
+    # worker never started: stop() must still resolve the future
+    srv.stop()
+    r = f.result(timeout=5)
+    assert not r["ok"] and r["error"]["code"] == 100
