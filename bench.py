@@ -200,6 +200,21 @@ def _backend_died(e: BaseException) -> bool:
     )
 
 
+def _resolved_backend() -> str:
+    """Every artifact row self-identifies with the RESOLVED backend —
+    fallback rows keep the "cpu-fallback" marker (the run is NOT on the
+    accelerator the baselines were recorded on, and the driver must
+    never compare one against a TPU baseline); ordinary rows carry the
+    live jax backend so an artifact is interpretable without knowing
+    which host produced it."""
+    if _BACKEND_TAG is not None:
+        return _BACKEND_TAG
+    try:
+        return str(jax.default_backend())
+    except Exception:  # noqa: BLE001 — backend dead: tag honestly
+        return "unknown"
+
+
 def _emit(metric, value, unit, vs_baseline, table, contention="auto"):
     row = {
         "metric": metric,
@@ -207,11 +222,7 @@ def _emit(metric, value, unit, vs_baseline, table, contention="auto"):
         "unit": unit,
         "vs_baseline": round(float(vs_baseline), 4),
     }
-    if _BACKEND_TAG is not None:
-        # The run is NOT on the accelerator the baselines were recorded
-        # on; every row self-identifies so the driver never compares a
-        # cpu-fallback number against a TPU baseline.
-        row["backend"] = _BACKEND_TAG
+    row["backend"] = _resolved_backend()
     if contention == "auto":
         contention = _LAST_CONTENTION
     if contention is not None:
@@ -471,6 +482,76 @@ def bench_stream_chunk(on_tpu, table):
             table,
             contention=None,  # min-of-5 custom loop — no burst spread
         )
+
+
+def bench_overlap(on_tpu, table):
+    """Async device-overlap streaming (round-11 tentpole): the same
+    columnwise CWT pass folded twice — ``overlap=True`` (host syncs only
+    at chunk boundaries; batch k+1's staging rides JAX async dispatch
+    under batch k's compute) vs ``overlap=False`` (``block_until_ready``
+    after every fold step, the serial anchor).  The two are bitwise
+    identical by the overlap contract (same blocks, same order, same
+    IEEE adds — only the host's wait points move), so ``vs_baseline``
+    (serial/overlapped) isolates pure dispatch-overlap win.  A second
+    row reports the overlap-efficiency submetric: the fraction of
+    producer (parse + host→device staging) seconds hidden under compute,
+    from the prefetch counters of one overlapped pass."""
+    from libskylark_tpu import streaming, telemetry
+    from libskylark_tpu.sketch.hash import CWT
+    from libskylark_tpu.streaming import StreamParams
+
+    if on_tpu:
+        br, n, s, nb = 65_536, 2048, 1024, 8
+    else:
+        br, n, s, nb = 4096, 256, 128, 4
+    m = br * nb
+    rng = np.random.default_rng(33)
+    host = [rng.standard_normal((br, n)).astype(np.float32) for _ in range(nb)]
+    S = CWT(m, s, SketchContext(seed=71))
+    S.hoistable_operands(jnp.float32)  # realize outside the timings
+
+    def run(overlap):
+        return jax.block_until_ready(
+            streaming.sketch(
+                lambda start: iter(host[start:]),
+                S,
+                ncols=n,
+                params=StreamParams(overlap=overlap),
+            )
+        )
+
+    run(True), run(False)  # compile the planned fold once
+    t_over = min(_timed(run, True) for _ in range(5))
+    t_serial = min(_timed(run, False) for _ in range(5))
+
+    prev = os.environ.get("SKYLARK_TELEMETRY")
+    os.environ["SKYLARK_TELEMETRY"] = "1"
+    telemetry.reset()
+    try:
+        run(True)
+        snap = telemetry.snapshot()
+    finally:
+        if prev is None:
+            os.environ.pop("SKYLARK_TELEMETRY", None)
+        else:
+            os.environ["SKYLARK_TELEMETRY"] = prev
+    eff = snap.get("overlap_efficiency")
+    _emit(
+        f"CWT overlapped stream columnwise {nb}x{br}x{n}->{s}",
+        (m / t_over) / 1e6,
+        "Mrows/s",
+        t_serial / t_over,
+        table,
+        contention=None,  # min-of-5 custom loop — no burst spread
+    )
+    _emit(
+        f"overlap efficiency (hidden transfer fraction, {nb}x{br}x{n})",
+        eff if eff is not None else -1,
+        "ratio",
+        1.0,
+        table,
+        contention=None,  # counter ratio, not a timing
+    )
 
 
 def bench_qrft(on_tpu, table):
@@ -1580,8 +1661,7 @@ def main() -> None:
                 "unit": "error",
                 "vs_baseline": 0,
             }
-    if _BACKEND_TAG is not None:
-        headline_row["backend"] = _BACKEND_TAG
+    headline_row["backend"] = _resolved_backend()
     table.append(dict(headline_row))
     print(json.dumps(headline_row), flush=True)
     # submetrics aliases the LIVE table: rows appended below are included
@@ -1646,6 +1726,10 @@ def main() -> None:
         # round-8 kernel-layer measurement (fused single-launch chunks
         # vs the two-step composite on identical data).
         ("fused stream-chunk", 90, lambda: bench_stream_chunk(on_tpu, table)),
+        # Overlapped streaming rides with it: the round-11 measurement
+        # (async-dispatch overlap vs per-step sync on identical data,
+        # plus the hidden-transfer-fraction submetric).
+        ("stream overlap", 90, lambda: bench_overlap(on_tpu, table)),
         ("streaming SVD", 150, lambda: bench_streaming_svd(on_tpu, table)),
         ("sparse CWT", 150, lambda: bench_sparse_cwt(on_tpu, table)),
         ("QRFT", 90, lambda: bench_qrft(on_tpu, table)),

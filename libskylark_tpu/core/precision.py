@@ -19,7 +19,24 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["bf16_split3", "f32_accumulable"]
+__all__ = ["bf16_split3", "f32_accumulable", "fp8_dtype", "fp8_available"]
+
+
+def fp8_dtype():
+    """The fp8 sketch-apply element type — e4m3 (4 exponent / 3 mantissa
+    bits: the accuracy-side fp8, vs e5m2's range-side) — or ``None`` on
+    JAX builds without fp8 support.  MXU fp8 matmuls accumulate in f32,
+    so the precision-ladder contract (narrow operands, f32 accumulate,
+    guard-certified result) carries down from bf16 unchanged; only the
+    operand rounding gets coarser."""
+    return getattr(jnp, "float8_e4m3fn", None)
+
+
+def fp8_available() -> bool:
+    """True when this JAX build can represent e4m3 at all (the ladder's
+    existence check; whether the BACKEND can matmul it profitably is the
+    policy layer's call — ``policy.config.fp8_allowed``)."""
+    return fp8_dtype() is not None
 
 
 def f32_accumulable(dtype, *, demote_f64: bool = False) -> bool:

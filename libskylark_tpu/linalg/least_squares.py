@@ -282,16 +282,44 @@ def approximate_least_squares(
             "sketch_and_solve_ls", context, s, m, attempt, fallback
         )
 
+    def _ok0(report):
+        attempts = report.to_dict().get("attempts") or []
+        return bool(attempts) and attempts[0].get("verdict") == guard.OK
+
     bf16_note = None
-    if decision.compute_dtype == "bfloat16":
+    fp8_note = None
+    if decision.compute_dtype == "float8_e4m3fn":
+        # fp8-first (one rung below bf16, reached only through a clean
+        # bf16 history): the sketch OPERAND is rounded to e4m3 — the
+        # rung's precision semantics — then lifted to bf16 so the apply
+        # reuses the proven f32-accumulating machinery (on fp8-MXU
+        # hardware XLA folds the f8→bf16 convert into the matmul).  The
+        # guard certificate checks the lifted sketch; a non-OK attempt 0
+        # — or a backend that cannot lower f8 at all — escalates to the
+        # input dtype and records ``fp8: fail`` so the policy retires
+        # the rung for this key.
+        from ..core.precision import fp8_dtype
+
+        X = report = None
+        f8 = fp8_dtype()
+        if f8 is not None:
+            try:
+                X, report = run_guarded(
+                    A.astype(f8).astype(jnp.bfloat16), True
+                )
+            except Exception:  # noqa: BLE001 — f8 lowering failure → f32
+                X = report = None
+        if report is None or not _ok0(report):
+            decision.escalated = True
+            fp8_note = "fail"
+            X, report = run_guarded(A, False)
+    elif decision.compute_dtype == "bfloat16":
         # bf16-first: the MXU-heavy sketch runs at bf16 (the
         # f32-accumulable kernel entry points make it nearly free); the
         # guard certificate checks the lifted sketch and a non-OK attempt
         # 0 escalates the whole solve back to the input dtype.
         X, report = run_guarded(A.astype(jnp.bfloat16), True)
-        attempts = report.to_dict().get("attempts") or []
-        ok0 = bool(attempts) and attempts[0].get("verdict") == guard.OK
-        if not ok0:
+        if not _ok0(report):
             decision.escalated = True
             bf16_note = "fail"
             X, report = run_guarded(A, False)
@@ -299,7 +327,10 @@ def approximate_least_squares(
         X, report = run_guarded(A, False)
     out = X[:, 0] if squeeze else X
     info = {"recovery": report.to_dict(), "policy": decision.to_dict()}
-    policy.observe(decision, info, default_size=default_size, bf16=bf16_note)
+    policy.observe(
+        decision, info, default_size=default_size, bf16=bf16_note,
+        fp8=fp8_note,
+    )
     telemetry.run_summary("sketch_and_solve_ls", info)
     if return_info:
         return out, info

@@ -239,8 +239,8 @@ class ProfileStore:
         ``obs`` fields (all optional): ``ok0`` (attempt-0 certificate
         OK), ``resketches``, ``fallback``, ``cond``, ``sketch_type``,
         ``sketch_size`` (certified-OK size), ``default_size``, ``route``,
-        ``bf16`` (``"ok"``/``"fail"``), ``escalated``, ``rows_per_s``,
-        ``batches``.
+        ``bf16`` / ``fp8`` (``"ok"``/``"fail"``), ``escalated``,
+        ``rows_per_s``, ``batches``.
         """
         with _LOCK:
             e = self._seed(key)
@@ -285,6 +285,9 @@ class ProfileStore:
             if obs.get("bf16") in ("ok", "fail"):
                 b = e.setdefault("bf16", {"ok": 0, "fail": 0})
                 b[obs["bf16"]] = b.get(obs["bf16"], 0) + 1
+            if obs.get("fp8") in ("ok", "fail"):
+                f8 = e.setdefault("fp8", {"ok": 0, "fail": 0})
+                f8[obs["fp8"]] = f8.get(obs["fp8"], 0) + 1
             if obs.get("escalated"):
                 e["escalations"] = int(e.get("escalations", 0)) + 1
             if obs.get("rows_per_s") is not None:

@@ -181,28 +181,29 @@ def _window_compiles() -> bool:
     return _WINDOW_COMPILES
 
 
-def _window_mode(k: int, m: int, num_segments: int, dtype) -> str:
+def _window_mode(k: int, m: int, num_segments: int, dtype, nnz: int = 1) -> str:
     """STATIC routing decision for the windowed row scatter-add — shape,
     dtype, env, and the one-time probe only, never values.  Returns
     ``"xla"``, ``"kernel"``, or ``"interpret"``.  Because every input is
     static, the eager apply_slice path and the planned slice-kernel path
     of the same (shape, dtype) block resolve to the SAME branch — the
     bitwise planned≡eager contract holds by construction, whichever
-    kernel wins.  ``SKYLARK_PALLAS_WINDOW=1`` forces the kernel,
-    ``=interpret`` runs it in interpret mode (CPU tests), ``=0`` (or
-    ``SKYLARK_NO_PALLAS=1``) forces the XLA path."""
+    kernel wins.  ``nnz > 1`` rates the stacked (SJLT/OSNAP) launch,
+    whose entry count is nnz·k.  ``SKYLARK_PALLAS_WINDOW=1`` forces the
+    kernel, ``=interpret`` runs it in interpret mode (CPU tests), ``=0``
+    (or ``SKYLARK_NO_PALLAS=1``) forces the XLA path."""
     mode = os.environ.get("SKYLARK_PALLAS_WINDOW", "")
     forced = mode in ("1", "interpret")
     ok = f32_accumulable(
         dtype, demote_f64=forced
-    ) and pallas_window.supported(k, num_segments, m)
+    ) and pallas_window.supported(k, num_segments, m, nnz)
     if not ok or mode == "0":
         return "xla"
     if forced:
         return "interpret" if mode == "interpret" else "kernel"
     if (
         jax.default_backend() == "tpu"
-        and pallas_window.worthwhile(k, num_segments, m)
+        and pallas_window.worthwhile(k, num_segments, m, nnz)
         and _window_compiles()
     ):
         return "kernel"
@@ -215,13 +216,21 @@ def _segment_sum_rows(A_block, b, v, num_segments: int, mode: str, acc=None):
     both the eager ``_apply_slice_columnwise`` and the jit-safe
     ``apply_slice_kernel`` call (with ``mode`` decided up front by
     :func:`_window_mode`), so the plans slice path and the eager path
-    pick the same kernel by construction.  ``v`` must carry the caller's
-    compute dtype on the XLA branch and f32 on the kernel branches (the
-    value realization dtype is part of the routing decision, not of this
+    pick the same kernel by construction.  ``b``/``v`` may be stacked
+    (nnz, k) — every hash function accumulates in ONE kernel launch (or
+    one flat XLA scatter).  ``v`` must carry the caller's compute dtype
+    on the XLA branch and f32 on the kernel branches (the value
+    realization dtype is part of the routing decision, not of this
     function).  ``acc`` (f32, kernel modes only) folds the streaming
     accumulator add into the kernel's emit — the fused stream-chunk
     path.  Kernel output is f32; the caller casts at the boundary."""
     if mode == "xla":
+        if b.ndim == 2:
+            m = A_block.shape[1]
+            stacked = (v[:, :, None] * A_block[None, :, :]).reshape(-1, m)
+            return jax.ops.segment_sum(
+                stacked, b.reshape(-1), num_segments=num_segments
+            )
         return jax.ops.segment_sum(
             v[:, None] * A_block, b, num_segments=num_segments
         )
@@ -368,11 +377,25 @@ class HashSketch(SketchTransform):
                 ).astype(dtype).reshape(self.s, m)
             return out
         A_block = A_block.astype(dtype)
-        mode = _window_mode(k, A_block.shape[1], self.s, dtype)
-        vdt = dtype if mode == "xla" else jnp.float32
+        mode = _window_mode(k, A_block.shape[1], self.s, dtype, self.nnz)
+        if mode != "xla":
+            # Stacked single launch: every hash window rides ONE kernel
+            # call (the A tile streams through VMEM once for all nnz
+            # hashes) — the jit slice path below builds the identical
+            # stack, so planned≡eager holds for nnz>1 too.
+            b = jnp.stack(
+                [self.buckets(h * self.n + start, k) for h in range(self.nnz)]
+            )
+            v = jnp.stack(
+                [
+                    self.values(jnp.float32, h * self.n + start, k)
+                    for h in range(self.nnz)
+                ]
+            )
+            return _segment_sum_rows(A_block, b, v, self.s, mode).astype(dtype)
         for h in range(self.nnz):
             b = self.buckets(h * self.n + start, k)
-            v = self.values(vdt, h * self.n + start, k)
+            v = self.values(dtype, h * self.n + start, k)
             out = out + _segment_sum_rows(
                 A_block, b, v, self.s, mode
             ).astype(dtype)
@@ -390,9 +413,10 @@ class HashSketch(SketchTransform):
         padded row would poison the sum.
 
         When an ``acc`` is given and the single-launch gate admits
-        (nnz=1, f32 block and f32 accumulator, window kernel engaged),
-        the accumulator add is folded into the kernel's emit — one
-        launch per stream chunk, bitwise equal to the unfused
+        (f32 block and f32 accumulator, window kernel engaged — any
+        nnz, since the stacked layout folds every hash into one
+        launch), the accumulator add is folded into the kernel's emit —
+        one launch per stream chunk, bitwise equal to the unfused
         ``acc + part`` composite (a single IEEE add of the same
         partial, so the plan layer's planned≡eager contract holds)."""
         k = A_block.shape[0]
@@ -401,25 +425,37 @@ class HashSketch(SketchTransform):
             dtype = jnp.float32
         A_block = A_block.astype(dtype)
         m = A_block.shape[1]
-        mode = _window_mode(k, m, self.s, dtype)
-        vdt = dtype if mode == "xla" else jnp.float32
+        mode = _window_mode(k, m, self.s, dtype, self.nnz)
         valid = start + jnp.arange(k, dtype=jnp.int32) < self.n
-        fuse = (
-            acc is not None
-            and mode != "xla"
-            and self.nnz == 1
-            and dtype == jnp.float32
-            and acc.dtype == jnp.float32
-        )
+        if mode != "xla":
+            # Stacked single launch — same stack as the eager slice
+            # path, so planned≡eager holds for every nnz.
+            b = jnp.stack(
+                [self.buckets((h * self.n, start), k) for h in range(self.nnz)]
+            )
+            v = jnp.stack(
+                [
+                    self.values(jnp.float32, (h * self.n, start), k)
+                    for h in range(self.nnz)
+                ]
+            )
+            v = jnp.where(valid[None, :], v, jnp.zeros((), jnp.float32))
+            fuse = (
+                acc is not None
+                and dtype == jnp.float32
+                and acc.dtype == jnp.float32
+            )
+            if fuse:
+                return _segment_sum_rows(A_block, b, v, self.s, mode, acc=acc)
+            out = _segment_sum_rows(A_block, b, v, self.s, mode).astype(dtype)
+            if acc is not None:
+                return acc + out.astype(acc.dtype)
+            return out
         out = jnp.zeros((self.s, m), dtype)
         for h in range(self.nnz):
             b = self.buckets((h * self.n, start), k)
-            v = self.values(vdt, (h * self.n, start), k)
-            v = jnp.where(valid, v, jnp.zeros((), vdt))
-            if fuse:
-                return _segment_sum_rows(
-                    A_block, b, v, self.s, mode, acc=acc
-                )
+            v = self.values(dtype, (h * self.n, start), k)
+            v = jnp.where(valid, v, jnp.zeros((), dtype))
             out = out + _segment_sum_rows(
                 A_block, b, v, self.s, mode
             ).astype(dtype)
@@ -503,22 +539,32 @@ class HashSketch(SketchTransform):
             if dim is Dimension.COLUMNWISE:
                 return M.T @ A.astype(dtype)
             return A.astype(dtype) @ M
-        if dim is Dimension.COLUMNWISE and self.nnz == 1:
-            # Single hash: the scatter-add IS the windowed row scatter,
-            # so the full dense apply rides the same dispatcher (and the
-            # same Pallas kernel, when engaged) as the streaming slices.
-            mode = _window_mode(self.n, A.shape[1], self.s, dtype)
-            b1 = self.buckets()
-            v1 = self.values(dtype if mode == "xla" else jnp.float32)
-            return _segment_sum_rows(A, b1, v1, self.s, mode).astype(dtype)
         b = self.buckets().reshape(self.nnz, self.n)
-        v = self.values(dtype).reshape(self.nnz, self.n)
         if dim is Dimension.COLUMNWISE:
+            # The scatter-add IS the windowed row scatter, so the full
+            # dense apply rides the same dispatcher (and the same Pallas
+            # kernel, when engaged) as the streaming slices; nnz>1
+            # stacks every hash function into one launch.
+            mode = _window_mode(self.n, A.shape[1], self.s, dtype, self.nnz)
+            if mode != "xla":
+                v = self.values(jnp.float32).reshape(self.nnz, self.n)
+                return _segment_sum_rows(A, b, v, self.s, mode).astype(dtype)
             # SA[r, c] = Σ_{h,i: b[h,i]=r} v[h,i]·A[i, c] — one scatter-add.
+            v = self.values(dtype).reshape(self.nnz, self.n)
             stacked = (v[:, :, None] * A[None, :, :]).reshape(-1, A.shape[1])
             return jax.ops.segment_sum(
                 stacked, b.reshape(-1), num_segments=self.s
             )
+        # ROWWISE: (A·S^T) = (S·A^T)^T — one transpose normalizes the
+        # lane-axis scatter into the kernel's sublane-dynamic form, so
+        # rowwise applies ride the same window kernel.
+        mode = _window_mode(self.n, A.shape[0], self.s, dtype, self.nnz)
+        if mode != "xla":
+            v = self.values(jnp.float32).reshape(self.nnz, self.n)
+            return _segment_sum_rows(
+                A.astype(dtype).T, b, v, self.s, mode
+            ).T.astype(dtype)
+        v = self.values(dtype).reshape(self.nnz, self.n)
         stacked = (A[:, None, :] * v[None, :, :]).reshape(A.shape[0], -1)
         return jax.ops.segment_sum(
             stacked.T, b.reshape(-1), num_segments=self.s

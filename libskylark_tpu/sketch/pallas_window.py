@@ -32,10 +32,24 @@ Out-of-domain counter draws (WZT's 1/Exp can be inf) must be zeroed by
 the CALLER in ``v`` before the call — inf·0 would otherwise poison the
 row — which the hash dispatcher already does for traced windows.
 
+Stacked hashes: ``b``/``v`` may be (nnz, k) — the OSNAP/SJLT layout —
+in which case every hash function's entries accumulate into the SAME
+persistent scratch in one launch (the A tile streams through VMEM once
+for all nnz hashes instead of once per hash).  The 1-D form is exactly
+the nnz=1 special case of the stacked kernel, so the generated op
+sequence for nnz=1 is unchanged.
+
+The module also carries the FJLT sampled-transform epilogue
+(:func:`gather_scaled_rows`): ``out[j, :] = scale · T[idx[j], :]`` — a
+scalar-indexed vector COPY instead of an RMW, same sublane-dynamic
+addressing, bitwise equal to the XLA ``scale * T[idx, :]`` gather (pure
+selection + the same elementwise multiply in the same dtype).
+
 Fallback: anything unsupported (gate below) keeps the XLA path;
 ``SKYLARK_NO_PALLAS=1`` forces it.  ``hash._window_compiles`` runs
 :func:`self_check` once per process before the TPU-default route
-engages (the ``_kernel_compiles`` probe pattern).
+engages (the ``_kernel_compiles`` probe pattern);
+``fjlt._gather_compiles`` does the same with :func:`self_check_gather`.
 """
 
 from __future__ import annotations
@@ -46,7 +60,16 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-__all__ = ["scatter_rows", "supported", "worthwhile", "self_check"]
+__all__ = [
+    "scatter_rows",
+    "supported",
+    "worthwhile",
+    "self_check",
+    "gather_scaled_rows",
+    "supported_gather",
+    "worthwhile_gather",
+    "self_check_gather",
+]
 
 # Entries per grid step along the chunk axis.  Larger chunks cut
 # grid-step overhead at the cost of the (ck, TM) A-tile VMEM; the
@@ -64,6 +87,9 @@ _MAX_K = 150_000_000
 # Default-on threshold: below this many entries the launch overhead of
 # the scalar-loop kernel is not worth it over XLA's scatter.
 _MIN_K = int(os.environ.get("SKYLARK_WINDOW_MIN_K", "4096"))
+# Default-on threshold for the sampled-epilogue gather: below this many
+# sampled rows XLA's gather is already launch-bound cheap.
+_MIN_GATHER = int(os.environ.get("SKYLARK_WINDOW_MIN_GATHER", "512"))
 
 
 def _ceil_to(x: int, q: int) -> int:
@@ -80,24 +106,25 @@ def _tiles(k: int, num_segments: int, m: int):
     return ck, Kc, TM, Tm, S_pad
 
 
-def supported(k: int, num_segments: int, m: int) -> bool:
-    """Hard feasibility of the window kernel for a (k, m) block — shape
-    and VMEM only.  Forced modes (``SKYLARK_PALLAS_WINDOW=1|interpret``)
-    honor this gate but not :func:`worthwhile`."""
+def supported(k: int, num_segments: int, m: int, nnz: int = 1) -> bool:
+    """Hard feasibility of the window kernel for a (k, m) block with
+    ``nnz`` stacked hash functions — shape and VMEM only.  Forced modes
+    (``SKYLARK_PALLAS_WINDOW=1|interpret``) honor this gate but not
+    :func:`worthwhile`."""
     if os.environ.get("SKYLARK_NO_PALLAS", "0") == "1":
         return False
-    if k < 1 or num_segments < 1 or m < 1:
+    if k < 1 or num_segments < 1 or m < 1 or nnz < 1:
         return False
-    if k > _MAX_K:
+    if nnz * k > _MAX_K:
         return False
     _, _, TM, _, S_pad = _tiles(k, num_segments, m)
     return S_pad * TM <= _VMEM_ELEMS
 
 
-def worthwhile(k: int, num_segments: int, m: int) -> bool:
+def worthwhile(k: int, num_segments: int, m: int, nnz: int = 1) -> bool:
     """Amortization gate for the TPU-DEFAULT route (forced modes skip
     it): enough entries to pay the launch + scalar-loop setup."""
-    return k >= _MIN_K
+    return nnz * k >= _MIN_K
 
 
 def _window_kernel(with_acc: bool, *refs):
@@ -114,18 +141,21 @@ def _window_kernel(with_acc: bool, *refs):
     def _zero():
         sc_ref[:, :] = jnp.zeros_like(sc_ref)
 
-    ck = b_ref.shape[1]
+    nnz, ck = b_ref.shape
 
     def entry(i, c):
-        # One scalar-indexed VECTOR accumulate per entry: dynamic
+        # One scalar-indexed VECTOR accumulate per (hash, entry): dynamic
         # sublane addressing only (pl.ds on the second-minor axis —
         # the same RMW shape Mosaic lowers in pallas_scatter's
-        # lane-masked mode); the full TM-lane row rides the VPU.
-        r = b_ref[0, i]
+        # lane-masked mode); the full TM-lane row rides the VPU.  The
+        # hash axis is a STATIC unroll — the A row loads once per entry
+        # and feeds all nnz accumulates.
         row = a_ref[pl.ds(i, 1), :].astype(jnp.float32)
-        sc_ref[pl.ds(r, 1), :] = (
-            sc_ref[pl.ds(r, 1), :] + v_ref[0, i] * row
-        )
+        for h in range(nnz):
+            r = b_ref[h, i]
+            sc_ref[pl.ds(r, 1), :] = (
+                sc_ref[pl.ds(r, 1), :] + v_ref[h, i] * row
+            )
         return c
 
     jax.lax.fori_loop(0, ck, entry, 0)
@@ -144,6 +174,7 @@ def _scatter_rows_impl(A, b, v, acc, num_segments, interpret, with_acc):
     from jax.experimental.pallas import tpu as pltpu
 
     k, m = A.shape
+    nnz = b.shape[0]
     ck, Kc, TM, Tm, S_pad = _tiles(k, num_segments, m)
     if A.dtype not in (jnp.float32, jnp.bfloat16):
         # f32-accumulate boundary cast (f64 arrives only via callers
@@ -151,13 +182,21 @@ def _scatter_rows_impl(A, b, v, acc, num_segments, interpret, with_acc):
         A = A.astype(jnp.float32)
     kp, mp = Kc * ck - k, Tm * TM - m
     A_p = jnp.pad(A, ((0, kp), (0, mp)))
-    b_p = jnp.pad(b.astype(jnp.int32), (0, kp)).reshape(Kc, ck)
-    v_p = jnp.pad(v.astype(jnp.float32), (0, kp)).reshape(Kc, ck)
+    # Stacked-hash layout: chunk-major rows, (nnz, ck) per chunk, so one
+    # (nnz, ck) block per grid step lands contiguously at block index kc.
+    b_p = (
+        jnp.pad(b.astype(jnp.int32), ((0, 0), (0, kp)))
+        .reshape(nnz, Kc, ck).transpose(1, 0, 2).reshape(Kc * nnz, ck)
+    )
+    v_p = (
+        jnp.pad(v.astype(jnp.float32), ((0, 0), (0, kp)))
+        .reshape(nnz, Kc, ck).transpose(1, 0, 2).reshape(Kc * nnz, ck)
+    )
 
     in_specs = [
-        pl.BlockSpec((1, ck), lambda tm, kc: (kc, 0),
+        pl.BlockSpec((nnz, ck), lambda tm, kc: (kc, 0),
                      memory_space=pltpu.VMEM),
-        pl.BlockSpec((1, ck), lambda tm, kc: (kc, 0),
+        pl.BlockSpec((nnz, ck), lambda tm, kc: (kc, 0),
                      memory_space=pltpu.VMEM),
         pl.BlockSpec((ck, TM), lambda tm, kc: (kc, tm),
                      memory_space=pltpu.VMEM),
@@ -193,13 +232,18 @@ def scatter_rows(A, b, v, num_segments: int, *, acc=None, interpret=False):
     [0, num_segments), ``v`` f32 with any out-of-domain entries already
     zeroed by the caller.  ``acc``, when given, must be (num_segments,
     m) f32 — the fused result is bitwise equal to ``acc + scatter_rows(
-    ...)`` (one IEEE add of the same partial).  Caller gates with
-    :func:`supported`."""
+    ...)`` (one IEEE add of the same partial).  ``b``/``v`` may also be
+    stacked (nnz, k) — every hash row scatters into the same output in
+    one launch.  Caller gates with :func:`supported`."""
     if acc is not None and acc.dtype != jnp.float32:
         raise TypeError(
             f"fused acc must be float32, got {acc.dtype}; the unfused "
             "composite handles other accumulator dtypes"
         )
+    if b.ndim == 1:
+        b, v = b[None, :], v[None, :]
+    if b.shape != v.shape:
+        raise ValueError(f"b/v shape mismatch: {b.shape} vs {v.shape}")
     return _scatter_rows_impl(
         A, b, v, acc if acc is not None else jnp.zeros((), jnp.float32),
         num_segments, interpret, acc is not None,
@@ -208,21 +252,139 @@ def scatter_rows(A, b, v, num_segments: int, *, acc=None, interpret=False):
 
 def self_check(
     k: int = 16384, num_segments: int = 1000, m: int = 320,
-    interpret: bool = False,
+    interpret: bool = False, nnz: int = 1,
 ) -> float:
     """Max *relative* error of the window kernel vs the XLA reference on
     random buckets/values — the ONE validator shared by the TPU-default
     probe (``hash._window_compiles``) and the hardware guard
     (``tests/_hw_guards.py``), so the two cannot drift.  The off-tile
-    shape (S=1000, m=320) exercises every padding seam.  Raises on
-    lowering failure; callers decide the tolerance (1e-5 is the
-    established hardware bar)."""
+    shape (S=1000, m=320) exercises every padding seam.  ``nnz > 1``
+    validates the stacked-hash layout.  Raises on lowering failure;
+    callers decide the tolerance (1e-5 is the established hardware
+    bar)."""
     k1, k2, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
-    b = jax.random.randint(k1, (k,), 0, num_segments, dtype=jnp.int32)
-    v = jax.random.normal(k2, (k,), jnp.float32)
+    shape = (k,) if nnz == 1 else (nnz, k)
+    b = jax.random.randint(k1, shape, 0, num_segments, dtype=jnp.int32)
+    v = jax.random.normal(k2, shape, jnp.float32)
     A = jax.random.normal(k3, (k, m), jnp.float32)
     out = scatter_rows(A, b, v, num_segments, interpret=interpret)
-    ref = jax.ops.segment_sum(v[:, None] * A, b, num_segments=num_segments)
+    ref = jax.ops.segment_sum(
+        (v.reshape(nnz, k)[:, :, None] * A[None, :, :]).reshape(-1, m),
+        b.reshape(-1), num_segments=num_segments,
+    )
     jax.block_until_ready((out, ref))
     scale = jnp.maximum(jnp.max(jnp.abs(ref)), 1e-30)
     return float(jnp.max(jnp.abs(out - ref)) / scale)
+
+
+# ---------------------------------------------------------------------------
+# FJLT sampled-transform epilogue: scaled row gather.
+# ---------------------------------------------------------------------------
+
+
+def _gather_tiles(nrows: int, s: int, m: int):
+    """(cs, Sc, TM, Tm, R_pad) for sampling s rows of a (nrows, m) T."""
+    cs = min(_ceil_to(1024, 128), _ceil_to(s, 128))
+    Sc = -(-s // cs)
+    TM = min(_TM, _ceil_to(m, 128))
+    Tm = -(-m // TM)
+    R_pad = _ceil_to(nrows, 8)
+    return cs, Sc, TM, Tm, R_pad
+
+
+def supported_gather(nrows: int, s: int, m: int) -> bool:
+    """Hard feasibility of the gather kernel: the full (R_pad, TM)
+    source tile must fit the VMEM budget alongside the (cs, TM) out."""
+    if os.environ.get("SKYLARK_NO_PALLAS", "0") == "1":
+        return False
+    if nrows < 1 or s < 1 or m < 1 or s > _MAX_K:
+        return False
+    _, _, TM, _, R_pad = _gather_tiles(nrows, s, m)
+    return R_pad * TM <= _VMEM_ELEMS
+
+
+def worthwhile_gather(nrows: int, s: int, m: int) -> bool:
+    """Amortization gate for the TPU-DEFAULT route: enough sampled rows
+    to beat XLA's already-cheap gather."""
+    return s >= _MIN_GATHER
+
+
+def _gather_kernel(idx_ref, t_ref, scale_ref, out_ref):
+    from jax.experimental import pallas as pl
+
+    _, cs = idx_ref.shape
+    scale = scale_ref[0, 0]
+
+    def entry(i, c):
+        # Scalar-indexed vector COPY: pure selection plus the same
+        # elementwise multiply XLA's ``scale * T[idx, :]`` performs, in
+        # the same dtype — bitwise equal to the gather composite.
+        r = idx_ref[0, i]
+        out_ref[pl.ds(i, 1), :] = t_ref[pl.ds(r, 1), :] * scale
+        return c
+
+    jax.lax.fori_loop(0, cs, entry, 0)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def _gather_rows_impl(T, idx, scale, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nrows, m = T.shape
+    (s,) = idx.shape
+    cs, Sc, TM, Tm, R_pad = _gather_tiles(nrows, s, m)
+    sp, mp = Sc * cs - s, Tm * TM - m
+    T_p = jnp.pad(T, ((0, R_pad - nrows), (0, mp)))
+    # Padded indices select row 0 of T; those rows are cropped below.
+    idx_p = jnp.pad(idx.astype(jnp.int32), (0, sp)).reshape(Sc, cs)
+    scale_arr = jnp.asarray(scale, T.dtype).reshape(1, 1)
+
+    out = pl.pallas_call(
+        _gather_kernel,
+        grid=(Tm, Sc),
+        in_specs=[
+            pl.BlockSpec((1, cs), lambda tm, sc: (sc, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R_pad, TM), lambda tm, sc: (0, tm),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda tm, sc: (0, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (cs, TM), lambda tm, sc: (sc, tm), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((Sc * cs, Tm * TM), T.dtype),
+        interpret=interpret,
+    )(idx_p, T_p, scale_arr)
+
+    return out[:s, :m]
+
+
+def gather_scaled_rows(T, idx, scale, *, interpret=False):
+    """``out[j, :] = scale * T[idx[j], :]`` — the FJLT sampled-transform
+    epilogue as one scalar-indexed vector-copy kernel.  ``T`` is
+    (nrows, m) float, ``idx`` int in [0, nrows), ``scale`` a python
+    float / 0-d array cast to ``T.dtype``.  Bitwise equal to the XLA
+    composite ``scale * T[idx, :]`` (selection plus the identical
+    elementwise multiply).  Caller gates with
+    :func:`supported_gather`."""
+    return _gather_rows_impl(T, idx, scale, interpret)
+
+
+def self_check_gather(
+    nrows: int = 3000, s: int = 4096, m: int = 320,
+    interpret: bool = False,
+) -> float:
+    """Max relative error of the gather kernel vs ``scale * T[idx, :]``
+    on a padding-seam shape.  Expected 0.0 exactly (pure selection +
+    identical multiply); raises on lowering failure."""
+    k1, k2 = jax.random.split(jax.random.PRNGKey(11))
+    T = jax.random.normal(k1, (nrows, m), jnp.float32)
+    idx = jax.random.randint(k2, (s,), 0, nrows, dtype=jnp.int32)
+    scale = 0.3125
+    out = gather_scaled_rows(T, idx, scale, interpret=interpret)
+    ref = jnp.asarray(scale, T.dtype) * T[idx, :]
+    jax.block_until_ready((out, ref))
+    scale_r = jnp.maximum(jnp.max(jnp.abs(ref)), 1e-30)
+    return float(jnp.max(jnp.abs(out - ref)) / scale_r)

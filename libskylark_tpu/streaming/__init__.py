@@ -33,7 +33,7 @@ from .elastic import (
     world_info,
 )
 from .engine import StreamParams, as_block_factory, run_stream, skip_batches
-from .pipeline import Prefetcher, PrefetchStats, device_placer
+from .pipeline import Prefetcher, PrefetchStats, device_placer, pinned_placer
 from .repartition import (
     ResumePlan,
     execute_rank_plan,
@@ -54,6 +54,7 @@ __all__ = [
     "Prefetcher",
     "PrefetchStats",
     "device_placer",
+    "pinned_placer",
     "ElasticParams",
     "RowPartition",
     "HostLedger",

@@ -71,6 +71,7 @@ from ..utils.exceptions import (
     WorldMismatchError,
 )
 from .engine import StreamParams, as_block_factory, run_stream
+from .pipeline import device_placer, pinned_placer
 
 __all__ = [
     "RowPartition",
@@ -515,11 +516,23 @@ def _handshake(
 def _local_params(params, hdir, expect_epoch: int | None = None) -> StreamParams:
     """This rank's private view of the shared params: same knobs, but
     checkpoints under the rank's host directory (and restores pinned to
-    the rank's elastic epoch when one is set)."""
+    the rank's elastic epoch when one is set).  The default placer is
+    re-bound to the rank's own first addressable device so staged
+    batches land on this rank's chip, never the implicit process
+    default; a caller-supplied placer is kept verbatim."""
+    placer = params.placer
+    if placer is device_placer:
+        import jax
+
+        local = jax.local_devices()
+        if local:
+            placer = pinned_placer(local[0])
     return StreamParams(
         prefetch=params.prefetch,
-        placer=params.placer,
+        placer=placer,
         expect_epoch=expect_epoch,
+        fused_chunks=getattr(params, "fused_chunks", None),
+        overlap=getattr(params, "overlap", None),
         checkpoint_dir=hdir,
         checkpoint_every=params.checkpoint_every,
         keep_last=params.keep_last,

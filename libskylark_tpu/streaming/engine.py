@@ -30,6 +30,7 @@ from itertools import islice
 import numpy as np
 
 from .. import guard, plans, telemetry
+from . import overlap as _overlap
 from ..resilient import ChunkedSolver, ResilientParams, ResilientRunner
 from .pipeline import Prefetcher, device_placer
 
@@ -40,24 +41,30 @@ class StreamParams(ResilientParams):
     """Runtime knobs of a streaming pass — the resilient runner's params
     (checkpointing, retries, divergence) plus the pipeline's:
     ``prefetch`` staged batches (0 disables the pipeline thread), the
-    staging ``placer`` (host→device by default), and ``fused_chunks``
+    staging ``placer`` (host→device by default), ``fused_chunks``
     — whether planned accumulate steps trace the transform's fused
     chunk body (``apply_slice_kernel_acc``: one kernel launch per
     chunk where supported; bitwise equal to the two-step composite
-    either way).  ``None`` defers to the process default
-    (``plans.fused_enabled`` / ``SKYLARK_NO_FUSED_CHUNKS``).
+    either way; ``None`` defers to the process default
+    ``plans.fused_enabled`` / ``SKYLARK_NO_FUSED_CHUNKS``) — and
+    ``overlap``: whether the fold rides async dispatch and syncs only
+    at chunk boundaries (:mod:`~libskylark_tpu.streaming.overlap`;
+    ``None`` defers to the default-on resolution, ``SKYLARK_NO_OVERLAP=1``
+    kills it everywhere).  Overlap is bitwise-free: same blocks, same
+    order, same IEEE accumulation — only the host's wait points move.
 
     ``checkpoint_every`` counts BATCHES per checkpoint round here.
     """
 
     def __init__(
         self, *, prefetch: int = 2, placer=device_placer,
-        fused_chunks: bool | None = None, **kw,
+        fused_chunks: bool | None = None, overlap: bool | None = None, **kw,
     ):
         super().__init__(**kw)
         self.prefetch = int(prefetch)
         self.placer = placer
         self.fused_chunks = fused_chunks
+        self.overlap = overlap
 
 
 def as_block_factory(source):
@@ -185,6 +192,7 @@ def run_stream(
     ``info["recovery"]``.
     """
     params = params or StreamParams()
+    overlapped = _overlap.enabled(getattr(params, "overlap", None))
     cursor = _Cursor(
         as_block_factory(source), params.prefetch, params.placer
     )
@@ -218,8 +226,18 @@ def run_stream(
             if fault_plan is not None:
                 block = fault_plan.corrupt_block(b, block)
             acc = step_fn(acc, block, b)
+            if not overlapped:
+                # Serial reference path: strictly alternate transfer and
+                # compute (the bitwise comparison target of overlap runs).
+                _overlap.step_sync(acc)
             b += 1
             cursor.advance()
+        if overlapped and b > b0:
+            # Overlap mode's ONE barrier per chunk: drain the device
+            # queue before the guard sentinel reads the accumulator and
+            # before the runner can checkpoint this state — a checkpoint
+            # never captures an in-flight donated buffer.
+            _overlap.chunk_sync(acc)
         if sp is not None:
             sp.attrs["batches"] = b - b0
             if guarded and b > b0:
@@ -301,6 +319,16 @@ def run_stream(
             telemetry.inc("prefetch.consumed", st.consumed)
             telemetry.inc("prefetch.hits", st.hits)
             telemetry.inc("prefetch.waits", st.waits)
+            # Time-weighted overlap evidence: producer_seconds is the
+            # staging (parse + transfer-issue) cost, wait_seconds the
+            # part the consumer stalled on — snapshot() derives the
+            # compute-hidden transfer fraction from these two.
+            telemetry.inc(
+                "prefetch.producer_seconds", round(st.producer_seconds, 6)
+            )
+            telemetry.inc(
+                "prefetch.wait_seconds", round(st.wait_seconds, 6)
+            )
             gets = st.hits + st.waits
             telemetry.event(
                 "stream", "prefetch",
@@ -311,6 +339,8 @@ def run_stream(
                     "hits": st.hits,
                     "waits": st.waits,
                     "producer_seconds": round(st.producer_seconds, 6),
+                    "wait_seconds": round(st.wait_seconds, 6),
+                    "overlapped": overlapped,
                     "overlap": round(st.hits / gets, 6) if gets else None,
                 },
             )

@@ -1,0 +1,84 @@
+"""Async device-overlap discipline for the streaming fold.
+
+JAX dispatch is asynchronous: ``step_fn(acc, block, b)`` returns a
+future-backed accumulator as soon as the work is *enqueued*, so while
+chunk k's fused sketch-accumulate executes on device, the prefetch
+thread's ``jax.device_put`` for chunk k+1 (``pipeline.Prefetcher``)
+runs its host→device copy concurrently — the device-level analogue of
+the reference's asynchronous solver tier (AsyRGS/AsyFCG in
+``algorithms/``).  The engine's job is therefore NOT to create overlap
+but to place the synchronization points that bound it:
+
+- **overlap mode** (default): the fold never blocks mid-chunk; one
+  :func:`chunk_sync` at the chunk boundary drains the device queue
+  before the guard sentinel reads the accumulator and before the
+  resilient runner captures the state for a checkpoint.  Donating step
+  plans ping-pong between two physical buffers (the chunk-entry
+  snapshot ``plans.copy_for_donation`` takes plus the donated step
+  output), and the boundary sync guarantees a checkpoint never
+  serializes an in-flight donated buffer.
+- **serial mode** (``SKYLARK_NO_OVERLAP=1`` or
+  ``StreamParams(overlap=False)``): :func:`step_sync` blocks after
+  EVERY step, so transfer and compute strictly alternate — the
+  reference path overlap runs are compared against.
+
+Both modes fold the same blocks in the same order with the same IEEE
+accumulation order — overlap changes *when* the host waits, never what
+the device computes — so overlapped ≡ serial is bitwise by
+construction (asserted over every hash sketch type in
+``tests/test_overlap.py``).
+
+Overlap efficiency is derived from the pipeline stats the engine folds
+into telemetry at stream close: ``producer_seconds`` is the staging
+(parse + transfer-issue) time, ``wait_seconds`` the part of it the
+consumer actually stalled on — so ``1 - wait/producer`` is the
+compute-hidden transfer fraction (``snapshot()["overlap_efficiency"]``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from .. import telemetry
+
+__all__ = ["enabled", "step_sync", "chunk_sync"]
+
+
+def enabled(flag: bool | None = None) -> bool:
+    """Resolve the overlap knob: the ``SKYLARK_NO_OVERLAP=1`` kill
+    switch wins over everything, then an explicit
+    ``StreamParams(overlap=)`` value, then the default — ON (overlap is
+    bitwise-free, so there is no accuracy reason to serialize)."""
+    if os.environ.get("SKYLARK_NO_OVERLAP", "0") == "1":
+        return False
+    if flag is None:
+        return True
+    return bool(flag)
+
+
+def step_sync(acc):
+    """Serial-mode barrier: block until this step's accumulator is
+    materialized before touching the next batch."""
+    import jax
+
+    jax.block_until_ready(acc)
+    return acc
+
+
+def chunk_sync(acc):
+    """Overlap-mode boundary barrier: drain the device queue once per
+    chunk — before the guard sentinel reads the accumulator and before
+    the runner checkpoints the state — and record how long the host
+    actually waited (``stream.sync_seconds``; near-zero when transfers
+    hid behind compute)."""
+    import jax
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(acc)
+    if telemetry.enabled():
+        telemetry.inc("stream.sync_chunks")
+        telemetry.inc(
+            "stream.sync_seconds", round(time.perf_counter() - t0, 6)
+        )
+    return acc

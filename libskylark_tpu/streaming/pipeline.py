@@ -31,17 +31,34 @@ __all__ = [
     "Prefetcher",
     "PrefetchStats",
     "device_placer",
+    "pinned_placer",
     "BucketedBatch",
     "bucketed_placer",
 ]
 
 
-def device_placer(batch):
-    """Default staging function: start the host→device transfer of every
-    array leaf (async — returns as soon as the copies are issued)."""
+def device_placer(batch, device=None):
+    """The ONE host→device staging path: start the transfer of every
+    array leaf (async — returns as soon as the copies are issued).
+    ``device`` pins the destination explicitly (elastic ranks pass their
+    own addressable device so a multi-host pass never stages onto the
+    implicit default); ``None`` keeps JAX's default placement."""
     import jax
 
-    return jax.device_put(batch)
+    if device is None:
+        return jax.device_put(batch)
+    return jax.device_put(batch, device)
+
+
+def pinned_placer(device):
+    """A :func:`device_placer` bound to one destination device — the
+    placer elastic ranks install so every staged batch lands on the
+    rank's own chip."""
+
+    def placer(batch):
+        return device_placer(batch, device)
+
+    return placer
 
 
 class BucketedBatch(NamedTuple):
@@ -53,27 +70,27 @@ class BucketedBatch(NamedTuple):
     true_rows: int
 
 
-def bucketed_placer(gates: tuple = ()):
+def bucketed_placer(gates: tuple = (), device=None):
     """Staging function that pads 2-D host batches up to the bucket
     ladder BEFORE the host→device transfer, so the copy itself — not
     just the compute — settles into one shape per ladder rung (the
     transfer of a ragged tail batch otherwise gets its own XLA transfer
     program).  Pass the consuming transform's ``batch_size_gates`` as
     ``gates`` so thin batches stay unpadded on the eager algorithm's
-    side of a gate.  Non-2-D and sparse batches stage unpadded."""
+    side of a gate.  Non-2-D and sparse batches stage unpadded.  Both
+    branches route through :func:`device_placer`, so ``device`` pinning
+    behaves identically to the unbucketed path."""
     from .. import plans
 
     def placer(batch):
-        import jax
-
         if (
             getattr(batch, "ndim", 0) == 2
             and not hasattr(batch, "todense")
             and plans.enabled()
         ):
             padded, k = plans.pad_rows_to_bucket(batch, gates)
-            return BucketedBatch(jax.device_put(padded), k)
-        return jax.device_put(batch)
+            return BucketedBatch(device_placer(padded, device), k)
+        return device_placer(batch, device)
 
     return placer
 
@@ -81,13 +98,17 @@ def bucketed_placer(gates: tuple = ()):
 @dataclass
 class PrefetchStats:
     """Counters for pipeline introspection; ``hits``/``waits`` partition
-    the consumer's ``get`` calls by whether a staged batch was ready."""
+    the consumer's ``get`` calls by whether a staged batch was ready,
+    and ``wait_seconds`` totals the time those stalls actually cost —
+    against ``producer_seconds`` it yields the compute-hidden transfer
+    fraction (``telemetry.snapshot()["overlap_efficiency"]``)."""
 
     produced: int = 0
     consumed: int = 0
     hits: int = 0
     waits: int = 0
     producer_seconds: float = 0.0
+    wait_seconds: float = 0.0
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
 
 
@@ -152,19 +173,25 @@ class Prefetcher:
         return self
 
     def __next__(self):
+        import time
+
         if self._finished:
             raise StopIteration
+        waited = 0.0
         try:
             item = self._queue.get_nowait()
             ready = True
         except queue.Empty:
+            t0 = time.perf_counter()
             item = self._queue.get()
+            waited = time.perf_counter() - t0
             ready = False
         with self.stats._lock:
             if ready:
                 self.stats.hits += 1
             else:
                 self.stats.waits += 1
+                self.stats.wait_seconds += waited
         if isinstance(item, _Done):
             self._finished = True
             if item.error is not None:

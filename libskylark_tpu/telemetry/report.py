@@ -42,6 +42,13 @@ def snapshot() -> dict:
     snap["plan_cache_hit_rate"] = _ratio(st["hits"], lookups)
     gets = counters.get("prefetch.hits", 0) + counters.get("prefetch.waits", 0)
     snap["prefetch_overlap"] = _ratio(counters.get("prefetch.hits", 0), gets)
+    # Compute-hidden transfer fraction: of the staging (parse +
+    # transfer-issue) seconds the producer spent, how many the consumer
+    # never waited for.  1.0 = every transfer hid behind compute;
+    # None = no prefetch pipeline ran.
+    prod = counters.get("prefetch.producer_seconds", 0.0)
+    wait = min(counters.get("prefetch.wait_seconds", 0.0), prod)
+    snap["overlap_efficiency"] = _ratio(prod - wait, prod)
     snap["guard"] = {
         k.split(".", 1)[1]: v
         for k, v in counters.items()

@@ -59,6 +59,10 @@ POLICY_TIMEOUT_S = 120
 # (plus an HTTP loopback); a worker that never drains, a future that
 # never resolves, or a leaked socket must not stall the tier-1 run.
 SERVE_TIMEOUT_S = 120
+# Overlap tests run full streaming passes twice (overlapped vs serial)
+# plus kill-resume rounds under donation; a fold that never syncs or a
+# resume that re-opens a wedged source must not stall the tier-1 run.
+OVERLAP_TIMEOUT_S = 120
 
 _TIMEOUT_MARKS = {
     "faults": FAULTS_TIMEOUT_S,
@@ -70,6 +74,7 @@ _TIMEOUT_MARKS = {
     "kernels": KERNELS_TIMEOUT_S,
     "policy": POLICY_TIMEOUT_S,
     "serve": SERVE_TIMEOUT_S,
+    "overlap": OVERLAP_TIMEOUT_S,
 }
 
 
@@ -135,6 +140,13 @@ def pytest_configure(config):
         "bitwise request isolation, admission/deadline shedding, "
         "transports); tier-1, guarded by a per-test "
         f"{SERVE_TIMEOUT_S}s timeout",
+    )
+    config.addinivalue_line(
+        "markers",
+        "overlap: async device-overlap streaming tests (overlapped vs "
+        "serial bitwise parity, kill-resume under donation, sync-point "
+        "discipline); tier-1, guarded by a per-test "
+        f"{OVERLAP_TIMEOUT_S}s timeout",
     )
 
 

@@ -1,0 +1,173 @@
+"""Async device-overlap streaming tests (round-11 tentpole).
+
+The lock is the overlap contract (``streaming/overlap.py``): an
+overlapped pass folds the SAME blocks in the SAME order through the
+SAME planned executables as the serial pass — only the host's
+``block_until_ready`` points move (per-chunk boundary vs per-step) —
+so overlapped and serial results must be BITWISE identical for every
+sketch family, ragged tails included.  On top of that: the kill switch
+(``SKYLARK_NO_OVERLAP=1``) must force the serial discipline through the
+default-on resolution, a pass killed and resumed MID-OVERLAP with
+buffer donation forced on must still be bit-for-bit the uninterrupted
+run (the chunk-boundary sync runs BEFORE checkpoint capture, so the
+snapshot can never see an in-flight donated accumulator), and the
+overlapped pass must fund the telemetry overlap-efficiency submetric.
+All on small synthetic data — tier-1.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from libskylark_tpu import sketch as sk
+from libskylark_tpu import streaming
+from libskylark_tpu.core import SketchContext
+from libskylark_tpu.streaming import StreamParams, overlap, skip_batches
+
+pytestmark = pytest.mark.overlap
+
+N, M, S_OUT = 40, 5, 12
+BATCH = 7  # deliberately does not divide N (last block is ragged)
+
+KINDS = ["CWT", "MMT", "JLT"]
+
+
+def blocks_of(A, batch=BATCH):
+    return [A[lo : lo + batch] for lo in range(0, A.shape[0], batch)]
+
+
+def factory_of(A):
+    def factory(start):
+        it = iter(blocks_of(A))
+        return skip_batches(it, start) if start else it
+
+    return factory
+
+
+def run_pass(A, S, *, overlap_flag, params=None):
+    params = params or StreamParams(overlap=overlap_flag)
+    return np.asarray(
+        streaming.sketch(factory_of(A), S, "columnwise", ncols=M,
+                         params=params)
+    )
+
+
+class TestOverlapResolution:
+    def test_default_on(self, monkeypatch):
+        monkeypatch.delenv("SKYLARK_NO_OVERLAP", raising=False)
+        assert overlap.enabled(None) is True
+        assert overlap.enabled(True) is True
+        assert overlap.enabled(False) is False
+
+    def test_kill_switch_wins(self, monkeypatch):
+        monkeypatch.setenv("SKYLARK_NO_OVERLAP", "1")
+        assert overlap.enabled(None) is False
+        assert overlap.enabled(True) is False
+
+
+class TestOverlapBitwise:
+    @pytest.mark.parametrize("kind", KINDS)
+    def test_overlapped_equals_serial(self, kind, rng):
+        A = jnp.asarray(rng.standard_normal((N, M)), jnp.float32)
+        want = run_pass(
+            A, sk.create_sketch(kind, N, S_OUT, context=SketchContext(seed=5)),
+            overlap_flag=False,
+        )
+        got = run_pass(
+            A, sk.create_sketch(kind, N, S_OUT, context=SketchContext(seed=5)),
+            overlap_flag=True,
+        )
+        np.testing.assert_array_equal(got, want)
+
+    @pytest.mark.parametrize("kind", KINDS)
+    def test_kill_switch_pass_is_bitwise_too(self, kind, monkeypatch, rng):
+        # The env kill switch flips the sync discipline, never the math:
+        # a defaulted pass under SKYLARK_NO_OVERLAP=1 stays bitwise equal
+        # to the overlapped pass.
+        A = jnp.asarray(rng.standard_normal((N, M)), jnp.float32)
+        got = run_pass(
+            A, sk.create_sketch(kind, N, S_OUT, context=SketchContext(seed=6)),
+            overlap_flag=True,
+        )
+        monkeypatch.setenv("SKYLARK_NO_OVERLAP", "1")
+        want = run_pass(
+            A, sk.create_sketch(kind, N, S_OUT, context=SketchContext(seed=6)),
+            overlap_flag=None,
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.faults
+class TestKillResumeMidOverlap:
+    @pytest.mark.parametrize("kind", KINDS)
+    def test_resume_under_donation_is_bitwise(
+        self, kind, tmp_path, monkeypatch, rng
+    ):
+        # Donation forced ON + overlap ON: the checkpoint written at the
+        # preemption boundary must hold a settled accumulator (the
+        # chunk-boundary sync runs before capture), never a buffer a
+        # donating step is still allowed to alias — a resumed pass that
+        # is bit-for-bit the uninterrupted one proves it.
+        from libskylark_tpu import plans
+        from libskylark_tpu.resilient import FaultPlan, SimulatedPreemption
+
+        monkeypatch.setenv("SKYLARK_PLAN_DONATE", "1")
+        plans.clear()
+        try:
+            A = jnp.asarray(rng.standard_normal((N, M)), jnp.float32)
+            mk = lambda: sk.create_sketch(  # noqa: E731
+                kind, N, S_OUT, context=SketchContext(seed=15)
+            )
+            want = run_pass(A, mk(), overlap_flag=True)
+
+            ck = str(tmp_path / f"ck_{kind}")
+            with pytest.raises(SimulatedPreemption):
+                streaming.sketch(
+                    factory_of(A), mk(), "columnwise", ncols=M,
+                    params=StreamParams(
+                        checkpoint_dir=ck, checkpoint_every=2, overlap=True
+                    ),
+                    fault_plan=FaultPlan(preempt_after_chunk=1),
+                )
+            got = streaming.sketch(
+                factory_of(A), mk(), "columnwise", ncols=M,
+                params=StreamParams(
+                    checkpoint_dir=ck, checkpoint_every=2, resume=True,
+                    overlap=True,
+                ),
+            )
+            np.testing.assert_array_equal(np.asarray(got), want)
+        finally:
+            plans.clear()  # drop donating executables for later tests
+
+
+@pytest.mark.telemetry
+class TestOverlapTelemetry:
+    def test_efficiency_submetric(self, monkeypatch, rng):
+        from libskylark_tpu import telemetry
+
+        monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+        telemetry.reset()
+        A = jnp.asarray(rng.standard_normal((N, M)), jnp.float32)
+        S = sk.create_sketch("CWT", N, S_OUT, context=SketchContext(seed=8))
+        run_pass(A, S, overlap_flag=True)
+        snap = telemetry.snapshot()
+        counters = snap["counters"]
+        # one boundary sync per chunk, and the producer/wait counters
+        # that fund the efficiency ratio
+        assert counters.get("stream.sync_chunks", 0) >= 1
+        assert "prefetch.producer_seconds" in counters
+        assert "prefetch.wait_seconds" in counters
+        eff = snap["overlap_efficiency"]
+        assert eff is not None and 0.0 <= eff <= 1.0
+
+    def test_serial_pass_counts_no_chunk_syncs(self, monkeypatch, rng):
+        from libskylark_tpu import telemetry
+
+        monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+        telemetry.reset()
+        A = jnp.asarray(rng.standard_normal((N, M)), jnp.float32)
+        S = sk.create_sketch("CWT", N, S_OUT, context=SketchContext(seed=9))
+        run_pass(A, S, overlap_flag=False)
+        counters = telemetry.snapshot()["counters"]
+        assert counters.get("stream.sync_chunks", 0) == 0

@@ -147,6 +147,142 @@ def test_window_self_check_interpret():
 
 
 # ---------------------------------------------------------------------------
+# stacked multi-hash scatter (SJLT, nnz > 1) — ISSUE 11
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("nnz", [2, 3, 4])
+def test_scatter_rows_stacked_matches_segment_sum(rng, nnz):
+    k, s, m = 500, 40, 36
+    A = _rand(rng, (k, m))
+    b = jnp.asarray(rng.integers(0, s, (nnz, k)), jnp.int32)
+    v = _rand(rng, (nnz, k))
+    out = pallas_window.scatter_rows(A, b, v, s, interpret=True)
+    ref = jax.ops.segment_sum(
+        (v[:, :, None] * A[None, :, :]).reshape(-1, m),
+        b.reshape(-1),
+        num_segments=s,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_scatter_rows_stacked_nnz1_degenerates(rng):
+    """A (1, k) stacked call is the SAME layout as the 1-D call — bitwise,
+    not just numerically (the nnz=1 fast path must not fork)."""
+    k, s, m = 257, 24, 17
+    A = _rand(rng, (k, m))
+    b = jnp.asarray(rng.integers(0, s, k), jnp.int32)
+    v = _rand(rng, k)
+    flat = pallas_window.scatter_rows(A, b, v, s, interpret=True)
+    stacked = pallas_window.scatter_rows(A, b[None, :], v[None, :], s,
+                                         interpret=True)
+    np.testing.assert_array_equal(np.asarray(flat), np.asarray(stacked))
+
+
+def test_scatter_rows_stacked_acc_fold_bitwise(rng):
+    """The fused emit holds for nnz > 1 too: acc + part in one launch is
+    bitwise acc + part in two."""
+    k, s, m, nnz = 300, 16, 24, 3
+    A = _rand(rng, (k, m))
+    b = jnp.asarray(rng.integers(0, s, (nnz, k)), jnp.int32)
+    v = _rand(rng, (nnz, k))
+    acc = _rand(rng, (s, m))
+    part = pallas_window.scatter_rows(A, b, v, s, interpret=True)
+    fused = pallas_window.scatter_rows(A, b, v, s, acc=acc, interpret=True)
+    np.testing.assert_array_equal(np.asarray(fused), np.asarray(acc + part))
+
+
+def test_scatter_rows_stacked_shape_mismatch_rejected(rng):
+    A = _rand(rng, (8, 4))
+    b = jnp.zeros((2, 8), jnp.int32)
+    v = _rand(rng, (3, 8))
+    with pytest.raises(ValueError, match="shape"):
+        pallas_window.scatter_rows(A, b, v, 4, interpret=True)
+
+
+def test_stacked_self_check_interpret():
+    assert pallas_window.self_check(1000, 96, 40, nnz=3, interpret=True) < 1e-5
+
+
+def test_sjlt_kernel_path_matches_xla_path(rng, window_interpret):
+    """SJLT (nnz=4) through the stacked single-launch kernel agrees with
+    the XLA per-hash fold (different kernels — tolerance, not bits)."""
+    S = SJLT(N, S_OUT, SketchContext(seed=5))
+    A = _rand(rng, (N, M))
+    kern = S.apply_slice(A[:7], 0)
+    os.environ["SKYLARK_PALLAS_WINDOW"] = "0"
+    xla = S.apply_slice(A[:7], 0)
+    scale = float(jnp.max(jnp.abs(xla))) or 1.0
+    np.testing.assert_allclose(
+        np.asarray(kern), np.asarray(xla), rtol=1e-5, atol=1e-5 * scale
+    )
+
+
+def test_rowwise_kernel_path_matches_xla_path(rng, window_interpret):
+    """ROWWISE dense apply normalizes to the sublane scatter by one
+    transpose; kernel vs XLA on the same sketch, tolerance not bits."""
+    S = _hash(CWT)
+    A = _rand(rng, (9, N))
+    kern = S.apply(A, "rowwise")
+    os.environ["SKYLARK_PALLAS_WINDOW"] = "0"
+    xla = S.apply(A, "rowwise")
+    scale = float(jnp.max(jnp.abs(xla))) or 1.0
+    np.testing.assert_allclose(
+        np.asarray(kern), np.asarray(xla), rtol=1e-5, atol=1e-5 * scale
+    )
+
+
+# ---------------------------------------------------------------------------
+# FJLT sampled-transform gather epilogue — ISSUE 11
+# ---------------------------------------------------------------------------
+
+
+def test_gather_scaled_rows_bitwise_xla(rng):
+    """The gather kernel is pure row selection + one elementwise multiply
+    in the same dtype — bitwise EQUAL to the XLA take, by contract."""
+    nrows, s, m = 600, 48, 36
+    T = _rand(rng, (nrows, m))
+    idx = jnp.asarray(rng.integers(0, nrows, s), jnp.int32)
+    scale = jnp.float32(0.3125)
+    out = pallas_window.gather_scaled_rows(T, idx, scale, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(out), np.asarray(scale * T[idx, :])
+    )
+
+
+def test_gather_self_check_interpret():
+    assert pallas_window.self_check_gather(interpret=True) == 0.0
+
+
+def test_gather_gates():
+    # (R_pad * TM) must fit the VMEM budget: 2000*384 does, a
+    # million-row source does not
+    assert pallas_window.supported_gather(2000, 512, 320)
+    assert not pallas_window.supported_gather(1_000_000, 512, 320)
+    assert pallas_window.worthwhile_gather(2000, 4096, 320)
+    assert not pallas_window.worthwhile_gather(2000, 8, 320)
+
+
+def test_fjlt_gather_epilogue_bitwise_xla(rng, monkeypatch):
+    """FJLT's sampled-transform epilogue through the gather kernel must
+    be bitwise the XLA sampling of the same transform output."""
+    from libskylark_tpu.sketch import fjlt as fjlt_mod
+
+    n, s, m = 64, 24, 7
+    A = _rand(rng, (n, m))
+    monkeypatch.setenv("SKYLARK_NO_SRHT_GEMM", "1")
+    monkeypatch.setenv("SKYLARK_PALLAS_GATHER", "0")
+    S = fjlt_mod.FJLT(n, s, SketchContext(seed=9))
+    xla = S.apply(A, "columnwise")
+    monkeypatch.setenv("SKYLARK_PALLAS_GATHER", "interpret")
+    S2 = fjlt_mod.FJLT(n, s, SketchContext(seed=9))
+    kern = S2.apply(A, "columnwise")
+    np.testing.assert_array_equal(np.asarray(kern), np.asarray(xla))
+
+
+# ---------------------------------------------------------------------------
 # dispatcher routing (static decisions only)
 # ---------------------------------------------------------------------------
 
@@ -267,8 +403,8 @@ def test_kernel_path_matches_xla_path(rng, cls, window_interpret):
 def test_planned_fused_bitwise_eager_ragged(rng, cls, window_interpret):
     """THE fused-chunk contract: planned-fused accumulation over ragged
     batches is bitwise the eager composite fold (CWT/MMT/WZT take the
-    single-launch fused kernel; SJLT nnz=4 pins the composite route of
-    the same entry point)."""
+    single-launch fused kernel; SJLT nnz=4 rides the SAME launch with
+    its hashes stacked on the sublane grid — ISSUE 11)."""
     S = _hash(cls)
     A = _rand(rng, (N, M))
     acc_e = jnp.zeros((S_OUT, M), jnp.float32)

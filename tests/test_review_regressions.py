@@ -373,3 +373,68 @@ def test_disabled_telemetry_server_is_pure_and_hookless():
     )
     assert out.returncode == 0, out.stderr[-2000:]
     assert "SERVE-PURE-OK" in out.stdout
+
+
+@pytest.mark.overlap
+def test_no_read_after_donation_lint():
+    """Static donation lint (ISSUE PR 11): buffer donation invalidates
+    the argument after the call, so every ``donate_argnums`` site in the
+    library must live in an audited module, the engine must snapshot via
+    ``plans.copy_for_donation`` before handing an accumulator to a
+    donating executable, and its chunk-boundary sync must run BEFORE the
+    sentinel read / checkpoint capture — a checkpoint must never hold a
+    buffer a donating step is still allowed to alias.  A grep over call
+    sites rather than a runtime probe: CPU silently ignores donation, so
+    only TPU runs would catch a read-after-donate dynamically."""
+    import inspect
+    import pathlib
+
+    import libskylark_tpu
+
+    pkg = pathlib.Path(libskylark_tpu.__file__).parent
+    # Every module allowed to spell donate_argnums; new sites must be
+    # audited for read-after-donation and added here deliberately.
+    audited = {
+        pkg / "plans" / "plan.py",
+        pkg / "streaming" / "drivers.py",
+    }
+    offenders = [
+        str(p.relative_to(pkg))
+        for p in sorted(pkg.rglob("*.py"))
+        if p not in audited and "donate_argnums" in p.read_text()
+    ]
+    assert not offenders, (
+        f"unaudited donate_argnums sites: {offenders}; audit each for "
+        "read-after-donation (donated buffers are invalid after the "
+        "call) and extend the whitelist in this test"
+    )
+
+    from libskylark_tpu.streaming import engine
+
+    src = inspect.getsource(engine.run_stream)
+    assert "copy_for_donation" in src, (
+        "run_stream no longer snapshots the accumulator via "
+        "plans.copy_for_donation before donating folds — a resumed "
+        "checkpoint could alias a donated buffer"
+    )
+    sync_at = src.find("chunk_sync")
+    sentinel_at = src.find("stream.sentinel_checks")
+    assert sync_at != -1, (
+        "run_stream lost its chunk-boundary sync (overlap contract: "
+        "one block_until_ready per chunk, before state capture)"
+    )
+    assert sentinel_at == -1 or sync_at < sentinel_at, (
+        "chunk_sync must run before the guard-sentinel read / "
+        "checkpoint capture: an in-flight donated accumulator must "
+        "never be observed by host-side state"
+    )
+
+    # kernel_ridge's donating update is the other audited site: its
+    # donated arguments must be rebound from the call's RESULT, never
+    # read again from the pre-call names.
+    from libskylark_tpu.streaming import drivers
+
+    kr = inspect.getsource(drivers.kernel_ridge)
+    assert "donate_argnums" not in kr or "copy_for_donation" in kr or (
+        "= update(" in kr
+    ), "kernel_ridge must rebind donated accumulators from update()'s result"
