@@ -1,0 +1,217 @@
+"""skylark-top: a live terminal view of a serving fleet.
+
+Tails whichever observability surfaces it is pointed at — any mix of:
+
+- ``--url``: a ``serve_http`` front end; polls ``/stats``, ``/healthz``
+  and ``/metrics`` (queue depth, coalesce ratio, p50/p99, shed and
+  fallback counters, flight-recorder violation ids via ``/traces``).
+- ``--telemetry-dir``: the JSONL run-ledger directory
+  (``ledger-<pid>.jsonl``); shows event-kind totals and the most recent
+  guard verdicts / dumped traces.
+- ``--root``: an elastic checkpoint root; folds the epoch-fenced
+  ``host-*/progress.jsonl`` ledgers into per-rank progress
+  (``telemetry.fold_ledgers``).
+
+Pure stdlib + the telemetry fold helpers — no server-side dependency
+beyond the HTTP endpoints, so it runs on a bastion host against a
+remote port forward.  ``--once`` renders a single frame and exits
+(scripts, tests); otherwise the screen refreshes every ``--interval``
+seconds until Ctrl-C.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+import urllib.request
+
+__all__ = ["main", "render_frame"]
+
+
+def _fetch_json(url: str, timeout: float = 2.0):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as fh:
+            return json.loads(fh.read().decode())
+    except Exception as e:  # noqa: BLE001 — a dead server is a displayed fact
+        return {"_error": f"{type(e).__name__}: {e}"}
+
+
+def _tail_ledgers(telemetry_dir: str, limit: int = 2048) -> dict:
+    """Fold the run-ledger files: event-kind totals plus the latest
+    guard / trace / error events (the incident feed)."""
+    kinds: dict[str, int] = {}
+    incidents: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(telemetry_dir, "ledger-*.jsonl"))):
+        try:
+            with open(path, encoding="utf-8", errors="replace") as fh:
+                lines = fh.readlines()[-limit:]
+        except OSError:
+            continue
+        for line in lines:
+            try:
+                rec = json.loads(line)
+            except ValueError:  # torn tail
+                continue
+            kind = rec.get("kind", "?")
+            kinds[kind] = kinds.get(kind, 0) + 1
+            if kind in ("guard", "error", "trace"):
+                incidents.append(rec)
+    incidents.sort(key=lambda r: float(r.get("ts", 0) or 0))
+    return {"kinds": kinds, "incidents": incidents[-8:]}
+
+
+def _fmt(v, nd=2):
+    if v is None:
+        return "n/a"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _serve_lines(stats: dict, health: dict, traces: dict) -> list[str]:
+    if "_error" in stats:
+        return [f"  server: UNREACHABLE ({stats['_error']})"]
+    c = stats.get("counters", {})
+    lat = stats.get("latency", {})
+    reqs = c.get("requests", 0)
+    out = []
+    backend = health.get("backend", "?")
+    reg = health.get("registry", {})
+    out.append(
+        f"  backend {backend}  models {reg.get('models', '?')}"
+        f"  systems {reg.get('systems', '?')}"
+        f"  primed {len(health.get('primed', []))}"
+        f"  worker {'up' if health.get('worker_alive') else 'DOWN'}"
+    )
+    coalesce = (c.get("coalesced", 0) / reqs) if reqs else None
+    out.append(
+        f"  queue {stats.get('queue_depth', '?')}"
+        f"  requests {reqs}  ok {c.get('ok', 0)}"
+        f"  errors {c.get('errors', 0)}"
+        f"  coalesce {_fmt(coalesce)}"
+    )
+    out.append(
+        f"  p50 {_fmt(lat.get('latency_p50_ms'))} ms"
+        f"  p99 {_fmt(lat.get('latency_p99_ms'))} ms"
+        f"  shed_admission {c.get('shed_admission', 0)}"
+        f"  shed_deadline {c.get('shed_deadline', 0)}"
+        f"  solo_retries {c.get('solo_retries', 0)}"
+    )
+    if traces and "_error" not in traces:
+        viol = traces.get("violations", [])
+        line = (
+            f"  traces: {len(traces.get('recent', []))} recent, "
+            f"{len(viol)} violating"
+        )
+        if viol:
+            line += f"  last: {viol[-1]}"
+        out.append(line)
+    return out
+
+
+def _ledger_lines(fold: dict) -> list[str]:
+    out = [
+        "  events: "
+        + (
+            "  ".join(f"{k} {v}" for k, v in sorted(fold["kinds"].items()))
+            or "(none)"
+        )
+    ]
+    for rec in fold["incidents"]:
+        attrs = rec.get("attrs") or {}
+        bits = [f"  [{rec.get('kind')}] {rec.get('name')}"]
+        for key in ("code", "action", "stage", "rung", "status", "verdict"):
+            if key in attrs:
+                bits.append(f"{key}={attrs[key]}")
+        out.append(" ".join(bits))
+    return out
+
+
+def _rank_lines(hosts: dict) -> list[str]:
+    out = [
+        f"  epoch {hosts.get('epoch')}  rows {hosts.get('rows_total', 0)}"
+        f"  stale {hosts.get('stale_records', 0)}"
+        f"  lost {hosts.get('lost_hosts', [])}"
+    ]
+    for rank in sorted(hosts.get("ranks", {})):
+        s = hosts["ranks"][rank]
+        age = time.time() - s["last_ts"] if s["last_ts"] else None
+        out.append(
+            f"  rank {rank:>3}: rows {s['rows']:>10}  batches"
+            f" {s['batches']:>6}  seq {s['last_seq']:>6}"
+            f"  last write {_fmt(age, 1)}s ago"
+        )
+    return out
+
+
+def render_frame(args) -> str:
+    """One full frame as a string (``--once`` prints exactly this)."""
+    lines = [f"skylark-top  {time.strftime('%H:%M:%S')}"]
+    if args.url:
+        base = args.url.rstrip("/")
+        stats = _fetch_json(base + "/stats")
+        health = _fetch_json(base + "/healthz")
+        traces = _fetch_json(base + "/traces")
+        lines.append(f"serve {base}")
+        lines += _serve_lines(stats, health, traces)
+    if args.telemetry_dir:
+        lines.append(f"ledger {args.telemetry_dir}")
+        lines += _ledger_lines(_tail_ledgers(args.telemetry_dir))
+    if args.root:
+        from ..telemetry import fold_ledgers
+
+        lines.append(f"fleet {args.root}")
+        lines += _rank_lines(fold_ledgers(args.root))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="skylark-top",
+        description="live terminal view of a skylark serving fleet",
+    )
+    p.add_argument(
+        "--url", default=None,
+        help="serve_http base URL to poll (/stats, /healthz, /metrics, "
+             "/traces), e.g. http://127.0.0.1:8080",
+    )
+    p.add_argument(
+        "--telemetry-dir", default=None,
+        help="run-ledger directory to tail (ledger-<pid>.jsonl)",
+    )
+    p.add_argument(
+        "--root", default=None,
+        help="elastic checkpoint root: fold host-*/progress.jsonl into "
+             "per-rank progress",
+    )
+    p.add_argument(
+        "--interval", type=float, default=2.0,
+        help="refresh period in seconds (default 2)",
+    )
+    p.add_argument(
+        "--once", action="store_true",
+        help="render a single frame and exit (no screen clearing)",
+    )
+    args = p.parse_args(argv)
+    if not (args.url or args.telemetry_dir or args.root):
+        p.error("nothing to watch: give --url, --telemetry-dir or --root")
+    if args.once:
+        print(render_frame(args))
+        return 0
+    try:
+        while True:
+            frame = render_frame(args)
+            # whole-frame repaint: clear + home, no curses dependency
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

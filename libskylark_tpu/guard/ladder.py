@@ -84,6 +84,9 @@ class RecoveryReport:
             attrs["stage"] = self.stage
             attrs["rung"] = len(self.attempts) - 1
             telemetry.event("guard", action, attrs)
+            # ... and the request trace's view: a rung > 0 here marks
+            # the trace SLO-violating (see telemetry.trace.is_violating)
+            telemetry.trace_event("guard", **attrs)  # attrs carry action
             telemetry.inc("guard.attempts")
             telemetry.inc(f"guard.{action}")
         return a

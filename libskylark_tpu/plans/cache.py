@@ -85,6 +85,10 @@ class PlanCache:
             telemetry.event(
                 "plan", "cache", {"hit": plan is not None, "plan": key[0]}
             )
+            # a serve dispatch in flight sees its plan-cache fate too
+            telemetry.trace_event(
+                "plan", hit=plan is not None, plan=key[0]
+            )
         if plan is not None:
             return plan
         # Build outside the lock (builders may trip jax machinery);

@@ -97,6 +97,16 @@ def consult(
     from .. import telemetry
 
     telemetry.inc("policy.decisions")
+    # route + provenance onto any serve trace this solve is answering
+    telemetry.trace_event(
+        "policy",
+        route=d.route,
+        sketch_type=d.sketch_type,
+        sketch_size=int(d.sketch_size),
+        source=d.source,
+        escalated=d.escalated,
+        reasons=list(d.reasons),
+    )
     if d.route not in ("sketch", "cholesky"):
         telemetry.inc(f"policy.route.{d.route}")
     if d.compute_dtype == "float8_e4m3fn":

@@ -37,7 +37,7 @@ class Entry:
 
     __slots__ = (
         "request", "future", "key", "op", "payload", "squeeze",
-        "t_admit", "deadline", "sketch", "counter_base", "trace",
+        "t_admit", "deadline", "sketch", "counter_base", "trace", "tctx",
     )
 
     def __init__(self, request, future, key, op, payload=None):
@@ -52,6 +52,10 @@ class Entry:
         self.sketch = None
         self.counter_base = None
         self.trace = {"events": []}
+        # TraceContext minted at admission when telemetry is on; its
+        # event list ALIASES trace["events"] so everything attached
+        # mid-flight lands in the response envelope too.
+        self.tctx = None
 
 
 class AdmissionQueue:

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import plans, telemetry
+from ..telemetry.trace import is_violating, next_id
 from ..utils.exceptions import NumericalHealthError, SkylarkError
 from . import protocol
 
@@ -192,6 +193,11 @@ def _finish_ok(entry, out, batch_size, bucket, t_exec_ms):
     if entry.counter_base is not None:
         entry.trace["counter_base"] = entry.counter_base
     telemetry.inc("serve.ok")
+    # a request that answered OK but only after a solo-retry / guard
+    # rung is still an SLO incident: keep it in the violation ring
+    telemetry.finish_trace(
+        entry.tctx, "ok", violation=is_violating(entry.trace["events"])
+    )
     entry.future.set_result(
         protocol.ok_response(entry.request.get("id"), out, entry.trace)
     )
@@ -199,6 +205,19 @@ def _finish_ok(entry, out, batch_size, bucket, t_exec_ms):
 
 def _finish_error(entry, exc, batch_size):
     entry.trace.update(batch_size=batch_size, coalesced=batch_size > 1)
+    code = int(getattr(exc, "code", 100))
+    if entry.tctx is not None:
+        # error_event appends onto the active trace, whose event list
+        # aliases entry.trace["events"] — envelope and recorder in one
+        with telemetry.activate([entry.tctx]):
+            telemetry.error_event(
+                f"serve.{entry.op}", exc, op=entry.op
+            )
+    else:
+        entry.trace["events"].append(
+            {"kind": "error", "code": code, "type": type(exc).__name__}
+        )
+    telemetry.finish_trace(entry.tctx, "error", code=code)
     entry.future.set_result(
         protocol.error_response(entry.request.get("id"), exc, entry.trace)
     )
@@ -206,7 +225,28 @@ def _finish_error(entry, exc, batch_size):
 
 def run_batch(registry, entries) -> None:
     """Execute one coalesced batch; every entry's future is resolved by
-    the time this returns (ok, degraded-solo, or structured error)."""
+    the time this returns (ok, degraded-solo, or structured error).
+
+    Tracing: ONE dispatch span id is minted per call and attached to
+    every traced entry — the k requests a coalesced batch carried share
+    it, and a solo retry (which re-enters here) mints a fresh one, so
+    the two rungs stay distinguishable in the flight recorder.  The
+    traces ride the thread's active set for the duration, so plan-cache
+    and guard events emitted below land on them too."""
+    tctxs = [e.tctx for e in entries if e.tctx is not None]
+    if not tctxs:  # telemetry off: zero tracing work, not even a span id
+        _dispatch(registry, entries)
+        return
+    sid = next_id()
+    n = len(entries)
+    peers = {"peers": [t.trace_id for t in tctxs]} if n > 1 else {}
+    for t in tctxs:
+        t.event("dispatch", span=sid, batch_size=n, **peers)
+    with telemetry.activate(tctxs):
+        _dispatch(registry, entries)
+
+
+def _dispatch(registry, entries) -> None:
     executor = _EXECUTORS[entries[0].op]
     n = len(entries)
     t0 = time.perf_counter()
@@ -217,9 +257,6 @@ def run_batch(registry, entries) -> None:
             telemetry.inc("serve.errors")
             if not isinstance(e, SkylarkError):
                 telemetry.event("serve", "batch_error", {"type": type(e).__name__})
-            entries[0].trace["events"].append(
-                {"kind": "error", "type": type(e).__name__}
-            )
             _finish_error(entries[0], e, n)
             return
         # a poisoned batch: re-run each request alone so one bad payload

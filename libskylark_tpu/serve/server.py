@@ -211,6 +211,9 @@ class Server:
             entry = self._validate(request, fut)
         except SkylarkError as e:
             telemetry.inc("serve.errors")
+            telemetry.error_event(
+                "serve.validate", e, op=request.get("op")
+            )
             fut.set_result(
                 protocol.error_response(
                     request.get("id"), e, {"events": []}
@@ -219,11 +222,40 @@ class Server:
             return fut
         if entry is None:  # ping/stats answered inline
             return fut
+        # Trace minting at admission: None (no allocation) with
+        # telemetry off; the context's event list aliases entry.trace's.
+        entry.tctx = telemetry.mint(
+            entry.op,
+            key=entry.key,
+            request_id=request.get("id"),
+            deadline_ms=request.get(
+                "deadline_ms", self.params.default_deadline_ms
+            ),
+            events=entry.trace["events"],
+        )
+        if entry.tctx is not None:
+            entry.trace["trace_id"] = entry.tctx.trace_id
         try:
             self.queue.offer(entry, on_admit=self._on_admit)
         except SkylarkError as e:  # AdmissionError
             telemetry.inc("serve.shed_admission")
             telemetry.inc("serve.errors")
+            # The envelope carries the queue state that caused the shed:
+            # depth/percentile context a backing-off caller (or a
+            # post-mortem) needs, without a second round trip.
+            entry.trace["events"].append(
+                {
+                    "kind": "admission_shed",
+                    "queue_depth": getattr(e, "queue_depth", None),
+                    "max_depth": getattr(e, "max_depth", None),
+                    **self._queue_state(),
+                }
+            )
+            with telemetry.activate([entry.tctx]):
+                telemetry.error_event("serve.admission", e, op=entry.op)
+            telemetry.finish_trace(
+                entry.tctx, "shed_admission", code=e.code
+            )
             fut.set_result(
                 protocol.error_response(request.get("id"), e, entry.trace)
             )
@@ -322,8 +354,27 @@ class Server:
             entry.counter_base = self.ctx.counter
             entry.sketch = type(system.S)(system.m, system.S.s, self.ctx)
 
-    def _resolve_error(self, entry: Entry, e: SkylarkError) -> None:
+    def _queue_state(self) -> dict:
+        """Queue/latency context folded into shed envelopes (satellite of
+        the observability plane): depth always; serve counters and the
+        p50/p99 only when telemetry is on (they are empty otherwise)."""
+        state: dict = {"depth": len(self.queue)}
+        if telemetry.enabled():
+            counters = telemetry.REGISTRY.snapshot()["counters"]
+            for k in ("requests", "shed_admission", "shed_deadline"):
+                v = counters.get(f"serve.{k}")
+                if v:
+                    state[k] = v
+            state.update(latency_percentiles())
+        return state
+
+    def _resolve_error(
+        self, entry: Entry, e: SkylarkError, status: str = "error"
+    ) -> None:
         telemetry.inc("serve.errors")
+        telemetry.finish_trace(
+            entry.tctx, status, code=getattr(e, "code", 100)
+        )
         entry.future.set_result(
             protocol.error_response(entry.request.get("id"), e, entry.trace)
         )
@@ -343,18 +394,26 @@ class Server:
                 e.trace["queue_ms"] = round(waited_ms, 4)
                 if e.deadline is not None and now > e.deadline:
                     telemetry.inc("serve.shed_deadline")
-                    e.trace["events"].append({"kind": "deadline_shed"})
-                    self._resolve_error(
-                        e,
-                        DeadlineExceededError(
-                            "deadline expired before dispatch",
-                            deadline_ms=e.request.get(
-                                "deadline_ms",
-                                self.params.default_deadline_ms,
-                            ),
-                            waited_ms=round(waited_ms, 4),
-                        ),
+                    e.trace["events"].append(
+                        {
+                            "kind": "deadline_shed",
+                            "waited_ms": round(waited_ms, 4),
+                            **self._queue_state(),
+                        }
                     )
+                    exc = DeadlineExceededError(
+                        "deadline expired before dispatch",
+                        deadline_ms=e.request.get(
+                            "deadline_ms",
+                            self.params.default_deadline_ms,
+                        ),
+                        waited_ms=round(waited_ms, 4),
+                    )
+                    with telemetry.activate([e.tctx]):
+                        telemetry.error_event(
+                            "serve.deadline", exc, op=e.op
+                        )
+                    self._resolve_error(e, exc, status="shed_deadline")
                     continue
                 telemetry.observe("serve.queue_ms", waited_ms)
                 live.append(e)

@@ -10,7 +10,16 @@ language that can write a JSON line can drive a server.
 - :func:`serve_http` — a loopback ``ThreadingHTTPServer``: ``POST /``
   with a request object (or a list of them — submitted concurrently,
   answered as a list, which is how a remote caller reaches the
-  coalescer), ``GET /stats``, ``GET /healthz``.
+  coalescer), plus the read-only observability surface: ``GET /stats``,
+  ``GET /healthz`` (resolved backend, registry census, primed rungs),
+  ``GET /metrics`` (Prometheus text format 0.0.4),
+  ``GET /traces`` (flight-recorder ids; ``?drain=1`` removes what it
+  returns) and ``GET /traces/<id>`` (one trace, JSON).
+
+Every GET is served from snapshots/copies taken under the telemetry
+locks — scrapes never block the worker thread and can never observe a
+torn registry (pinned by the concurrent-scrape test in
+``tests/test_trace.py``).
 """
 
 from __future__ import annotations
@@ -18,6 +27,7 @@ from __future__ import annotations
 import json
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from .. import telemetry
 from . import protocol
 
 __all__ = ["serve_stdio", "serve_http"]
@@ -49,6 +59,29 @@ def serve_stdio(server, in_stream, out_stream) -> int:
     return served
 
 
+def _healthz(srv) -> dict:
+    """Liveness + identity: which backend actually resolved, how much is
+    registered, whether the compile ladder is primed — the three facts a
+    probe needs to tell 'up' from 'up but will stall mid-traffic'."""
+    try:
+        import jax
+
+        backend = str(jax.default_backend())
+    except Exception:  # noqa: BLE001 — health must answer even so
+        backend = "unknown"
+    return {
+        "ok": True,
+        "backend": backend,
+        "registry": {
+            "models": len(srv.registry.models),
+            "systems": len(srv.registry.systems),
+        },
+        "primed": list(srv.primed),
+        "worker_alive": srv._thread is not None and srv._thread.is_alive(),
+        "telemetry": telemetry.enabled(),
+    }
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "skylark-serve"
 
@@ -63,12 +96,44 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_text(self, code: int, text: str, content_type: str) -> None:
+        body = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self):
         srv = self.server.skylark_server
-        if self.path == "/healthz":
-            self._send(200, {"ok": True})
-        elif self.path == "/stats":
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
+            self._send(200, _healthz(srv))
+        elif path == "/stats":
             self._send(200, srv.stats())
+        elif path == "/metrics":
+            from ..telemetry.exposition import CONTENT_TYPE
+
+            self._send_text(
+                200,
+                telemetry.prometheus_text(
+                    extra_gauges={"serve_queue_depth": len(srv.queue)}
+                ),
+                CONTENT_TYPE,
+            )
+        elif path == "/traces":
+            if "drain=1" in query.split("&"):
+                self._send(200, telemetry.drain_traces())
+            else:
+                self._send(200, telemetry.trace_ids())
+        elif path.startswith("/traces/"):
+            trace = telemetry.get_trace(path[len("/traces/"):])
+            if trace is None:
+                self._send(
+                    404, {"ok": False, "error": {"message": "unknown trace"}}
+                )
+            else:
+                self._send(200, trace)
         else:
             self._send(404, {"ok": False, "error": {"message": "not found"}})
 

@@ -25,13 +25,40 @@ the prefetch overlap ratio, and the guard/checkpoint counter groups;
 :func:`report` reduces counters min/max/avg over ``jax.distributed``
 processes under the same ``process_allgather`` + CRC-signature contract
 as ``utils.timer.timer_report``.  See ``docs/observability.md``.
+
+The fleet observability plane rides on top: request-scoped traces
+minted at serve admission (:mod:`.trace` — TraceContext, the bounded
+flight recorder, cross-layer :func:`trace_event` attachment),
+``snapshot(fleet=True)`` cross-host aggregation (:mod:`.fleet` —
+allgathered registries whose merged counters SUM over ranks, plus the
+epoch-fenced ``host-*/progress.jsonl`` ledger fold), and the
+Prometheus text exposition (:mod:`.exposition`) the serve ``/metrics``
+endpoint and ``skylark-top`` scrape.
 """
 
 from .config import enabled, ledger_dir
+from .exposition import prometheus_text
+from .fleet import fleet_snapshot, fold_ledgers, merge_snapshots
 from .ledger import close, configure, emit, event, flush, ledger_path
 from .registry import LOCK, REGISTRY, Registry, inc, observe, reset, set_gauge
 from .report import report, run_summary, snapshot
 from .spans import NOOP_SPAN, Span, span
+from .trace import (
+    RECORDER,
+    FlightRecorder,
+    TraceContext,
+    activate,
+    drain_traces,
+    dump_traces,
+    error_event,
+    get_trace,
+    is_violating,
+    mint,
+    trace_enabled,
+    trace_event,
+    trace_ids,
+)
+from .trace import finish as finish_trace
 
 __all__ = [
     "enabled",
@@ -55,4 +82,24 @@ __all__ = [
     "snapshot",
     "run_summary",
     "report",
+    # tracing + flight recorder
+    "TraceContext",
+    "FlightRecorder",
+    "RECORDER",
+    "mint",
+    "trace_enabled",
+    "is_violating",
+    "activate",
+    "trace_event",
+    "error_event",
+    "finish_trace",
+    "get_trace",
+    "trace_ids",
+    "drain_traces",
+    "dump_traces",
+    # fleet aggregation + exposition
+    "merge_snapshots",
+    "fold_ledgers",
+    "fleet_snapshot",
+    "prometheus_text",
 ]

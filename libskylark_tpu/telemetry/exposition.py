@@ -1,0 +1,92 @@
+"""Prometheus text exposition of the telemetry snapshot.
+
+Renders ``snapshot()`` (or any dict of its shape) as Prometheus
+text-format 0.0.4 — the lingua franca every fleet scraper, the serve
+``GET /metrics`` endpoint, and ``skylark-top --url`` share.  Naming
+rules, stable so dashboards survive refactors:
+
+- every metric is prefixed ``skylark_``; dots and other non-word
+  characters in registry names become underscores;
+- counters are suffixed ``_total`` (``serve.requests`` →
+  ``skylark_serve_requests_total``);
+- histograms expose their streaming moments as four series:
+  ``_count``, ``_sum``, ``_min``, ``_max``;
+- the plan-cache block exports as ``skylark_plans_<counter>`` and the
+  derived ratios (``plan_cache_hit_rate``, ``prefetch_overlap``,
+  ``overlap_efficiency``, serve ``coalesce_ratio`` and latency
+  percentiles) as gauges, skipped when undefined (``None``) rather
+  than exported as NaN.
+
+Rendering reads ONE consistent registry snapshot (one lock
+acquisition) and never touches the worker thread — the concurrency
+contract pinned by the scrape test in ``tests/test_trace.py``.
+"""
+
+from __future__ import annotations
+
+import re
+
+__all__ = ["prometheus_text", "CONTENT_TYPE"]
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _name(raw: str) -> str:
+    n = _SANITIZE.sub("_", str(raw))
+    if not n or n[0].isdigit():
+        n = "_" + n
+    return f"skylark_{n}"
+
+
+def _num(v) -> str:
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)
+
+
+def prometheus_text(snap: dict | None = None, *, extra_gauges=None) -> str:
+    """Prometheus 0.0.4 text body for ``snap`` (default: a fresh
+    ``telemetry.snapshot()``).  ``extra_gauges`` lets a caller inject
+    point-in-time gauges sampled outside the registry (the serve front
+    adds its live queue depth)."""
+    if snap is None:
+        from .report import snapshot
+
+        snap = snapshot()
+    lines: list[str] = []
+
+    def emit(name, kind, value):
+        if value is None:
+            return
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {_num(value)}")
+
+    for k in sorted(snap.get("counters") or {}):
+        emit(_name(k) + "_total", "counter", snap["counters"][k])
+    gauges = dict(snap.get("gauges") or {})
+    gauges.update(extra_gauges or {})
+    for k in sorted(gauges):
+        v = gauges[k]
+        if isinstance(v, (int, float)):
+            emit(_name(k), "gauge", v)
+    for k in sorted(snap.get("histograms") or {}):
+        h = snap["histograms"][k]
+        base = _name(k)
+        emit(base + "_count", "counter", h["count"])
+        emit(base + "_sum", "counter", h["sum"])
+        emit(base + "_min", "gauge", h["min"])
+        emit(base + "_max", "gauge", h["max"])
+    for k, v in sorted((snap.get("plans") or {}).items()):
+        if isinstance(v, (int, float)):
+            emit(_name(f"plans_{k}"), "gauge", v)
+    for k in ("plan_cache_hit_rate", "prefetch_overlap",
+              "overlap_efficiency"):
+        emit(_name(k), "gauge", snap.get(k))
+    serve = snap.get("serve") or {}
+    for k in ("coalesce_ratio", "latency_p50_ms", "latency_p99_ms"):
+        if k in serve and f"serve.{k}" not in (snap.get("counters") or {}):
+            emit(_name(f"serve_{k}"), "gauge", serve[k])
+    if "world" in snap:
+        emit(_name("fleet_world"), "gauge", snap["world"])
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,267 @@
+"""Cross-host telemetry aggregation: one fleet view of many registries.
+
+Two independent folds, composable because both produce/consume the
+``snapshot()`` dict shape:
+
+- :func:`merge_snapshots` — the pure reduction.  Counters sum (the
+  acceptance contract: merged counters EQUAL the sum of per-rank
+  snapshots), histograms merge their streaming moments
+  (count/sum add, min/max extremize), gauges keep the per-rank values
+  under ``gauges_by_rank`` plus a max fold, and the ``plans`` counter
+  block sums like counters.  Derived ratios are recomputed from the
+  merged numbers, never averaged.
+- :func:`fold_ledgers` — the durable half: walks the elastic
+  checkpoint root's ``host-*/progress.jsonl`` ledgers (the PR-6
+  per-host fold records), epoch-fenced exactly like
+  ``streaming.repartition``: only the NEWEST epoch's records merge
+  (``epoch.json`` marker when present, else the max epoch observed),
+  stale epochs are counted, never folded.  The result is one merged
+  timeline ordered by ``(ts, rank, seq)`` plus per-rank progress
+  summaries — the single view an elastic run never had.
+
+:func:`fleet_snapshot` composes them: the live-process side gathers
+every rank's counter vector with ``multihost_utils.process_allgather``
+under the SAME CRC32 name-signature discipline as
+``utils.timer.timer_report`` (every rank must bring the same counter
+names; a mismatch raises instead of silently misaligning columns), and
+the ledger side folds whatever root it is pointed at.  In a
+single-process world the gather degenerates to the local snapshot, so
+``telemetry.snapshot(fleet=True)`` is always safe to call.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import re
+import zlib
+
+import numpy as np
+
+from .report import snapshot as _local_snapshot
+
+__all__ = ["merge_snapshots", "fold_ledgers", "fleet_snapshot"]
+
+_HOST_RE = re.compile(r"host-(\d+)$")
+
+
+def _ratio(num, den):
+    return round(num / den, 6) if den else None
+
+
+def merge_snapshots(snaps: list[dict]) -> dict:
+    """Fold per-rank ``snapshot()`` dicts into one fleet snapshot.
+
+    Merged ``counters[k]`` is exactly ``sum(rank_counters[k])`` over the
+    ranks that carry ``k`` — the acceptance invariant pinned in
+    ``tests/test_trace.py``.
+    """
+    counters: dict = {}
+    histograms: dict = {}
+    gauges_by_rank: dict = {}
+    plans: dict = {}
+    for rank, snap in enumerate(snaps):
+        for k, v in (snap.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + v
+        for k, h in (snap.get("histograms") or {}).items():
+            m = histograms.get(k)
+            if m is None:
+                histograms[k] = dict(h)
+            else:
+                m["count"] += h["count"]
+                m["sum"] += h["sum"]
+                m["min"] = min(m["min"], h["min"])
+                m["max"] = max(m["max"], h["max"])
+        for k, g in (snap.get("gauges") or {}).items():
+            gauges_by_rank.setdefault(k, {})[rank] = g
+        for k, v in (snap.get("plans") or {}).items():
+            if isinstance(v, (int, float)):
+                plans[k] = plans.get(k, 0) + v
+    gauges = {}
+    for k, per_rank in gauges_by_rank.items():
+        nums = [v for v in per_rank.values()
+                if isinstance(v, (int, float))]
+        if nums:
+            gauges[k] = max(nums)
+    out = {
+        "world": len(snaps),
+        "counters": counters,
+        "gauges": gauges,
+        "gauges_by_rank": gauges_by_rank,
+        "histograms": histograms,
+        "plans": plans,
+    }
+    lookups = plans.get("hits", 0) + plans.get("misses", 0)
+    out["plan_cache_hit_rate"] = _ratio(plans.get("hits", 0), lookups)
+    gets = counters.get("prefetch.hits", 0) + counters.get(
+        "prefetch.waits", 0
+    )
+    out["prefetch_overlap"] = _ratio(counters.get("prefetch.hits", 0), gets)
+    for group in ("guard", "checkpoint", "policy", "serve"):
+        out[group] = {
+            k.split(".", 1)[1]: v
+            for k, v in counters.items()
+            if k.startswith(group + ".")
+        }
+    return out
+
+
+def fold_ledgers(root, *, timeline_limit: int = 256) -> dict:
+    """Epoch-fenced fold of every ``host-*/progress.jsonl`` under
+    ``root`` into per-rank summaries + one merged timeline.
+
+    Returns ``{"epoch", "ranks": {rank: {...}}, "timeline": [...],
+    "stale_records", "lost_hosts"}``; a missing/empty root folds to an
+    empty view rather than raising (the exposition surface must stay up
+    when no elastic run ever wrote here).
+    """
+    from ..streaming.elastic import PROGRESS_NAME, read_progress
+    from ..streaming.repartition import read_epoch
+
+    root = str(root)
+    paths = sorted(
+        glob.glob(os.path.join(root, "host-*", PROGRESS_NAME))
+        + glob.glob(os.path.join(root, "epoch-*", "host-*", PROGRESS_NAME))
+    )
+    marker = read_epoch(root)
+    per_path: list[tuple[int, list[dict]]] = []
+    max_epoch = 0
+    lost_hosts = []
+    for path in paths:
+        m = _HOST_RE.search(os.path.dirname(path))
+        rank = int(m.group(1)) if m else -1
+        try:
+            recs = read_progress(path)
+        except Exception:  # noqa: BLE001 — a corrupt host is reported, not fatal
+            lost_hosts.append(rank)
+            continue
+        per_path.append((rank, recs))
+        for rec in recs:
+            max_epoch = max(
+                max_epoch, int((rec.get("attrs") or {}).get("epoch", 0))
+            )
+    epoch = int(marker["epoch"]) if marker else max_epoch
+    ranks: dict = {}
+    timeline = []
+    stale = 0
+    for rank, recs in per_path:
+        for rec in recs:
+            attrs = rec.get("attrs") or {}
+            if int(attrs.get("epoch", 0)) != epoch:
+                stale += 1
+                continue
+            r = int(attrs.get("rank", rank))
+            summary = ranks.setdefault(
+                r,
+                {"records": 0, "rows": 0, "batches": 0,
+                 "last_seq": 0, "last_ts": 0.0},
+            )
+            summary["records"] += 1
+            summary["rows"] += int(attrs.get("rows", 0) or 0)
+            summary["batches"] += int(attrs.get("batches", 1) or 0)
+            summary["last_seq"] = max(
+                summary["last_seq"], int(rec.get("seq", 0) or 0)
+            )
+            summary["last_ts"] = max(
+                summary["last_ts"], float(rec.get("ts", 0) or 0)
+            )
+            timeline.append(rec)
+    timeline.sort(
+        key=lambda rec: (
+            float(rec.get("ts", 0) or 0),
+            int((rec.get("attrs") or {}).get("rank", -1)),
+            int(rec.get("seq", 0) or 0),
+        )
+    )
+    return {
+        "epoch": epoch,
+        "ranks": ranks,
+        "rows_total": sum(r["rows"] for r in ranks.values()),
+        "timeline": timeline[-timeline_limit:],
+        "stale_records": stale,
+        "lost_hosts": lost_hosts,
+    }
+
+
+def _gather_registries(local: dict) -> list[dict]:
+    """Allgather every process's counter/histogram vectors, timer_report
+    discipline: CRC32 name-signature first, positional columns after."""
+    import jax
+
+    if jax.process_count() == 1:
+        return [local]
+    from jax.experimental import multihost_utils
+
+    names = sorted(local["counters"])
+    hnames = sorted(local["histograms"])
+    sig = np.asarray(
+        [
+            zlib.crc32("\x00".join(names).encode()),
+            len(names),
+            zlib.crc32("\x00".join(hnames).encode()),
+            len(hnames),
+        ],
+        np.int64,
+    )
+    sigs = np.atleast_2d(np.asarray(multihost_utils.process_allgather(sig)))
+    if not (sigs == sigs[0]).all():
+        raise RuntimeError(
+            "telemetry.snapshot(fleet=True): processes carry different "
+            f"counter-name sets (this rank has {len(names)} counters); "
+            "every rank must fold the same metrics — the same collective "
+            "contract as utils.timer.timer_report(distributed=True)"
+        )
+    vec = np.asarray(
+        [float(local["counters"][n]) for n in names], np.float64
+    )
+    hvec = np.asarray(
+        [
+            [local["histograms"][n][f] for n in hnames]
+            for f in ("count", "sum", "min", "max")
+        ],
+        np.float64,
+    ).reshape(-1)
+    stacked = np.atleast_2d(
+        np.asarray(multihost_utils.process_allgather(vec))
+    )
+    hstacked = np.atleast_2d(
+        np.asarray(multihost_utils.process_allgather(hvec))
+    )
+    snaps = []
+    for p in range(stacked.shape[0]):
+        h4 = hstacked[p].reshape(4, len(hnames)) if hnames else None
+        snaps.append(
+            {
+                "counters": dict(zip(names, stacked[p].tolist())),
+                "histograms": {
+                    n: {
+                        "count": h4[0, j],
+                        "sum": h4[1, j],
+                        "min": h4[2, j],
+                        "max": h4[3, j],
+                    }
+                    for j, n in enumerate(hnames)
+                }
+                if hnames
+                else {},
+                # gauges/plans are process-local context, not collective
+                # state: only rank 0's ride along (plans counters are
+                # per-process caches anyway).
+                "gauges": local["gauges"] if p == 0 else {},
+                "plans": local["plans"] if p == 0 else {},
+            }
+        )
+    return snaps
+
+
+def fleet_snapshot(root=None) -> dict:
+    """The fleet-wide fold ``telemetry.snapshot(fleet=True)`` returns:
+    allgathered per-rank registries merged by :func:`merge_snapshots`,
+    plus the epoch-fenced ledger fold of ``root`` (or
+    ``SKYLARK_TELEMETRY_FLEET_ROOT``) when one is given."""
+    local = _local_snapshot()
+    merged = merge_snapshots(_gather_registries(local))
+    root = root or os.environ.get("SKYLARK_TELEMETRY_FLEET_ROOT")
+    if root:
+        merged["hosts"] = fold_ledgers(root)
+    return merged

@@ -29,9 +29,24 @@ def _ratio(num, den):
     return round(num / den, 6) if den else None
 
 
-def snapshot() -> dict:
+def snapshot(fleet: bool = False, root=None) -> dict:
     """Fold every status channel into one dict (works even disabled —
-    an empty registry still reports the plan-cache block)."""
+    an empty registry still reports the plan-cache block).
+
+    ``fleet=True`` returns the cross-host fold instead: every rank's
+    registry allgathered under the ``timer_report`` CRC name-signature
+    discipline and merged so counters SUM over ranks, plus — when
+    ``root`` (or ``SKYLARK_TELEMETRY_FLEET_ROOT``) names an elastic
+    checkpoint root — the epoch-fenced fold of its
+    ``host-*/progress.jsonl`` ledgers under ``"hosts"``.  Collective
+    contract: with ``jax.distributed`` initialized EVERY process must
+    make the call (see ``telemetry/fleet.py``); single-process worlds
+    degenerate to the local snapshot's numbers.
+    """
+    if fleet:
+        from .fleet import fleet_snapshot
+
+        return fleet_snapshot(root)
     from .. import plans
 
     snap = REGISTRY.snapshot()

@@ -63,6 +63,10 @@ SERVE_TIMEOUT_S = 120
 # plus kill-resume rounds under donation; a fold that never syncs or a
 # resume that re-opens a wedged source must not stall the tier-1 run.
 OVERLAP_TIMEOUT_S = 120
+# Trace-plane tests drive live servers (worker thread + HTTP scrapers)
+# and fleet folds; a scrape that deadlocks against the worker must not
+# stall the tier-1 run.
+TRACE_TIMEOUT_S = 120
 
 _TIMEOUT_MARKS = {
     "faults": FAULTS_TIMEOUT_S,
@@ -75,6 +79,7 @@ _TIMEOUT_MARKS = {
     "policy": POLICY_TIMEOUT_S,
     "serve": SERVE_TIMEOUT_S,
     "overlap": OVERLAP_TIMEOUT_S,
+    "trace": TRACE_TIMEOUT_S,
 }
 
 
@@ -147,6 +152,12 @@ def pytest_configure(config):
         "serial bitwise parity, kill-resume under donation, sync-point "
         "discipline); tier-1, guarded by a per-test "
         f"{OVERLAP_TIMEOUT_S}s timeout",
+    )
+    config.addinivalue_line(
+        "markers",
+        "trace: fleet observability-plane tests (request tracing, flight "
+        "recorder, cross-host aggregation, exposition endpoints); tier-1, "
+        f"guarded by a per-test {TRACE_TIMEOUT_S}s timeout",
     )
 
 

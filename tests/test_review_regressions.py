@@ -438,3 +438,64 @@ def test_no_read_after_donation_lint():
     assert "donate_argnums" not in kr or "copy_for_donation" in kr or (
         "= update(" in kr
     ), "kernel_ridge must rebind donated accumulators from update()'s result"
+
+
+def test_error_codes_documented_and_traceable(tmp_path, monkeypatch):
+    """Error-code contract (ISSUE PR 12): the 100-113 ladder is only
+    useful if every code (a) has a row in docs/fault_tolerance.md's
+    matrix a supervisor can act on, and (b) surfaces through
+    ``telemetry.error_event`` with a mandatory ``code`` attr so traces,
+    the ledger, and the ``error.code.<n>`` counters all agree.  Static
+    over the exception taxonomy so ADDING a code without documenting it
+    fails here, not in an incident."""
+    import inspect
+    import pathlib
+
+    from libskylark_tpu import telemetry
+    from libskylark_tpu.utils import exceptions as ex
+
+    classes = [
+        obj
+        for _, obj in inspect.getmembers(ex, inspect.isclass)
+        if issubclass(obj, ex.SkylarkError)
+    ]
+    codes = {cls.code for cls in classes}
+    assert codes == set(range(100, 114)), codes  # the ladder, no gaps
+
+    doc = (
+        pathlib.Path(__file__).parent.parent / "docs" / "fault_tolerance.md"
+    ).read_text()
+    undocumented = [c for c in sorted(codes) if f"| {c} |" not in doc]
+    assert not undocumented, (
+        f"error codes missing a docs/fault_tolerance.md matrix row: "
+        f"{undocumented}"
+    )
+
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    telemetry.configure(tmp_path)
+    telemetry.reset()
+    try:
+        for cls in classes:
+            tctx = telemetry.mint(f"probe-{cls.code}")
+            with telemetry.activate([tctx]):
+                telemetry.error_event("probe", cls("probe"))
+            evs = [e for e in tctx.events if e["kind"] == "error"]
+            assert evs and evs[-1]["code"] == cls.code, cls
+        counters = telemetry.REGISTRY.snapshot()["counters"]
+        for cls in classes:
+            assert counters.get(f"error.code.{cls.code}", 0) >= 1, cls
+        telemetry.flush()
+        import json
+
+        ledger = [
+            json.loads(line)
+            for line in open(telemetry.ledger_path(), encoding="utf-8")
+        ]
+        ledger_codes = {
+            r["attrs"]["code"] for r in ledger if r["kind"] == "error"
+        }
+        assert codes <= ledger_codes
+    finally:
+        telemetry.close()
+        telemetry.configure(None)
+        telemetry.reset()

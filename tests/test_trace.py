@@ -1,0 +1,451 @@
+"""Fleet observability plane (ISSUE PR 12): end-to-end request tracing,
+the flight recorder, cross-host aggregation, and the exposition surface.
+
+The acceptance contracts pinned here:
+
+- An e2e trace through a coalesced 3-request batch with one poisoned
+  request yields THREE complete traces — two sharing the batch-dispatch
+  span id, the poisoned one showing the solo-retry rung — and only the
+  poisoned one lands in the violation ring.
+- ``SKYLARK_TELEMETRY=0`` reruns the same workload bit-identically with
+  zero trace allocations (no trace_id in envelopes, empty recorder,
+  empty registry).
+- ``merge_snapshots`` over per-rank snapshots produces counters EQUAL
+  to the per-rank sums, and ``fold_ledgers`` is epoch-fenced exactly
+  like the elastic layer.
+- Concurrent ``/stats`` + ``/metrics`` + ``/traces`` scrapes during
+  live traffic never block the worker and never observe a torn
+  snapshot.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from libskylark_tpu import serve, telemetry
+from libskylark_tpu.core.context import SketchContext
+from libskylark_tpu.telemetry.trace import FlightRecorder, is_violating
+from libskylark_tpu.utils import exceptions as ex
+
+pytestmark = pytest.mark.trace
+
+M, N = 64, 5
+_rng = np.random.default_rng(4321)
+A = _rng.standard_normal((M, N))
+RHS = [_rng.standard_normal(M) for _ in range(6)]
+
+
+@pytest.fixture
+def traced(monkeypatch, tmp_path):
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    monkeypatch.delenv("SKYLARK_TRACE", raising=False)
+    telemetry.configure(tmp_path)
+    telemetry.reset()
+    telemetry.drain_traces()
+    yield tmp_path
+    telemetry.close()
+    telemetry.configure(None)
+    telemetry.reset()
+    telemetry.drain_traces()
+
+
+def _ls_server(max_coalesce=8, max_queue=256, deadline_ms=None):
+    srv = serve.Server(
+        serve.ServeParams(
+            max_coalesce=max_coalesce,
+            max_queue=max_queue,
+            default_deadline_ms=deadline_ms,
+            warm_start=False,
+            prime=False,
+        ),
+        seed=42,
+    )
+    srv.registry.register_system("sys", A, context=SketchContext(seed=9))
+    return srv
+
+
+def _coalesced_poisoned_run():
+    """3 requests queued before the worker starts (one coalesced batch);
+    request 1 carries a NaN payload."""
+    srv = _ls_server()
+    reqs = [
+        serve.make_request("ls_solve", system="sys", b=b.copy())
+        for b in RHS[:3]
+    ]
+    reqs[1]["b"][3] = float("nan")
+    futures = [srv.submit(r) for r in reqs]
+    srv.start()
+    results = [f.result() for f in futures]
+    srv.stop()
+    return results
+
+
+# ---------------------------------------------------------------------------
+# the e2e acceptance trace
+
+
+def test_e2e_coalesced_batch_with_poison_yields_three_traces(traced):
+    results = _coalesced_poisoned_run()
+    assert [r["ok"] for r in results] == [True, False, True]
+
+    tids = [r["trace"]["trace_id"] for r in results]
+    assert len(set(tids)) == 3
+    traces = [telemetry.get_trace(t) for t in tids]
+    assert all(t is not None for t in traces), "all 3 traces complete"
+    assert [t["status"] for t in traces] == ["ok", "error", "ok"]
+
+    # the two healthy requests rode ONE batch dispatch: same span id
+    span = lambda t: [  # noqa: E731
+        e for e in t["events"] if e["kind"] == "dispatch"
+    ]
+    d0, d2 = span(traces[0]), span(traces[2])
+    assert len(d0) == 1 and len(d2) == 1
+    assert d0[0]["span"] == d2[0]["span"]
+    assert d0[0]["batch_size"] == 3
+    assert set(d0[0]["peers"]) == set(tids)
+
+    # the poisoned one shows the solo-retry rung: the shared dispatch,
+    # a fallback, then a FRESH solo dispatch span of batch_size 1
+    d1 = span(traces[1])
+    assert len(d1) == 2
+    assert d1[0]["span"] == d0[0]["span"] and d1[0]["batch_size"] == 3
+    assert d1[1]["span"] != d0[0]["span"] and d1[1]["batch_size"] == 1
+    kinds = [e["kind"] for e in traces[1]["events"]]
+    assert "fallback" in kinds
+    errors = [e for e in traces[1]["events"] if e["kind"] == "error"]
+    assert errors and errors[-1]["code"] == 108
+    assert traces[1]["code"] == 108
+
+    # only the poisoned trace is an SLO violation
+    ids = telemetry.trace_ids()
+    assert set(ids["recent"]) == set(tids)
+    assert ids["violations"] == [tids[1]]
+
+    # violating traces are dumped to the run ledger the moment they
+    # finish (post-mortems need no live process)
+    telemetry.flush()
+    ledger = [
+        json.loads(line)
+        for line in open(telemetry.ledger_path(), encoding="utf-8")
+    ]
+    dumped = [r for r in ledger if r["kind"] == "trace"]
+    assert [r["attrs"]["trace_id"] for r in dumped] == [tids[1]]
+
+
+def test_disabled_telemetry_rerun_is_bit_identical_and_traceless(
+    traced, monkeypatch
+):
+    on = _coalesced_poisoned_run()
+    telemetry.drain_traces()
+    telemetry.reset()
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "0")
+    off = _coalesced_poisoned_run()
+
+    assert [r["ok"] for r in off] == [True, False, True]
+    for r_on, r_off in zip(on, off):
+        if r_on["ok"]:  # bit-identical results, traced or not
+            assert (
+                np.asarray(r_off["result"]) == np.asarray(r_on["result"])
+            ).all()
+    # zero trace allocations: no ids minted, nothing recorded, nothing
+    # counted
+    assert all("trace_id" not in r["trace"] for r in off)
+    assert len(telemetry.RECORDER) == 0
+    assert telemetry.trace_ids() == {"recent": [], "violations": []}
+    assert telemetry.REGISTRY.snapshot()["counters"] == {}
+    assert telemetry.mint("op") is None
+
+
+def test_trace_subgate_disables_minting_but_keeps_counters(
+    traced, monkeypatch
+):
+    monkeypatch.setenv("SKYLARK_TRACE", "0")
+    results = _coalesced_poisoned_run()
+    assert all("trace_id" not in r["trace"] for r in results)
+    assert len(telemetry.RECORDER) == 0
+    counters = telemetry.REGISTRY.snapshot()["counters"]
+    assert counters.get("serve.requests") == 3  # telemetry itself still on
+    assert "trace.minted" not in counters
+
+
+def test_shed_envelopes_carry_queue_state(traced):
+    # admission shed: worker not started, queue depth 1 -> second submit
+    # sheds at the door with the queue state in its envelope
+    srv = _ls_server(max_queue=1)
+    reqs = [
+        serve.make_request("ls_solve", system="sys", b=b) for b in RHS[:2]
+    ]
+    f0 = srv.submit(reqs[0])
+    r1 = srv.submit(reqs[1]).result()
+    assert r1["error"]["code"] == 112
+    shed = [
+        e for e in r1["trace"]["events"] if e["kind"] == "admission_shed"
+    ]
+    assert shed and shed[0]["queue_depth"] == 1 and shed[0]["depth"] == 1
+    assert shed[0]["requests"] == 2
+    errors = [e for e in r1["trace"]["events"] if e["kind"] == "error"]
+    assert errors and errors[0]["code"] == 112
+    tid = r1["trace"]["trace_id"]
+    assert tid in telemetry.trace_ids()["violations"]
+    assert telemetry.get_trace(tid)["status"] == "shed_admission"
+
+    # deadline shed: expired before dispatch -> 113 with waited_ms +
+    # queue state in the envelope event
+    srv2 = _ls_server(deadline_ms=0.001)
+    f = srv2.submit(
+        serve.make_request("ls_solve", system="sys", b=RHS[0])
+    )
+    import time
+
+    time.sleep(0.05)
+    srv2.start()
+    r = f.result()
+    srv2.stop()
+    assert r["error"]["code"] == 113
+    ds = [e for e in r["trace"]["events"] if e["kind"] == "deadline_shed"]
+    assert ds and ds[0]["waited_ms"] > 0 and "depth" in ds[0]
+    assert telemetry.get_trace(r["trace"]["trace_id"])["status"] == (
+        "shed_deadline"
+    )
+    srv.stop()
+    f0.result()
+
+
+def test_flight_recorder_keeps_all_violations_past_capacity():
+    rec = FlightRecorder(capacity=8)
+    for i in range(30):
+        rec.record({"trace_id": f"ok-{i}", "status": "ok"})
+    rec.record({"trace_id": "bad-1", "status": "error"})
+    for i in range(30, 60):
+        rec.record({"trace_id": f"ok-{i}", "status": "ok"})
+    assert len(rec) == 8  # recent ring bounded
+    assert rec.get("bad-1") is not None  # ...but the incident survives
+    assert rec.ids()["violations"] == ["bad-1"]
+    drained = rec.drain()
+    assert [p["trace_id"] for p in drained["violations"]] == ["bad-1"]
+    assert len(rec) == 0 and rec.get("bad-1") is None
+
+
+def test_is_violating_flags_retry_and_guard_rungs():
+    assert not is_violating([{"kind": "dispatch"}, {"kind": "policy"}])
+    assert is_violating([{"kind": "fallback", "reason": "x"}])
+    assert is_violating([{"kind": "error", "code": 108}])
+    assert not is_violating([{"kind": "guard", "rung": 0}])
+    assert is_violating([{"kind": "guard", "rung": 1}])
+
+
+def test_trace_event_bounded_per_trace(traced):
+    tctx = telemetry.mint("op")
+    with telemetry.activate([tctx]):
+        for i in range(200):
+            telemetry.trace_event("spam", i=i)
+    telemetry.finish_trace(tctx, "ok")
+    payload = telemetry.get_trace(tctx.trace_id)
+    assert len(payload["events"]) == 64
+    assert payload["events_dropped"] == 200 - 64
+
+
+# ---------------------------------------------------------------------------
+# cross-host aggregation
+
+
+def test_fleet_merge_counters_equal_sum_of_rank_snapshots(traced):
+    # two simulated ranks: run disjoint workloads, snapshot each
+    per_rank = []
+    for rank in range(2):
+        telemetry.reset()
+        for _ in range(rank + 1):
+            telemetry.inc("serve.requests")
+            telemetry.inc("serve.ok")
+        telemetry.observe("serve.latency_ms", 10.0 * (rank + 1))
+        per_rank.append(telemetry.snapshot())
+    merged = telemetry.merge_snapshots(per_rank)
+    assert merged["world"] == 2
+    for key in ("serve.requests", "serve.ok"):
+        assert merged["counters"][key] == sum(
+            s["counters"].get(key, 0) for s in per_rank
+        )
+    h = merged["histograms"]["serve.latency_ms"]
+    assert h["count"] == 2 and h["sum"] == 30.0
+    assert h["min"] == 10.0 and h["max"] == 20.0
+    assert merged["serve"]["requests"] == 3
+
+    # single-process world: snapshot(fleet=True) degenerates to local
+    telemetry.reset()
+    telemetry.inc("serve.requests", 7)
+    fleet = telemetry.snapshot(fleet=True)
+    assert fleet["world"] == 1
+    assert fleet["counters"]["serve.requests"] == 7
+
+
+def test_fold_ledgers_is_epoch_fenced(tmp_path):
+    from libskylark_tpu.streaming import elastic
+
+    # epoch-0 records from two hosts, then a repartitioned epoch-1 world
+    # where only rank 0 survived — only epoch 1 may fold
+    for epoch, ranks, rows in ((0, (0, 1), 100), (1, (0,), 250)):
+        for rank in ranks:
+            d = elastic.host_dir(tmp_path, rank, epoch)
+            os.makedirs(d, exist_ok=True)
+            led = elastic.HostLedger(
+                os.path.join(d, elastic.PROGRESS_NAME),
+                rank=rank,
+                epoch=epoch,
+            )
+            for b in range(3):
+                led.record("fold", rows=rows, batches=1)
+            led.close()
+    fold = telemetry.fold_ledgers(tmp_path)
+    assert fold["epoch"] == 1  # newest epoch observed wins
+    assert set(fold["ranks"]) == {0}
+    assert fold["rows_total"] == 3 * 250
+    assert fold["stale_records"] == 6  # both epoch-0 hosts fenced out
+    assert all(
+        rec["attrs"]["epoch"] == 1 for rec in fold["timeline"]
+    )
+
+    # empty root folds to an empty view, never raises (the exposition
+    # surface stays up before any elastic run writes here)
+    empty = telemetry.fold_ledgers(tmp_path / "nothing")
+    assert empty["ranks"] == {} and empty["rows_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# exposition surface
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as fh:
+        return fh.getcode(), fh.headers.get("Content-Type"), fh.read()
+
+
+def test_concurrent_scrapes_during_live_traffic(traced):
+    srv = _ls_server().start()
+    httpd = serve.serve_http(srv)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    failures = []
+    stop = threading.Event()
+
+    def scrape(path, check):
+        while not stop.is_set():
+            try:
+                code, _, body = _get(base, path)
+                assert code == 200
+                check(body)
+            except Exception as e:  # noqa: BLE001 — collected, not raised
+                failures.append((path, repr(e)))
+                return
+
+    def check_metrics(body):
+        # never torn: every exposed line is a comment or "name value"
+        for line in body.decode().splitlines():
+            assert line.startswith("#") or (
+                len(line.split()) == 2 and line.startswith("skylark_")
+            ), line
+
+    scrapers = [
+        threading.Thread(
+            target=scrape, args=("/metrics", check_metrics), daemon=True
+        ),
+        threading.Thread(
+            target=scrape,
+            args=("/stats", lambda b: json.loads(b)["counters"]),
+            daemon=True,
+        ),
+        threading.Thread(
+            target=scrape,
+            args=("/traces", lambda b: json.loads(b)["recent"]),
+            daemon=True,
+        ),
+    ]
+    for t in scrapers:
+        t.start()
+    # live traffic while the scrapers hammer the surface
+    results = [
+        srv.call(serve.make_request("ls_solve", system="sys", b=b))
+        for b in RHS * 3
+    ]
+    stop.set()
+    for t in scrapers:
+        t.join(timeout=10)
+    httpd.shutdown()
+    srv.stop()
+    assert not failures, failures
+    assert all(r["ok"] for r in results)  # scrapes never blocked serving
+
+    snap = telemetry.snapshot()
+    assert snap["serve"]["requests"] == len(results)
+
+
+def test_healthz_metrics_and_trace_endpoints(traced):
+    srv = _ls_server().start()
+    httpd = serve.serve_http(srv)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    r = srv.call(serve.make_request("ls_solve", system="sys", b=RHS[0]))
+    tid = r["trace"]["trace_id"]
+
+    code, _, body = _get(base, "/healthz")
+    health = json.loads(body)
+    assert health["ok"] is True
+    assert health["backend"] == "cpu"  # the RESOLVED backend tag
+    assert health["registry"] == {"models": 0, "systems": 1}
+    assert health["worker_alive"] is True and health["telemetry"] is True
+
+    code, ctype, body = _get(base, "/metrics")
+    assert ctype.startswith("text/plain")
+    text = body.decode()
+    assert "# TYPE skylark_serve_requests_total counter" in text
+    assert "skylark_serve_queue_depth 0" in text
+    assert "skylark_trace_minted_total 1" in text
+
+    code, _, body = _get(base, f"/traces/{tid}")
+    assert json.loads(body)["status"] == "ok"
+    code, _, body = _get(base, "/traces")
+    assert json.loads(body)["recent"] == [tid]
+    code, _, body = _get(base, "/traces?drain=1")
+    assert [p["trace_id"] for p in json.loads(body)["recent"]] == [tid]
+    assert telemetry.trace_ids()["recent"] == []  # drained through HTTP
+
+    try:
+        urllib.request.urlopen(base + "/traces/nope", timeout=10)
+        raise AssertionError("unknown trace must 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+    httpd.shutdown()
+    srv.stop()
+
+
+def test_skylark_top_renders_fleet_frame(tmp_path, capsys):
+    from libskylark_tpu.cli import top
+    from libskylark_tpu.streaming import elastic
+
+    d = elastic.host_dir(tmp_path, 0, 0)
+    os.makedirs(d, exist_ok=True)
+    led = elastic.HostLedger(
+        os.path.join(d, elastic.PROGRESS_NAME), rank=0, epoch=0
+    )
+    led.record("fold", rows=500, batches=1)
+    led.close()
+    rc = top.main(["--root", str(tmp_path), "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "rank   0" in out and "rows        500" in out
+
+
+def test_error_response_envelopes_unchanged_for_protocol_peers():
+    """The trace plane may only ADD envelope fields: a 112 from a
+    telemetry-off server still round-trips through the protocol codec
+    exactly as PR-10 shipped it."""
+    exc = ex.AdmissionError("full", queue_depth=4, max_depth=4)
+    frame = serve.encode(serve.error_response("r1", exc, {"events": []}))
+    back = serve.exception_for(serve.decode(frame)["error"])
+    assert type(back) is ex.AdmissionError and back.code == 112
