@@ -1034,6 +1034,139 @@ def bench_serve(on_tpu, table):
           (finished / minted) if minted else 0.0, table, contention=None)
 
 
+def bench_fleet(on_tpu, table):
+    """Fleet scaling (docs/serving.md, fleet section): the sustained
+    mixed single-row drive (LS-solve + KRR-predict — two placement
+    keys, so workers AND replicas both have parallel work) through
+    (a) one worker, (b) two device-pinned workers on one admission
+    queue, and (c) a 2-replica fleet behind the front-door router.
+    ``vs_baseline`` on the (b)/(c) QPS rows is the scaling ratio over
+    (a); the acceptance target is >= 1.7x on multi-chip hardware, and
+    on a single-device/single-core host the honest ratio is ~1x and
+    lands as measured.  The p99 row guards the tail: its ratio is
+    p99_1w/p99_2w, so >= 0.67 means the 2-worker tail stayed within
+    1.5x of single-worker.  The last row is the device-parallel
+    dispatch census: value = sharded programs parity-probed on this
+    backend, ratio = fraction that verified bitwise (a tombstoned
+    program still serves correct bits through the single-device path,
+    so this is hardware truth, not a correctness gate)."""
+    import concurrent.futures as cf
+
+    from libskylark_tpu import serve
+    from libskylark_tpu import telemetry as _tel
+    from libskylark_tpu.ml.kernels import GaussianKernel
+    from libskylark_tpu.ml.model import FeatureMapModel
+    from libskylark_tpu.serve import dispatch
+
+    m, n = (8192, 64) if on_tpu else (512, 16)
+    d, feats = 24, 64
+    total = 64 if _SMOKE else 256
+    clients = 16
+    rng = np.random.default_rng(17)
+    A = rng.standard_normal((m, n))
+    maps = [GaussianKernel(d, 1.3).create_rft(
+        feats, "regular", SketchContext(seed=33)
+    )]
+    model = FeatureMapModel(
+        maps, rng.standard_normal((feats, 4)), scale_maps=True
+    )
+    rhs = [rng.standard_normal(m) for _ in range(8)]
+    xs = [rng.standard_normal(d) for _ in range(8)]
+
+    def make_server(workers):
+        srv = serve.Server(
+            serve.ServeParams(
+                max_coalesce=32, max_queue=8 * total,
+                warm_start=False, prime=True, workers=workers,
+            ),
+            seed=13,
+        )
+        srv.registry.register_system(
+            "sys", A, context=SketchContext(seed=29)
+        )
+        srv.registry.register_model("mdl", model)
+        return srv
+
+    def mk(i):
+        if i % 2 == 0:
+            return serve.make_request(
+                "ls_solve", system="sys", b=rhs[i % len(rhs)]
+            )
+        return serve.make_request(
+            "predict", model="mdl", x=xs[i % len(xs)]
+        )
+
+    def drive(front, stoppers):
+        def one(i):
+            t0 = time.perf_counter()
+            r = front.call(mk(i))
+            dt_ms = (time.perf_counter() - t0) * 1e3
+            if not r["ok"]:
+                raise RuntimeError(r["error"]["message"])
+            return dt_ms
+
+        try:
+            with cf.ThreadPoolExecutor(max_workers=clients) as pool:
+                list(pool.map(one, range(clients)))  # warm every rung
+                t0 = time.perf_counter()
+                lat = sorted(pool.map(one, range(total)))
+            wall = time.perf_counter() - t0
+        finally:
+            for s in stoppers:
+                s.stop()
+        return (
+            total / wall,
+            lat[min(len(lat) - 1, int(len(lat) * 0.99))],
+        )
+
+    srv1 = make_server(1).start()
+    qps1, p99_1 = drive(srv1, [srv1])
+    srv2 = make_server(2).start()
+    qps2, p99_2 = drive(srv2, [srv2])
+    ra, rb = make_server(1).start(), make_server(1).start()
+    router = serve.Router()
+    router.join("a", server=ra)
+    router.join("b", server=rb)
+    qps_r, _ = drive(router, [router, ra, rb])
+
+    _emit("serve fleet 1-worker QPS", qps1, "req/s", 1.0, table,
+          contention=None)
+    _emit("serve fleet 2-worker QPS", qps2, "req/s", qps2 / qps1, table,
+          contention=None)
+    _emit("serve fleet 2-worker p99", p99_2, "ms", p99_1 / p99_2, table,
+          contention=None)
+    _emit("serve fleet 2-replica routed QPS", qps_r, "req/s",
+          qps_r / qps1, table, contention=None)
+
+    # Device-parallel dispatch census: force the shard gate open, run
+    # the same drive once, and count how many sharded programs the
+    # one-time parity probe verified bitwise on this backend.
+    prev = {
+        k: os.environ.get(k)
+        for k in ("SKYLARK_SERVE_SHARD", "SKYLARK_TELEMETRY")
+    }
+    try:
+        os.environ["SKYLARK_SERVE_SHARD"] = "1"
+        os.environ["SKYLARK_TELEMETRY"] = "1"
+        _tel.reset()
+        dispatch.clear_cache()
+        srv = make_server(1).start()
+        drive(srv, [srv])
+        counters = _tel.REGISTRY.snapshot()["counters"]
+    finally:
+        dispatch.clear_cache()
+        _tel.reset()
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    verified = counters.get("serve.sharded_verified", 0)
+    probed = verified + counters.get("serve.sharded_rejected", 0)
+    _emit("serve sharded probes verified", verified, "programs",
+          (verified / probed) if probed else 0.0, table, contention=None)
+
+
 def bench_plan_cache(on_tpu, table):
     """Plan-cache cold vs warm: what one compiled sketch-apply plan costs
     to build (trace + compile + first exec) against what the cached
@@ -1770,6 +1903,11 @@ def main() -> None:
         # throughput contract (docs/serving.md) — coalesced vs serial
         # QPS with p50/p99 for single-row LS-solve and KRR-predict.
         ("serve SLO", 90, lambda: bench_serve(on_tpu, table)),
+        # Fleet scaling rides behind it: the round-13 measurement
+        # (docs/serving.md fleet section) — 2 pinned workers and a
+        # 2-replica routed fleet vs one worker, plus the sharded-
+        # dispatch parity-probe census.
+        ("serve fleet", 90, lambda: bench_fleet(on_tpu, table)),
         # Elastic resume latency rides with them: the round-7
         # fault-tolerance measurement (docs/fault_tolerance.md), world=1
         # dry-run scale so it costs seconds, not minutes.

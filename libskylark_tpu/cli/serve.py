@@ -12,12 +12,27 @@ the ``native/`` parity interchange; docs/serving.md has the schema):
 Warm state is loaded ONCE at startup: ``--model NAME=PATH`` JSON models
 (``ml/model.py`` save format) and ``--system NAME=PATH`` least-squares
 operators (``.npy``) become device-resident before the first request.
+
+Fleet mode:
+
+- ``--workers K`` pins K batcher threads to disjoint local devices
+  (one admission queue, one coalescer — K fused dispatches in flight);
+- ``--replicas K`` (HTTP only) runs K full replica servers behind an
+  in-process :class:`~..serve.router.Router` front door — ``POST /``
+  is placed by key affinity + load, ``GET /fleet`` shows membership;
+- ``--join URL`` announces THIS server to a router front door at URL
+  once it is primed and serving (zero-downtime rollout: the router
+  fences the registry signature and only then places traffic here).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
+import threading
+import urllib.error
+import urllib.request
 
 import numpy as np
 
@@ -79,6 +94,19 @@ def main(argv=None) -> int:
     p.add_argument("--no-prime", dest="prime", action="store_false",
                    help="skip the startup priming dispatches that compile "
                         "the first-rung executables before traffic")
+    p.add_argument("--workers", type=int, default=1,
+                   help="batcher worker threads; K>1 pins each to a "
+                        "distinct local device so independent batches "
+                        "use every chip")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="run K replica servers behind a router front "
+                        "door (requires --http); requests are placed by "
+                        "key affinity, live queue depth and profiled "
+                        "throughput")
+    p.add_argument("--join", default=None, metavar="URL",
+                   help="announce this server to a router front door at "
+                        "URL after priming (requires --http); registry "
+                        "signatures are fenced at join")
     p.add_argument("--x64", action="store_true")
     add_perf_args(p)
     add_policy_args(p)
@@ -97,6 +125,12 @@ def main(argv=None) -> int:
     from .. import serve
     from ..core import SketchContext
 
+    if args.replicas > 1 and args.http is None:
+        raise SystemExit("--replicas needs --http (the front door is HTTP)")
+    if args.join and args.http is None:
+        raise SystemExit("--join needs --http (the router heartbeats this "
+                         "server's /healthz)")
+
     params = serve.ServeParams(
         max_queue=args.max_queue,
         max_coalesce=args.max_coalesce,
@@ -104,44 +138,95 @@ def main(argv=None) -> int:
         default_deadline_ms=args.deadline_ms,
         warm_start=False,  # setup_policy above already replayed
         prime=args.prime,
+        workers=args.workers,
     )
-    server = serve.Server(params, seed=args.seed)
-    for spec in args.model:
-        name, path = _name_path(spec, "--model")
-        server.registry.load_model(name, path)
-        print(f"model {name!r} <- {path}", file=sys.stderr)
-    for spec in args.system:
-        name, path = _name_path(spec, "--system")
-        A = np.load(path)
-        server.registry.register_system(
-            name, A,
-            context=SketchContext(seed=args.seed + 1),
-            sketch_type=args.sketch_type,
-            sketch_size=args.sketch_size,
-        )
-        print(f"system {name!r} <- {path} {A.shape}", file=sys.stderr)
 
-    server.start()
+    def make_server() -> "serve.Server":
+        server = serve.Server(params, seed=args.seed)
+        for spec in args.model:
+            name, path = _name_path(spec, "--model")
+            server.registry.load_model(name, path)
+            print(f"model {name!r} <- {path}", file=sys.stderr)
+        for spec in args.system:
+            name, path = _name_path(spec, "--system")
+            A = np.load(path)
+            server.registry.register_system(
+                name, A,
+                context=SketchContext(seed=args.seed + 1),
+                sketch_type=args.sketch_type,
+                sketch_size=args.sketch_size,
+            )
+            print(f"system {name!r} <- {path} {A.shape}", file=sys.stderr)
+        return server
+
+    servers = [make_server() for _ in range(max(1, args.replicas))]
+    router = None
+    if args.replicas > 1:
+        router = serve.Router(
+            serve.RouterParams(heartbeat_interval_s=1.0)
+        ).start()
+        for i, s in enumerate(servers):
+            s.start()  # primed BEFORE the router can place traffic here
+            rec = router.join(f"replica-{i}", server=s)
+            print(f"replica-{i} joined (epoch {rec['epoch']})",
+                  file=sys.stderr)
+        front = router
+    else:
+        servers[0].start()
+        front = servers[0]
     try:
         if args.http is not None:
-            httpd = serve.serve_http(server, port=args.http)
+            httpd = serve.serve_http(front, port=args.http)
             host, port = httpd.server_address[:2]
             print(f"serving http://{host}:{port}", file=sys.stderr)
             try:
-                httpd.serve_forever()
+                if args.join:
+                    # Serve in the background so the router's join-time
+                    # /healthz probe (which checks we are primed and
+                    # alive) can reach us before we block.
+                    t = threading.Thread(
+                        target=httpd.serve_forever, daemon=True
+                    )
+                    t.start()
+                    _announce_join(args.join, host, port)
+                    t.join()
+                else:
+                    httpd.serve_forever()
             except KeyboardInterrupt:
                 pass
             finally:
                 httpd.shutdown()
         else:
-            served = serve.serve_stdio(server, sys.stdin, sys.stdout)
+            served = serve.serve_stdio(front, sys.stdin, sys.stdout)
             print(f"served {served} requests", file=sys.stderr)
     finally:
-        server.stop()
+        if router is not None:
+            router.stop()
+        for s in servers:
+            s.stop()
         print_perf_report(args)
         print_policy_report(args)
         print_telemetry_report(args)
     return 0
+
+
+def _announce_join(router_url: str, host: str, port: int) -> None:
+    """POST /join to the front door; a code-109 signature fence comes
+    back as a structured envelope and exits with its message."""
+    url = f"http://{host}:{port}"
+    req = urllib.request.Request(
+        router_url.rstrip("/") + "/join",
+        data=json.dumps({"name": f"{host}:{port}", "url": url}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            rec = json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        body = e.read().decode(errors="replace")
+        raise SystemExit(f"join rejected by {router_url}: {body}") from e
+    print(f"joined fleet at {router_url}: epoch {rec.get('epoch')} "
+          f"placeable {rec.get('placeable')}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -5,6 +5,10 @@ Tails whichever observability surfaces it is pointed at — any mix of:
 - ``--url``: a ``serve_http`` front end; polls ``/stats``, ``/healthz``
   and ``/metrics`` (queue depth, coalesce ratio, p50/p99, shed and
   fallback counters, flight-recorder violation ids via ``/traces``).
+  Repeatable: several ``--url`` flags render one per-replica fleet
+  table (queue depth, QPS, primed rungs, heartbeat age); pointing one
+  ``--url`` at a router front door expands its membership table the
+  same way.
 - ``--telemetry-dir``: the JSONL run-ledger directory
   (``ledger-<pid>.jsonl``); shows event-kind totals and the most recent
   guard verdicts / dumped traces.
@@ -148,16 +152,57 @@ def _rank_lines(hosts: dict) -> list[str]:
     return out
 
 
+def _fleet_table(rows: list) -> list[str]:
+    """Per-replica rows of (name, load report | None, heartbeat age)."""
+    out = [
+        "  replica                        queue    qps  primed  heartbeat"
+    ]
+    for name, load, age in rows:
+        if not isinstance(load, dict):
+            out.append(f"  {name:<30} UNREACHABLE")
+            continue
+        qps = sum(
+            float(v.get("rows_per_s") or 0.0)
+            for v in (load.get("throughput") or {}).values()
+        )
+        beat = "now" if age is None else f"{_fmt(age, 1)}s ago"
+        out.append(
+            f"  {name:<30} {str(load.get('queue_depth', '?')):>5}"
+            f"  {qps:>5.1f}  {len(load.get('primed', [])):>6}  {beat}"
+        )
+    return out
+
+
 def render_frame(args) -> str:
     """One full frame as a string (``--once`` prints exactly this)."""
     lines = [f"skylark-top  {time.strftime('%H:%M:%S')}"]
-    if args.url:
-        base = args.url.rstrip("/")
-        stats = _fetch_json(base + "/stats")
+    urls = args.url or []
+    if isinstance(urls, str):  # programmatic callers with a bare string
+        urls = [urls]
+    fleet_rows: list = []
+    for base in urls:
+        base = base.rstrip("/")
         health = _fetch_json(base + "/healthz")
-        traces = _fetch_json(base + "/traces")
-        lines.append(f"serve {base}")
-        lines += _serve_lines(stats, health, traces)
+        if len(urls) == 1:
+            stats = _fetch_json(base + "/stats")
+            traces = _fetch_json(base + "/traces")
+            lines.append(f"serve {base}")
+            lines += _serve_lines(stats, health, traces)
+        load = health.get("load") if "_error" not in health else None
+        fleet = health.get("fleet") if "_error" not in health else None
+        # A router front door has no load report of its own — it is
+        # represented by its expanded members, not an UNREACHABLE row.
+        if load is not None or (len(urls) > 1 and not fleet):
+            fleet_rows.append((base, load, None))
+        if fleet:  # a router front door: expand its membership table
+            for name, m in sorted(fleet.get("members", {}).items()):
+                tag = name if m.get("placeable") else f"{name} (unplaceable)"
+                fleet_rows.append(
+                    (tag, m.get("report"), m.get("heartbeat_age_s"))
+                )
+    if len(fleet_rows) > 1:
+        lines.append(f"fleet ({len(fleet_rows)} replicas)")
+        lines += _fleet_table(fleet_rows)
     if args.telemetry_dir:
         lines.append(f"ledger {args.telemetry_dir}")
         lines += _ledger_lines(_tail_ledgers(args.telemetry_dir))
@@ -175,9 +220,10 @@ def main(argv=None) -> int:
         description="live terminal view of a skylark serving fleet",
     )
     p.add_argument(
-        "--url", default=None,
+        "--url", action="append", default=None,
         help="serve_http base URL to poll (/stats, /healthz, /metrics, "
-             "/traces), e.g. http://127.0.0.1:8080",
+             "/traces), e.g. http://127.0.0.1:8080; repeatable — "
+             "several URLs render a per-replica fleet table",
     )
     p.add_argument(
         "--telemetry-dir", default=None,

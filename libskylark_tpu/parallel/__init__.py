@@ -15,6 +15,8 @@ from .collectives import (
     HEARTBEAT_DIR,
     CollectiveWatchdog,
     ShardedBCOO,
+    batch_sharded_program,
+    columnwise_batch_sharded,
     columnwise_sharded,
     cross_host_psum,
     columnwise_sharded_sparse,
@@ -60,6 +62,8 @@ __all__ = [
     "CollectiveWatchdog",
     "HEARTBEAT_DIR",
     "rowwise_sharded",
+    "batch_sharded_program",
+    "columnwise_batch_sharded",
     "columnwise_sharded",
     "rowwise_sharded_sparse",
     "columnwise_sharded_sparse",

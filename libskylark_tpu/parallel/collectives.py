@@ -38,6 +38,8 @@ __all__ = [
     "HEARTBEAT_DIR",
     "rowwise_sharded",
     "columnwise_sharded",
+    "batch_sharded_program",
+    "columnwise_batch_sharded",
     "rowwise_sharded_sparse",
     "columnwise_sharded_sparse",
     "columnwise_sharded_sparse_2d",
@@ -377,6 +379,59 @@ def rowwise_sharded(S, A, mesh: Mesh):
         in_specs=P(axes, None),
         out_specs=P(axes, None),
     )(A)
+
+
+def batch_sharded_program(local, mesh: Mesh):
+    """Shard the BATCH axis: run ``local`` on column blocks of a 2-D
+    operand, outputs re-concatenated on columns.  Communication-free by
+    construction — the serving layer's device-parallel dispatch schedule,
+    where the columns are independent coalesced requests.
+
+    Contrast :func:`columnwise_sharded`, which shards the CONTRACTION
+    axis and merges with a ``psum``: the psum reorders the accumulation,
+    so its result is only approximately the single-device one.  Here no
+    reduction crosses shards, so the result is bitwise-identical to the
+    unsharded ``local`` PROVIDED (a) ``local`` is column-pure (each
+    output column depends only on its input column — the per-slot purity
+    the serve batcher's coalescing contract already pins) and (b) every
+    shard's column block keeps the lane-uniform width the XLA gemm
+    micro-kernels key on (a multiple of the serve ladder's base rung; a
+    remainder-width shard would take a different accumulation
+    micro-kernel and break bit-parity).  Callers gate on (b); this
+    schedule just runs.
+    """
+    axes = tuple(mesh.axis_names)
+    # check_rep=False: the sketch applies trace counter-stream
+    # primitives that carry no replication rule; nothing here relies on
+    # replication inference (every spec is explicit).
+    return _shard_map_fn()(
+        local,
+        mesh=mesh,
+        in_specs=P(None, axes),
+        out_specs=P(None, axes),
+        check_rep=False,
+    )
+
+
+def columnwise_batch_sharded(S, B, mesh: Mesh):
+    """B (N, k) of k independent RHS columns → S·B (S.s, k), sharded on
+    the batch (column) axis: each shard applies the FULL sketch to its
+    column block (no counter windowing — every shard sees the whole
+    Omega, unlike :func:`columnwise_sharded`'s contraction split).  Zero
+    communication, and bitwise-equal to the unsharded columnwise apply
+    under :func:`batch_sharded_program`'s lane-uniformity proviso."""
+    nshards = mesh.size
+    B = _coerce_float(B)
+    k = B.shape[1]
+    if k % nshards:
+        raise ValueError(
+            f"batch columns {k} not divisible by mesh size {nshards}"
+        )
+
+    def local(b):
+        return S.apply(b, Dimension.COLUMNWISE)
+
+    return batch_sharded_program(local, mesh)(B)
 
 
 def columnwise_sharded(S: DenseSketch, A, mesh: Mesh, scatter: bool = False):

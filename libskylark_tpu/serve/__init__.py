@@ -17,9 +17,17 @@ Layout (see ``docs/serving.md``):
 - :mod:`.registry` — models + LS systems, loaded once, device-resident;
 - :mod:`.batcher` — the coalescing executors + solo-retry fault
   isolation (code-108 structured degradation, batch-mates unaffected);
-- :mod:`.server` — the worker loop, warm start, telemetry;
-- :mod:`.transport` / :mod:`.client` — stdio + HTTP loopback fronts and
-  the Python client (``skylark-serve`` is the CLI wrapper).
+- :mod:`.server` — the worker loop (``workers=K`` pins K batcher
+  threads to disjoint devices), warm start, telemetry;
+- :mod:`.dispatch` — probe-verified device-parallel dispatch: batches
+  whose padded rung clears the flop gate run their heavy half sharded
+  over every local chip, bitwise-identical to single-device by
+  construction;
+- :mod:`.router` — the fleet front door: signature-fenced membership,
+  profile-aware placement (key affinity → coalescing), 112/114
+  shedding, heartbeat ejection with in-flight re-placement;
+- :mod:`.transport` / :mod:`.client` — stdio + HTTP/1.1 keep-alive
+  fronts and the Python client (``skylark-serve`` is the CLI wrapper).
 """
 
 from .admission import AdmissionQueue, Entry
@@ -32,9 +40,17 @@ from .protocol import (
     exception_for,
     make_request,
     ok_response,
+    placement_key,
     raise_for_error,
 )
 from .registry import LSSystem, Registry
+from .router import (
+    HttpReplica,
+    InProcessReplica,
+    Router,
+    RouterParams,
+    choose_replica,
+)
 from .server import ServeParams, Server, latency_percentiles, record_latency
 from .transport import serve_http, serve_stdio
 
@@ -42,10 +58,15 @@ __all__ = [
     "AdmissionQueue",
     "Client",
     "Entry",
+    "HttpReplica",
+    "InProcessReplica",
     "LSSystem",
     "Registry",
+    "Router",
+    "RouterParams",
     "ServeParams",
     "Server",
+    "choose_replica",
     "decode",
     "encode",
     "error_payload",
@@ -54,6 +75,7 @@ __all__ = [
     "latency_percentiles",
     "make_request",
     "ok_response",
+    "placement_key",
     "raise_for_error",
     "record_latency",
     "serve_http",

@@ -48,9 +48,22 @@ import numpy as np
 from .. import plans, telemetry
 from ..telemetry.trace import is_violating, next_id
 from ..utils.exceptions import NumericalHealthError, SkylarkError
-from . import protocol
+from . import dispatch, protocol
 
 __all__ = ["run_batch"]
+
+
+def _stage(x, device):
+    """Host→device staging for one executor operand: the PR-11
+    ``pinned_placer`` seam.  ``device=None`` (single-worker servers) is
+    a no-op — the operand flows to JAX exactly as before, bit-for-bit;
+    a pinned worker stages onto its own chip so K workers' dispatches
+    never serialize through device 0."""
+    if device is None:
+        return x
+    from ..streaming.pipeline import device_placer
+
+    return device_placer(x, device)
 
 
 @jax.jit
@@ -91,11 +104,17 @@ def _pad_cols(Bt: np.ndarray) -> tuple[np.ndarray, int]:
     return np.ascontiguousarray(Bp.T), kb
 
 
-def _execute_ls(registry, entries):
+def _execute_ls(registry, entries, device=None):
     system = registry.get_system(entries[0].request["system"])
     S = entries[0].sketch or system.S
     Bt = np.stack([e.payload for e in entries])  # (k, m)
     B, kb = _pad_cols(Bt)  # (m, kb)
+    Bj = jnp.asarray(B, system.A.dtype)
+
+    def single():
+        return plans.apply(S, _stage(Bj, device), "columnwise")
+
+    SB = None
     if entries[0].sketch is not None:
         # fresh-sketch slow path: the factorization is per-request
         SA = plans.apply(S, system.A, "columnwise")
@@ -103,20 +122,24 @@ def _execute_ls(registry, entries):
         Qt = jnp.asarray(Q).T
     else:
         Qt, R = system.Qt, system.R
-    SB = plans.apply(S, jnp.asarray(B, system.A.dtype), "columnwise")
+        # Heavy half over every local chip when the rung clears the
+        # gates; the (s, kb) solve below is the UNCHANGED light half.
+        SB = dispatch.maybe_sketch_sharded(S, Bj, kb, entries, single)
+    if SB is None:
+        SB = single()
     X = np.asarray(_qr_solve(Qt, R, SB))  # (n, kb)
     return [X[:, i] for i in range(len(entries))], kb
 
 
-def _feature_map_predict(model, Xp, true_rows):
-    """model.features + the coefficient matmul, planned and SHAPE-STABLE:
+def _feature_z(model, Xp, true_rows):
+    """The feature block Z of a predict batch, planned and SHAPE-STABLE:
     ``Xp`` arrives padded to the rung, every map rides
     ``apply_rowwise_bucketed(pad_out=True)`` (padded rows zeroed inside
-    the executable), and the concat + matmul are keyed on the rung shape
-    alone.  Shape stability is the latency contract: if any step here
-    saw the RAW batch size, every distinct coalesce width would compile
-    a fresh executable mid-traffic and stall the single worker queue —
-    ``Server.prime`` can only pre-compile rung shapes."""
+    the executable), and the concat is keyed on the rung shape alone.
+    Shape stability is the latency contract: if any step here saw the
+    RAW batch size, every distinct coalesce width would compile a fresh
+    executable mid-traffic and stall the worker queue — ``Server.prime``
+    can only pre-compile rung shapes."""
     kb = Xp.shape[0]
     blocks = []
     for S in model.maps:
@@ -130,9 +153,7 @@ def _feature_map_predict(model, Xp, true_rows):
         if model.scale_maps:
             Z = Z * jnp.asarray(np.sqrt(Z.shape[-1] / Xp.shape[-1]), Z.dtype)
         blocks.append(Z)
-    Z = jnp.concatenate(blocks, axis=-1) if blocks else jnp.asarray(Xp)
-    O = _matmul(Z, model.W.astype(Z.dtype))
-    return np.asarray(O)[:true_rows]
+    return jnp.concatenate(blocks, axis=-1) if blocks else jnp.asarray(Xp)
 
 
 def _kernel_jit(registry, name, model):
@@ -146,19 +167,35 @@ def _kernel_jit(registry, name, model):
     return fn
 
 
-def _execute_predict(registry, entries):
+def _execute_predict(registry, entries, device=None):
     name = entries[0].request["model"]
     model = registry.get_model(name)
     X = np.concatenate([e.payload for e in entries])  # (R, d)
     R_tot = X.shape[0]
     kb = plans.bucket_for(R_tot)
+    Xp = plans.pad_rows(X, kb)
     if hasattr(model, "maps"):
-        Xp = plans.pad_rows(X, kb)
-        O = _feature_map_predict(model, Xp, true_rows=R_tot)
+        # Sharded heavy half: feature maps over every chip, the Z·W
+        # matmul below unchanged.  Padding rows are garbage on the
+        # sharded route (eager applies don't zero them) exactly until
+        # the [:R_tot] slice — row purity keeps true rows bit-equal.
+        def zsingle():
+            return _feature_z(model, _stage(Xp, device), true_rows=R_tot)
+
+        Z = dispatch.maybe_feature_sharded(model, Xp, R_tot, entries, zsingle)
+        if Z is None:
+            Z = zsingle()
+        O = np.asarray(_matmul(Z, model.W.astype(Z.dtype)))[:R_tot]
     else:
-        Xp = plans.pad_rows(X, kb)
-        O = np.asarray(_kernel_jit(registry, name, model)(jnp.asarray(Xp)))
-        O = O[:R_tot]
+        def osingle():
+            return _kernel_jit(registry, name, model)(
+                _stage(jnp.asarray(Xp), device)
+            )
+
+        O = dispatch.maybe_kernel_sharded(model, Xp, R_tot, entries, osingle)
+        if O is None:
+            O = osingle()
+        O = np.asarray(O)[:R_tot]
     outs, at = [], 0
     for e in entries:
         r = e.payload.shape[0]
@@ -223,7 +260,7 @@ def _finish_error(entry, exc, batch_size):
     )
 
 
-def run_batch(registry, entries) -> None:
+def run_batch(registry, entries, device=None) -> None:
     """Execute one coalesced batch; every entry's future is resolved by
     the time this returns (ok, degraded-solo, or structured error).
 
@@ -235,7 +272,7 @@ def run_batch(registry, entries) -> None:
     and guard events emitted below land on them too."""
     tctxs = [e.tctx for e in entries if e.tctx is not None]
     if not tctxs:  # telemetry off: zero tracing work, not even a span id
-        _dispatch(registry, entries)
+        _dispatch(registry, entries, device)
         return
     sid = next_id()
     n = len(entries)
@@ -243,15 +280,15 @@ def run_batch(registry, entries) -> None:
     for t in tctxs:
         t.event("dispatch", span=sid, batch_size=n, **peers)
     with telemetry.activate(tctxs):
-        _dispatch(registry, entries)
+        _dispatch(registry, entries, device)
 
 
-def _dispatch(registry, entries) -> None:
+def _dispatch(registry, entries, device=None) -> None:
     executor = _EXECUTORS[entries[0].op]
     n = len(entries)
     t0 = time.perf_counter()
     try:
-        outs, bucket = executor(registry, entries)
+        outs, bucket = executor(registry, entries, device)
     except Exception as e:  # noqa: BLE001 — isolate, then solo-retry
         if n == 1:
             telemetry.inc("serve.errors")
@@ -267,7 +304,7 @@ def _dispatch(registry, entries) -> None:
                 {"kind": "fallback", "reason": f"batch raised {type(e).__name__}"}
             )
             telemetry.inc("serve.solo_retries")
-            run_batch(registry, [e2])
+            run_batch(registry, [e2], device)
         return
     t_ms = (time.perf_counter() - t0) * 1e3
     for entry, out in zip(entries, outs):
@@ -285,7 +322,7 @@ def _dispatch(registry, entries) -> None:
                     "serve", "fallback",
                     {"op": entry.op, "id": entry.request.get("id")},
                 )
-                run_batch(registry, [entry])
+                run_batch(registry, [entry], device)
                 continue
             telemetry.inc("serve.errors")
             entry.trace["events"].append(

@@ -6,15 +6,26 @@ callers coalesce.  ``Client(url="http://127.0.0.1:PORT")`` speaks the
 :mod:`.transport` HTTP front end — results come back as the protocol's
 nested lists.
 
+HTTP clients hold one **keep-alive** connection per calling thread
+(the transport speaks HTTP/1.1): under a serving workload of many
+small requests, the TCP handshake would otherwise dominate the wire
+cost of a ~100-byte frame.  A connection that went stale between calls
+is retried once on a fresh socket; the reused-vs-fresh split is
+counted (``serve.client_conn_reused`` / ``serve.client_conn_fresh``)
+so a fleet bench can verify reuse is actually happening.
+
 Every convenience method returns the protocol response dict by default;
 ``check=True`` unwraps ``result`` and re-raises structured errors as
 their :mod:`utils.exceptions` classes (code-mapped)."""
 
 from __future__ import annotations
 
+import http.client
 import json
-import urllib.request
+import threading
+from urllib.parse import urlsplit
 
+from .. import telemetry
 from . import protocol
 
 __all__ = ["Client"]
@@ -28,35 +39,86 @@ class Client:
         self._server = server
         self._url = url.rstrip("/") if url else None
         self._timeout = timeout
+        self._local = threading.local()
+        if self._url:
+            parts = urlsplit(self._url)
+            self._host = parts.hostname or "127.0.0.1"
+            self._port = parts.port or 80
+            self._base = parts.path.rstrip("/")
 
     # -- transport ----------------------------------------------------------
+
+    def _request(self, method: str, path: str,
+                 body: bytes | None = None) -> str:
+        """One HTTP exchange over this thread's keep-alive connection.
+
+        A reused connection the server has since closed fails on the
+        first read — retried ONCE on a fresh socket; errors on a fresh
+        connection propagate (the server is actually down)."""
+        for _ in range(2):
+            conn = getattr(self._local, "conn", None)
+            fresh = conn is None
+            if fresh:
+                conn = http.client.HTTPConnection(
+                    self._host, self._port, timeout=self._timeout
+                )
+                self._local.conn = conn
+            try:
+                conn.request(
+                    method, self._base + path, body=body,
+                    headers={"Content-Type": "application/json"}
+                    if body is not None else {},
+                )
+                resp = conn.getresponse()
+                text = resp.read().decode()
+                if resp.will_close:
+                    conn.close()
+                    self._local.conn = None
+                telemetry.inc(
+                    "serve.client_conn_fresh" if fresh
+                    else "serve.client_conn_reused"
+                )
+                return text
+            except (http.client.HTTPException, OSError):
+                conn.close()
+                self._local.conn = None
+                if fresh:
+                    raise
+        raise OSError("unreachable")  # pragma: no cover
+
+    def close(self) -> None:
+        """Drop this thread's keep-alive connection (idempotent)."""
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
 
     def call(self, request: dict | None = None, /, **fields) -> dict:
         req = dict(request or {}, **fields)
         if self._server is not None:
             return self._server.call(req)
-        data = protocol.encode(req).encode()
-        http_req = urllib.request.Request(
-            self._url + "/", data=data,
-            headers={"Content-Type": "application/json"},
+        return protocol.decode(
+            self._request("POST", "/", protocol.encode(req).encode())
         )
-        with urllib.request.urlopen(http_req, timeout=self._timeout) as r:
-            return protocol.decode(r.read().decode())
 
     def call_many(self, requests: list[dict]) -> list[dict]:
         """Submit concurrently (the coalescing path for remote callers)."""
         if self._server is not None:
             futures = [self._server.submit(r) for r in requests]
             return [f.result() for f in futures]
-        data = json.dumps(
-            requests, default=lambda o: o.tolist()
-        ).encode()
-        http_req = urllib.request.Request(
-            self._url + "/", data=data,
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(http_req, timeout=self._timeout) as r:
-            return json.loads(r.read().decode())
+        data = json.dumps(requests, default=lambda o: o.tolist()).encode()
+        return json.loads(self._request("POST", "/", data))
+
+    def healthz(self) -> dict:
+        """The server's ``/healthz`` (includes the ``load`` report the
+        fleet router places by); in-process, the report directly."""
+        if self._server is not None:
+            return {
+                "ok": True,
+                "load": self._server.load_report(),
+                "primed": list(self._server.primed),
+            }
+        return json.loads(self._request("GET", "/healthz"))
 
     # -- conveniences -------------------------------------------------------
 

@@ -31,7 +31,7 @@ Response schema::
      "trace": {"queue_ms", "exec_ms", "batch_size", "bucket",
                "coalesced", "events": [...], ...}}
     {"id": ...,
-     "ok": false, "error": {"code": int,    # the 100-113 ladder
+     "ok": false, "error": {"code": int,    # the 100-114 ladder
                             "type": str, "message": str},
      "trace": {...}}
 
@@ -58,10 +58,29 @@ __all__ = [
     "exception_for",
     "make_request",
     "ok_response",
+    "placement_key",
     "raise_for_error",
 ]
 
 OPS = ("ls_solve", "predict", "ping", "stats")
+
+
+def placement_key(request: dict) -> str:
+    """The routing identity of a request — the string granularity at
+    which the fleet router tracks affinity and replicas report
+    throughput.  Mirrors the batcher's coalescing key (``Entry.key``
+    minus the fresh-sketch suffix): requests sharing a placement key
+    can share a fused dispatch, so the router sends them to the same
+    replica to keep batches full."""
+    op = request.get("op")
+    if op == "ls_solve":
+        return f"ls:{request.get('system')}"
+    if op == "predict":
+        return (
+            f"predict:{request.get('model')}"
+            f":{np.dtype(request.get('dtype', 'float64')).name}"
+        )
+    return str(op)
 
 # code -> exception class, for client-side re-raising (raise_for_error)
 _CODE_CLASSES = {

@@ -85,6 +85,14 @@ class ServeParams:
     - ``warm_start`` / ``prime``: replay policy warm-start profiles /
       pre-compile registered entities' first-rung executables at
       :meth:`Server.start`.
+    - ``workers``: batcher worker threads draining the one admission
+      queue.  ``1`` (the default) is PR-10 behavior bit-for-bit; ``K>1``
+      pins worker ``i`` to local device ``i % ndevices`` (the PR-11
+      ``pinned_placer`` seam), so small-batch traffic scales with chip
+      count instead of serializing through one device.  Coalescing is
+      unchanged — ``take_batch`` is already multi-consumer-safe, and
+      per-slot purity keeps results bitwise identical to a single
+      worker's.
     """
 
     max_queue: int = 256
@@ -93,6 +101,7 @@ class ServeParams:
     default_deadline_ms: float | None = None
     warm_start: bool = True
     prime: bool = True
+    workers: int = 1
 
 
 class Server:
@@ -110,7 +119,12 @@ class Server:
         self.warm_summary: dict | None = None
         self.primed: list[str] = []
         self._thread: threading.Thread | None = None
+        self._threads: list[threading.Thread] = []
         self._fresh_seq = 0
+        # per-placement-key {key: [requests, busy_seconds]} — the
+        # throughput half of the load report the fleet router places by
+        self._key_stats: dict[str, list] = {}
+        self._stats_lock = threading.Lock()
 
     # -- registration (delegates; the server's context is the default
     #    counter stream, so registration order is deterministic) ------------
@@ -136,11 +150,28 @@ class Server:
             self.warm_summary = policy.warm_start()
         if self.params.prime:
             self.prime()
-        self._thread = threading.Thread(
-            target=self._worker, name="skylark-serve-worker", daemon=True
-        )
-        self._thread.start()
+        for i, dev in enumerate(self._worker_devices()):
+            t = threading.Thread(
+                target=self._worker, args=(dev,),
+                name=f"skylark-serve-worker-{i}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        self._thread = self._threads[0]
         return self
+
+    def _worker_devices(self) -> list:
+        """One slot per worker thread: ``[None]`` for the single-worker
+        server (no pinning — PR-10 behavior exactly), else worker ``i``
+        pins ``jax.local_devices()[i % ndevices]`` so independent
+        batches land on disjoint chips."""
+        k = max(1, self.params.workers)
+        if k == 1:
+            return [None]
+        import jax
+
+        devs = jax.local_devices()
+        return [devs[i % len(devs)] for i in range(k)]
 
     def prime(self) -> list[str]:
         """Compile every executable a coalesced batch can reach, NOW.
@@ -152,17 +183,26 @@ class Server:
         compiling batch eats that stall (the bench measured KRR-predict
         coalesced slower than serial before this primed the ladder)."""
         mc = max(1, self.params.max_coalesce)
+        # Multi-worker servers prime once per DISTINCT pinned device:
+        # XLA executables are per-device, so a rung warm on chip 0 still
+        # stalls the first batch chip 1 draws.  Single-worker = [None],
+        # exactly the PR-10 prime.
+        devices = sorted(
+            {id(d): d for d in self._worker_devices()}.values(),
+            key=lambda d: getattr(d, "id", -1),
+        )
         for name, system in self.registry.systems.items():
             widths = sorted({batcher._lane_bucket(k) for k in range(1, mc + 1)})
-            for w in widths:
-                entries = [
-                    Entry(
-                        {"op": "ls_solve", "system": name}, Future(), None,
-                        "ls_solve", payload=np.zeros(system.m),
-                    )
-                    for _ in range(w)
-                ]
-                batcher._execute_ls(self.registry, entries)
+            for dev in devices:
+                for w in widths:
+                    entries = [
+                        Entry(
+                            {"op": "ls_solve", "system": name}, Future(), None,
+                            "ls_solve", payload=np.zeros(system.m),
+                        )
+                        for _ in range(w)
+                    ]
+                    batcher._execute_ls(self.registry, entries, dev)
             self.primed.append(f"system:{name}:{widths}")
         from .. import plans
 
@@ -171,24 +211,27 @@ class Server:
             if not d:
                 continue
             rungs = sorted({plans.bucket_for(k) for k in range(1, mc + 1)})
-            for r in rungs:
-                entries = [
-                    Entry(
-                        {"op": "predict", "model": name}, Future(), None,
-                        "predict", payload=np.zeros((1, int(d))),
-                    )
-                    for _ in range(r)
-                ]
-                batcher._execute_predict(self.registry, entries)
+            for dev in devices:
+                for r in rungs:
+                    entries = [
+                        Entry(
+                            {"op": "predict", "model": name}, Future(), None,
+                            "predict", payload=np.zeros((1, int(d))),
+                        )
+                        for _ in range(r)
+                    ]
+                    batcher._execute_predict(self.registry, entries, dev)
             self.primed.append(f"model:{name}:{rungs}")
         return self.primed
 
     def stop(self, timeout: float = 10.0) -> None:
         self.queue.close()
-        if self._thread is not None:
-            self._thread.join(timeout)
-            self._thread = None
-        for e in self.queue.drain():  # anything the worker never reached
+        deadline = time.monotonic() + timeout
+        for t in self._threads:
+            t.join(max(0.0, deadline - time.monotonic()))
+        self._threads = []
+        self._thread = None
+        for e in self.queue.drain():  # anything the workers never reached
             self._resolve_error(
                 e, SkylarkError("server stopped before dispatch")
             )
@@ -280,6 +323,75 @@ class Server:
             "warm_start": self.warm_summary,
             "primed": list(self.primed),
         }
+
+    # -- fleet surface ------------------------------------------------------
+
+    def census(self) -> dict:
+        """The sorted names this replica serves — the human half of the
+        membership check (the bit-exact half is :meth:`signature`)."""
+        d = self.registry.describe()
+        return {
+            "models": sorted(d["models"]),
+            "systems": sorted(d["systems"]),
+        }
+
+    def signature(self) -> int:
+        """CRC32 of the canonical registry description.  Two replicas
+        may join one fleet only when their signatures agree — the same
+        fencing discipline as the elastic layer's partition signature
+        (``streaming/elastic.py``): a fleet that silently mixed
+        registries would route requests to replicas that resolve the
+        same name to different models."""
+        import json
+        import zlib
+
+        blob = json.dumps(
+            self.registry.describe(), sort_keys=True, default=str
+        )
+        return zlib.crc32(blob.encode())
+
+    def load_report(self) -> dict:
+        """Everything the front-door router needs to place a request,
+        in one snapshot: live queue pressure, per-key measured
+        throughput (this process), the policy profile store's prior
+        (survives restarts), what's primed, and the membership identity
+        (census + signature).  Served over HTTP as ``/fleet`` and folded
+        into ``/healthz`` as ``"load"``."""
+        with self._stats_lock:
+            throughput = {
+                k: {
+                    "requests": c,
+                    "busy_s": round(s, 6),
+                    "rows_per_s": round(c / s, 3) if s > 0 else None,
+                }
+                for k, (c, s) in self._key_stats.items()
+            }
+        report = {
+            "queue_depth": len(self.queue),
+            "max_queue": self.params.max_queue,
+            "workers": max(1, self.params.workers),
+            "worker_alive": any(t.is_alive() for t in self._threads),
+            "throughput": throughput,
+            "latency": latency_percentiles(),
+            "primed": list(self.primed),
+            "census": self.census(),
+            "signature": self.signature(),
+        }
+        try:
+            from ..policy import profile as _profile
+
+            view = _profile.load_entries()
+        except Exception:  # noqa: BLE001 — profiles are advisory
+            view = None
+        if view:
+            profiles = {
+                k: e["throughput"]
+                for k, e in view.get("entries", {}).items()
+                if e.get("throughput")
+            }
+            if profiles:
+                report["profiles"] = profiles
+        return report
 
     # -- internals ----------------------------------------------------------
 
@@ -379,7 +491,7 @@ class Server:
             protocol.error_response(entry.request.get("id"), e, entry.trace)
         )
 
-    def _worker(self) -> None:
+    def _worker(self, device=None) -> None:
         while True:
             batch = self.queue.take_batch(
                 self.params.max_coalesce,
@@ -423,8 +535,9 @@ class Server:
             telemetry.observe("serve.batch_size", len(live))
             if len(live) > 1:
                 telemetry.inc("serve.coalesced", len(live))
+            t_exec = time.monotonic()
             try:
-                batcher.run_batch(self.registry, live)
+                batcher.run_batch(self.registry, live, device)
             except Exception as e:  # noqa: BLE001 — the worker must survive
                 for entry in live:
                     if not entry.future.done():
@@ -432,7 +545,19 @@ class Server:
                             entry, SkylarkError(f"serve worker error: {e}")
                         )
             done = time.monotonic()
+            self._fold_key_stats(live, done - t_exec)
             for e in live:
                 ms = (done - e.t_admit) * 1e3
                 telemetry.observe("serve.latency_ms", ms)
                 record_latency(ms)
+
+    def _fold_key_stats(self, live, busy_s: float) -> None:
+        """Per-placement-key throughput accounting, fed by every batch
+        regardless of the telemetry gate — the router's placement logic
+        needs it even on telemetry-dark replicas.  One batch is one key
+        (``take_batch`` coalesces same-key only)."""
+        key = protocol.placement_key(live[0].request)
+        with self._stats_lock:
+            slot = self._key_stats.setdefault(key, [0, 0.0])
+            slot[0] += len(live)
+            slot[1] += busy_s

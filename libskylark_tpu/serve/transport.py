@@ -62,28 +62,41 @@ def serve_stdio(server, in_stream, out_stream) -> int:
 def _healthz(srv) -> dict:
     """Liveness + identity: which backend actually resolved, how much is
     registered, whether the compile ladder is primed — the three facts a
-    probe needs to tell 'up' from 'up but will stall mid-traffic'."""
+    probe needs to tell 'up' from 'up but will stall mid-traffic'.  For
+    fleet members the full load report rides along as ``"load"`` (queue
+    pressure, per-key throughput, census signature) — one GET is both
+    the probe and the router's heartbeat."""
     try:
         import jax
 
         backend = str(jax.default_backend())
     except Exception:  # noqa: BLE001 — health must answer even so
         backend = "unknown"
-    return {
-        "ok": True,
-        "backend": backend,
-        "registry": {
-            "models": len(srv.registry.models),
-            "systems": len(srv.registry.systems),
-        },
-        "primed": list(srv.primed),
-        "worker_alive": srv._thread is not None and srv._thread.is_alive(),
-        "telemetry": telemetry.enabled(),
-    }
+    out = {"ok": True, "backend": backend, "telemetry": telemetry.enabled()}
+    registry = getattr(srv, "registry", None)
+    if registry is not None:  # a Server (a Router front door has none)
+        out["registry"] = {
+            "models": len(registry.models),
+            "systems": len(registry.systems),
+        }
+        out["primed"] = list(srv.primed)
+        out["worker_alive"] = (
+            srv._thread is not None and srv._thread.is_alive()
+        )
+    if hasattr(srv, "load_report"):
+        out["load"] = srv.load_report()
+    if hasattr(srv, "fleet_report"):
+        out["fleet"] = srv.fleet_report()
+    return out
 
 
 class _Handler(BaseHTTPRequestHandler):
     server_version = "skylark-serve"
+    # Keep-alive: HTTP/1.0 (the BaseHTTPRequestHandler default) closes
+    # the socket per response, making every ~100-byte frame pay a TCP
+    # handshake; every _send path always sets Content-Length, which is
+    # what HTTP/1.1 persistence requires.
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, *args):  # quiet: telemetry owns observability
         pass
@@ -111,13 +124,27 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, _healthz(srv))
         elif path == "/stats":
             self._send(200, srv.stats())
+        elif path == "/fleet":
+            # A Router front door answers with the membership table; a
+            # plain Server answers with its own load report, so one
+            # probe URL works against either end of the fleet.
+            if hasattr(srv, "fleet_report"):
+                self._send(200, srv.fleet_report())
+            elif hasattr(srv, "load_report"):
+                self._send(200, srv.load_report())
+            else:
+                self._send(
+                    404, {"ok": False, "error": {"message": "not found"}}
+                )
         elif path == "/metrics":
             from ..telemetry.exposition import CONTENT_TYPE
 
+            queue = getattr(srv, "queue", None)
             self._send_text(
                 200,
                 telemetry.prometheus_text(
-                    extra_gauges={"serve_queue_depth": len(srv.queue)}
+                    extra_gauges={"serve_queue_depth": len(queue)}
+                    if queue is not None else None
                 ),
                 CONTENT_TYPE,
             )
@@ -146,6 +173,22 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(
                 400, protocol.error_response(None, e, {"events": []})
             )
+            return
+        if self.path.partition("?")[0] == "/join":
+            # Fleet membership: a replica announces itself to a Router
+            # front door.  Signature mismatches come back as structured
+            # code-109 envelopes (HTTP 409), not stack traces.
+            if not hasattr(srv, "handle_join"):
+                self._send(
+                    404, {"ok": False, "error": {"message": "not a router"}}
+                )
+            else:
+                try:
+                    self._send(200, {"ok": True, **srv.handle_join(payload)})
+                except Exception as e:  # noqa: BLE001 — structured join errors
+                    self._send(
+                        409, protocol.error_response(None, e, {"events": []})
+                    )
             return
         if isinstance(payload, list):
             # concurrent submission IS the point: a remote batch rides

@@ -101,6 +101,20 @@ def snapshot(fleet: bool = False, root=None) -> dict:
         srv = _sys.modules.get("libskylark_tpu.serve")
         if srv is not None:
             snap["serve"].update(srv.latency_percentiles())
+    router = {
+        k.split(".", 1)[1]: v
+        for k, v in counters.items()
+        if k.startswith("router.")
+    }
+    if router:
+        # Fleet front-door counters (placements, affinity_hits, joins,
+        # ejects, sheds, failovers) fold only when a router actually
+        # ran — single-server snapshots keep their exact PR-12 shape.
+        router["affinity_ratio"] = _ratio(
+            counters.get("router.affinity_hits", 0),
+            counters.get("router.placements", 0),
+        )
+        snap["router"] = router
     return snap
 
 

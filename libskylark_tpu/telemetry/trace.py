@@ -220,7 +220,7 @@ def trace_event(kind: str, **attrs) -> None:
 
 def error_event(name: str, exc: BaseException, **attrs) -> None:
     """The one way an error becomes a telemetry event: kind ``"error"``
-    with a MANDATORY ``code`` attr (the 100–113 ladder; foreign
+    with a MANDATORY ``code`` attr (the 100–114 ladder; foreign
     exceptions degrade to 100) — the static contract in
     ``tests/test_review_regressions.py`` keeps new codes traceable.
     Lands on the ledger, the ``error.code.<n>`` counter, and every

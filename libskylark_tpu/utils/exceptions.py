@@ -21,6 +21,7 @@ __all__ = [
     "StaleEpochError",
     "AdmissionError",
     "DeadlineExceededError",
+    "ReplicaLostError",
 ]
 
 
@@ -174,3 +175,23 @@ class DeadlineExceededError(SkylarkError):
         super().__init__(msg)
         self.deadline_ms = deadline_ms
         self.waited_ms = waited_ms
+
+
+class ReplicaLostError(SkylarkError):
+    """A serving replica disappeared from the fleet: its load-report
+    heartbeat went stale past the router's timeout, its worker thread
+    died, or a request in flight to it failed at the transport layer.
+    The router ejects the replica from the membership table (bumping the
+    fleet epoch so placement decisions are fenced, exactly like the
+    elastic layer's :class:`StaleEpochError` discipline) and re-places
+    the affected keys on the survivors; this error reaches a caller only
+    when NO placeable replica remains.  ``replica`` names the lost
+    member; ``last_heartbeat_s`` is the age of its last successful load
+    report (best-effort, ``None`` when it never reported)."""
+
+    code = 114
+
+    def __init__(self, msg, replica=None, last_heartbeat_s=None):
+        super().__init__(msg)
+        self.replica = replica
+        self.last_heartbeat_s = last_heartbeat_s

@@ -67,6 +67,11 @@ OVERLAP_TIMEOUT_S = 120
 # and fleet folds; a scrape that deadlocks against the worker must not
 # stall the tier-1 run.
 TRACE_TIMEOUT_S = 120
+# Fleet tests run multi-worker servers, a router front door with
+# heartbeat polling, and sharded-dispatch parity probes over virtual
+# devices; a placement that never resolves or a worker pinned to a
+# wedged device must not stall the tier-1 run.
+FLEET_TIMEOUT_S = 120
 
 _TIMEOUT_MARKS = {
     "faults": FAULTS_TIMEOUT_S,
@@ -80,6 +85,7 @@ _TIMEOUT_MARKS = {
     "serve": SERVE_TIMEOUT_S,
     "overlap": OVERLAP_TIMEOUT_S,
     "trace": TRACE_TIMEOUT_S,
+    "fleet": FLEET_TIMEOUT_S,
 }
 
 
@@ -158,6 +164,13 @@ def pytest_configure(config):
         "trace: fleet observability-plane tests (request tracing, flight "
         "recorder, cross-host aggregation, exposition endpoints); tier-1, "
         f"guarded by a per-test {TRACE_TIMEOUT_S}s timeout",
+    )
+    config.addinivalue_line(
+        "markers",
+        "fleet: fleet-scale serving tests (device-parallel dispatch "
+        "parity, replicated workers, router placement / membership / "
+        "failover); tier-1, guarded by a per-test "
+        f"{FLEET_TIMEOUT_S}s timeout",
     )
 
 

@@ -1,0 +1,561 @@
+"""Fleet-scale serving (ISSUE PR 13): device-parallel dispatch,
+replicated batcher workers, and the profile-aware front-door router.
+
+The load-bearing contracts:
+
+- **Sharded dispatch is bitwise-identical to single-device dispatch.**
+  The first dispatch of every sharded program is a parity probe that
+  runs BOTH routes on the live batch and compares bits; a match serves
+  sharded thereafter, a mismatch tombstones the program — either way
+  the response bits equal the single-device path's.
+- **K workers ≡ 1 worker, bitwise.**  Pinned workers drain the same
+  admission queue through the same per-slot-pure executors; worker
+  count may change scheduling, never bits.
+- **2-replica routed ≡ single-worker serial, bitwise** for LS-solve
+  and KRR-predict across rung boundaries (same-seed registries).
+- **Placement is a pure function** of the frozen load reports
+  (affinity → depth → profiled throughput → name).
+- **Membership is fenced**: signature mismatch = 109 at join,
+  heartbeat loss = ejection + re-placement, 114 only when no
+  placeable replica remains, fleet saturation = the same 112 envelope
+  a single server sheds with.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from libskylark_tpu import serve, telemetry
+from libskylark_tpu.core.context import SketchContext
+from libskylark_tpu.ml.kernels import GaussianKernel
+from libskylark_tpu.ml.model import FeatureMapModel, KernelModel
+from libskylark_tpu.serve import dispatch, protocol
+from libskylark_tpu.sketch.rft import GaussianRFT
+from libskylark_tpu.utils import exceptions as ex
+
+pytestmark = pytest.mark.fleet
+
+M, N = 64, 5
+_rng = np.random.default_rng(77)
+A = _rng.standard_normal((M, N))
+RHS = [_rng.standard_normal(M) for _ in range(12)]
+XQ = [_rng.standard_normal(12) for _ in range(12)]
+
+
+def _params(max_coalesce=16, workers=1, **kw):
+    return serve.ServeParams(
+        max_coalesce=max_coalesce, warm_start=False, prime=False,
+        workers=workers, **kw
+    )
+
+
+def _feature_map_model():
+    S = GaussianRFT(12, 32, SketchContext(seed=5), sigma=1.2)
+    W = np.random.default_rng(7).standard_normal((32, 3))
+    return FeatureMapModel([S], W, scale_maps=True)
+
+
+def _kernel_model():
+    rng = np.random.default_rng(8)
+    return KernelModel(
+        GaussianKernel(12, sigma=1.1),
+        rng.standard_normal((24, 12)),
+        rng.standard_normal((24, 3)),
+    )
+
+
+def _replica(max_coalesce=16, workers=1, seed=42, **kw):
+    """A full replica: same-seed registry every time, so bitwise
+    comparisons across replicas/servers are meaningful."""
+    srv = serve.Server(_params(max_coalesce, workers, **kw), seed=seed)
+    srv.registry.register_system("sys", A, context=SketchContext(seed=9))
+    srv.registry.register_model("fm", _feature_map_model())
+    srv.registry.register_model("krr", _kernel_model())
+    return srv
+
+
+def _requests():
+    """LS + both predict kinds, counts that straddle the 8→16 rung."""
+    return (
+        [serve.make_request("ls_solve", system="sys", b=b) for b in RHS[:10]]
+        + [serve.make_request("predict", model="fm", x=x) for x in XQ[:10]]
+        + [serve.make_request("predict", model="krr", x=x) for x in XQ[:10]]
+    )
+
+
+def _serial_reference():
+    srv = _replica(max_coalesce=1)
+    srv.start()
+    results = [srv.call(r) for r in _requests()]
+    srv.stop()
+    return results
+
+
+# ---------------------------------------------------------------------------
+# placement: pure, deterministic
+
+
+def test_placement_key_mirrors_coalescing_identity():
+    assert protocol.placement_key(
+        {"op": "ls_solve", "system": "sys"}
+    ) == "ls:sys"
+    assert protocol.placement_key(
+        {"op": "predict", "model": "m"}
+    ) == "predict:m:float64"
+    assert protocol.placement_key(
+        {"op": "predict", "model": "m", "dtype": "float32"}
+    ) == "predict:m:float32"
+    assert protocol.placement_key({"op": "ping"}) == "ping"
+
+
+def _report(depth, cap=8, tput=None, profile=None):
+    rep = {"queue_depth": depth, "max_queue": cap, "throughput": {}}
+    if tput is not None:
+        rep["throughput"]["ls:sys"] = {"rows_per_s": tput}
+    if profile is not None:
+        rep["profiles"] = {"any": {"rows_per_s": profile}}
+    return rep
+
+
+def test_choose_replica_is_pure_and_deterministic():
+    members = {
+        "b": {"placeable": True, "report": _report(3)},
+        "a": {"placeable": True, "report": _report(3)},
+        "c": {"placeable": True, "report": _report(1)},
+    }
+    # lowest live queue depth wins; dict order must not matter
+    assert serve.choose_replica("ls:sys", members, {}) == "c"
+    flipped = dict(reversed(list(members.items())))
+    assert serve.choose_replica("ls:sys", flipped, {}) == "c"
+    # depth tie: measured per-key throughput breaks it
+    members["a"]["report"] = _report(1, tput=100.0)
+    assert serve.choose_replica("ls:sys", members, {}) == "a"
+    # the policy profile prior stands in when the key was never served
+    members["b"]["report"] = _report(1, profile=500.0)
+    assert serve.choose_replica("ls:sys", members, {}) == "b"
+    # throughput tie all around: lexicographic name, still deterministic
+    fresh = {
+        n: {"placeable": True, "report": _report(2)} for n in ("y", "x", "z")
+    }
+    assert serve.choose_replica("ls:sys", fresh, {}) == "x"
+    # affinity (coalescing) beats a better-scored stranger
+    assert serve.choose_replica("ls:sys", members, {"ls:sys": "c"}) == "c"
+    # ... but not a saturated or unplaceable one
+    members["c"]["report"] = _report(8)
+    assert serve.choose_replica("ls:sys", members, {"ls:sys": "c"}) == "b"
+    members["c"]["report"] = _report(1)
+    members["c"]["placeable"] = False
+    assert serve.choose_replica("ls:sys", members, {"ls:sys": "c"}) == "b"
+    # every placeable member saturated -> None (the caller sheds 112)
+    for m in members.values():
+        m["report"] = _report(8)
+    assert serve.choose_replica("ls:sys", members, {}) is None
+
+
+# ---------------------------------------------------------------------------
+# device-parallel dispatch: gates + the bitwise probe contract
+
+
+def test_shard_gates(monkeypatch):
+    # lane-uniform feasibility: shard width must stay a multiple of 8
+    assert not dispatch.supported(8, 2)
+    assert dispatch.supported(16, 2)
+    assert not dispatch.supported(16, 3)
+    assert not dispatch.supported(16, 4)
+    assert dispatch.supported(32, 4)
+    assert not dispatch.supported(32, 0)
+    # mode "0" disables even enormous dispatches
+    monkeypatch.setenv("SKYLARK_SERVE_SHARD", "0")
+    assert dispatch.shard_devices(32, 1e12) is None
+    # auto honors the amortization floor
+    monkeypatch.setenv("SKYLARK_SERVE_SHARD", "")
+    assert dispatch.shard_devices(32, 1.0) is None
+    assert dispatch.shard_devices(32, 1e12) is not None
+    # force mode skips worthwhile() but never supported()
+    monkeypatch.setenv("SKYLARK_SERVE_SHARD", "1")
+    devs = dispatch.shard_devices(32, 1.0)
+    assert devs is not None and len(devs) == 4  # largest feasible split
+    assert dispatch.shard_devices(8, 1e12) is None
+    # the env floor is respected in auto mode
+    monkeypatch.setenv("SKYLARK_SERVE_SHARD", "")
+    monkeypatch.setenv("SKYLARK_SERVE_SHARD_MIN_FLOPS", "10")
+    assert dispatch.shard_devices(16, 100.0) is not None
+
+
+def test_sharded_dispatch_bitwise_and_probed(monkeypatch):
+    """Forced sharding must change NO bits: the probe runs both routes
+    on the first batch of each program and the executor serves the
+    reference bits; subsequent batches ride the verified program."""
+    monkeypatch.setenv("SKYLARK_SERVE_SHARD", "1")
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    telemetry.REGISTRY.reset()
+    dispatch.clear_cache()
+    serial = _serial_reference()  # max_coalesce=1 -> kb=8, never sharded
+
+    srv = _replica(max_coalesce=16)
+    futures = [srv.submit(r) for r in _requests()]
+    srv.start()
+    routed = [f.result() for f in futures]
+    srv.stop()
+    dispatch.clear_cache()
+    counters = telemetry.REGISTRY.snapshot()["counters"]
+    telemetry.REGISTRY.reset()
+
+    assert all(r["ok"] for r in serial + routed)
+    for s, c in zip(serial, routed):
+        assert (np.asarray(s["result"]) == np.asarray(c["result"])).all()
+    # every coalesced program ran its one-time parity probe ...
+    kinds = {
+        e["kind"]
+        for r in routed
+        for e in r["trace"]["events"]
+        if "shard" in e.get("kind", "")
+    }
+    assert "sharded_probe" in kinds
+    # ... and the LS probe (FJLT at this scale) verifies, so at least
+    # one program carries a recorded verdict
+    assert (
+        counters.get("serve.sharded_verified", 0)
+        + counters.get("serve.sharded_rejected", 0)
+    ) >= 1
+
+
+def test_shard_auto_mode_stays_single_device_at_small_scale(monkeypatch):
+    """Unset env: the amortization gate keeps test-scale batches on the
+    single-device path — the PR-10 executor, no probes, no programs."""
+    monkeypatch.delenv("SKYLARK_SERVE_SHARD", raising=False)
+    monkeypatch.delenv("SKYLARK_SERVE_SHARD_MIN_FLOPS", raising=False)
+    dispatch.clear_cache()
+    srv = _replica(max_coalesce=16)
+    futures = [
+        srv.submit(serve.make_request("ls_solve", system="sys", b=b))
+        for b in RHS[:10]
+    ]
+    srv.start()
+    results = [f.result() for f in futures]
+    srv.stop()
+    assert all(r["ok"] for r in results)
+    assert not dispatch._PROGRAMS  # nothing was ever built
+    for r in results:
+        assert all(
+            "shard" not in e.get("kind", "") for e in r["trace"]["events"]
+        )
+
+
+# ---------------------------------------------------------------------------
+# replicated workers
+
+
+def test_multi_worker_bitwise_identical_to_single():
+    def run(workers):
+        srv = _replica(max_coalesce=4, workers=workers)
+        srv.start()
+        futures = [srv.submit(r) for r in _requests()]
+        results = [f.result() for f in futures]
+        report = srv.load_report()
+        srv.stop()
+        return results, report
+
+    one, _ = run(1)
+    two, report = run(2)
+    assert all(r["ok"] for r in one + two)
+    for s, c in zip(one, two):
+        assert (np.asarray(s["result"]) == np.asarray(c["result"])).all()
+    assert report["workers"] == 2
+    # the load report carries per-key measured throughput for placement
+    assert "ls:sys" in report["throughput"]
+    assert report["throughput"]["ls:sys"]["requests"] == 10
+    assert report["census"]["systems"] == ["sys"]
+    assert isinstance(report["signature"], int)
+
+
+def test_multi_worker_prime_covers_every_pinned_device():
+    srv = serve.Server(
+        serve.ServeParams(
+            max_coalesce=8, warm_start=False, prime=True, workers=2
+        ),
+        seed=3,
+    )
+    srv.registry.register_system("sys", A, context=SketchContext(seed=9))
+    srv.start()
+    primed = list(srv.primed)
+    r = srv.call(op="ls_solve", system="sys", b=RHS[0])
+    srv.stop()
+    assert r["ok"]
+    assert any(p.startswith("system:sys") for p in primed)
+
+
+# ---------------------------------------------------------------------------
+# the front-door router
+
+
+def test_two_replica_routed_bitwise_equals_single_worker_serial():
+    serial = _serial_reference()
+    r1, r2 = _replica().start(), _replica().start()
+    router = serve.Router()
+    assert router.join("r1", server=r1)["epoch"] == 1
+    assert router.join("r2", server=r2)["epoch"] == 2
+    futures = [router.submit(r) for r in _requests()]
+    routed = [f.result() for f in futures]
+    fleet = router.fleet_report()
+    router.stop()
+    r1.stop()
+    r2.stop()
+
+    assert all(r["ok"] for r in serial + routed)
+    for s, c in zip(serial, routed):
+        assert (np.asarray(s["result"]) == np.asarray(c["result"])).all()
+    # placement rode affinity: each key pinned to exactly one replica,
+    # and every response is stamped with its placement + fleet epoch
+    by_key: dict = {}
+    for req, resp in zip(_requests(), routed):
+        by_key.setdefault(protocol.placement_key(req), set()).add(
+            resp["trace"]["replica"]
+        )
+        assert resp["trace"]["fleet_epoch"] == 2
+    assert all(len(replicas) == 1 for replicas in by_key.values())
+    assert fleet["epoch"] == 2 and len(fleet["members"]) == 2
+
+
+def test_join_signature_mismatch_code_109():
+    r1 = _replica().start()
+    router = serve.Router()
+    router.join("r1", server=r1)
+    odd = serve.Server(_params(), seed=42)
+    odd.registry.register_system("other", A, context=SketchContext(seed=9))
+    odd.start()
+    with pytest.raises(ex.WorldMismatchError) as ei:
+        router.join("odd", server=odd)
+    assert ei.value.code == 109
+    fleet = router.fleet_report()
+    assert set(fleet["members"]) == {"r1"} and fleet["epoch"] == 1
+    router.stop()
+    odd.stop()
+    r1.stop()
+
+
+def test_heartbeat_eject_114_and_replacement_on_survivors():
+    r1, r2 = _replica().start(), _replica().start()
+    router = serve.Router(serve.RouterParams(heartbeat_timeout_s=5.0))
+    router.join("r1", server=r1)
+    router.join("r2", server=r2)
+    # pin the LS key's affinity to whichever replica places first
+    first = router.call(op="ls_solve", system="sys", b=RHS[0])
+    assert first["ok"]
+    pinned = first["trace"]["replica"]
+    lost, survivor = (
+        ("r1", r2) if pinned == "r1" else ("r2", r1)
+    )
+    (r1 if lost == "r1" else r2).stop()  # worker dies mid-fleet
+
+    now = time.monotonic()
+    assert router.poll_once(now=now)[lost] is False  # fenced immediately
+    alive = router.poll_once(now=now + 10.0)  # past the timeout: ejected
+    assert set(alive) == {"r1", "r2"} - {lost}
+    fleet = router.fleet_report()
+    assert lost not in fleet["members"]
+    assert fleet["epoch"] == 3  # two joins + one eject
+    # the dead replica's keys re-place transparently on the survivor
+    results = [
+        router.call(op="ls_solve", system="sys", b=b) for b in RHS[:4]
+    ]
+    assert all(r["ok"] for r in results)
+    assert {r["trace"]["replica"] for r in results} == set(alive)
+
+    # the last replica dies too: 114 reaches the caller, structured.
+    # (keep the injected clock moving forward past the survivor's
+    # refreshed heartbeat at now+10)
+    survivor.stop()
+    router.poll_once(now=now + 20.0)
+    resp = router.call(op="ls_solve", system="sys", b=RHS[0])
+    assert not resp["ok"] and resp["error"]["code"] == 114
+    with pytest.raises(ex.ReplicaLostError):
+        serve.raise_for_error(resp)
+    router.stop()
+
+
+def test_fleet_saturation_sheds_code_112():
+    r1 = _replica().start()
+    router = serve.Router()
+    router.join("r1", server=r1)
+    with router._lock:  # freeze a saturated report, as a heartbeat would
+        router._members["r1"].report = _report(8, cap=8)
+    resp = router.call(op="ls_solve", system="sys", b=RHS[0])
+    assert not resp["ok"] and resp["error"]["code"] == 112
+    with pytest.raises(ex.AdmissionError):
+        serve.raise_for_error(resp)
+    router.stop()
+    r1.stop()
+
+
+def test_join_is_placeable_only_after_prime_and_start():
+    """Zero-downtime rollout: an unstarted (unprimed) replica may join
+    but draws no traffic until its worker loop is up — and start()
+    primes BEFORE spawning workers, so placeable implies warm."""
+    warm = _replica().start()
+    cold = serve.Server(
+        serve.ServeParams(warm_start=False, prime=True), seed=42
+    )
+    cold.registry.register_system("sys", A, context=SketchContext(seed=9))
+    cold.registry.register_model("fm", _feature_map_model())
+    cold.registry.register_model("krr", _kernel_model())
+    router = serve.Router()
+    router.join("warm", server=warm)
+    rec = router.join("cold", server=cold)
+    assert rec["placeable"] is False
+    r = router.call(op="ls_solve", system="sys", b=RHS[0])
+    assert r["ok"] and r["trace"]["replica"] == "warm"
+
+    cold.start()  # primes the ladder, THEN spawns the worker
+    assert cold.primed
+    router.poll_once()
+    assert router.fleet_report()["members"]["cold"]["placeable"]
+    # drain the affinity pin: a fresh key may now land on cold
+    with router._lock:
+        router._affinity.clear()
+        router._members["warm"].report = _report(8, cap=8)
+    r = router.call(op="ls_solve", system="sys", b=RHS[1])
+    assert r["ok"] and r["trace"]["replica"] == "cold"
+    router.stop()
+    warm.stop()
+    cold.stop()
+
+
+# ---------------------------------------------------------------------------
+# HTTP: keep-alive client, /fleet + /join endpoints, skylark-top
+
+
+def _http_server(srv):
+    httpd = serve.serve_http(srv, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    host, port = httpd.server_address[:2]
+    return httpd, f"http://{host}:{port}"
+
+
+def test_client_keepalive_connection_reuse(monkeypatch):
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    telemetry.REGISTRY.reset()
+    srv = _replica().start()
+    httpd, url = _http_server(srv)
+    try:
+        client = serve.Client(url=url)
+        for b in RHS[:3]:
+            assert client.ls_solve("sys", b, check=True)
+        health = client.healthz()
+        client.close()
+    finally:
+        httpd.shutdown()
+        srv.stop()
+    counters = telemetry.REGISTRY.snapshot()["counters"]
+    telemetry.REGISTRY.reset()
+    # one TCP connect, then reuse (HTTP/1.1 keep-alive end to end)
+    assert counters.get("serve.client_conn_fresh") == 1
+    assert counters.get("serve.client_conn_reused", 0) >= 3
+    assert health["ok"] and "load" in health
+    assert health["load"]["signature"] == _replica().signature()
+
+
+def test_router_http_front_door_join_fleet_and_placement():
+    replica = _replica().start()
+    rep_httpd, rep_url = _http_server(replica)
+    router = serve.Router()
+    front_httpd, front_url = _http_server(router)
+    try:
+        # a replica announces itself over POST /join
+        req = urllib.request.Request(
+            front_url + "/join",
+            data=json.dumps({"name": "r1", "url": rep_url}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            rec = json.loads(r.read().decode())
+        assert rec["ok"] and rec["placeable"]
+        # GET /fleet on the front door shows the membership table
+        with urllib.request.urlopen(front_url + "/fleet", timeout=10) as r:
+            fleet = json.loads(r.read().decode())
+        assert "r1" in fleet["members"] and fleet["epoch"] == 1
+        # ... and on a plain replica, its own load report
+        with urllib.request.urlopen(rep_url + "/fleet", timeout=10) as r:
+            load = json.loads(r.read().decode())
+        assert load["worker_alive"] and "throughput" in load
+        # POST / to the front door routes through the HTTP replica,
+        # bitwise equal to asking the replica directly
+        front = serve.Client(url=front_url)
+        direct = serve.Client(url=rep_url)
+        via_router = front.ls_solve("sys", RHS[0], check=True)
+        straight = direct.ls_solve("sys", RHS[0], check=True)
+        assert via_router == straight
+        # a signature-mismatched joiner is rejected with a 109 envelope
+        odd = serve.Server(_params(), seed=42)
+        odd.registry.register_system(
+            "other", A, context=SketchContext(seed=9)
+        )
+        odd.start()
+        odd_httpd, odd_url = _http_server(odd)
+        try:
+            req = urllib.request.Request(
+                front_url + "/join",
+                data=json.dumps({"name": "odd", "url": odd_url}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            body = json.loads(ei.value.read().decode())
+            assert ei.value.code == 409
+            assert body["error"]["code"] == 109
+        finally:
+            odd_httpd.shutdown()
+            odd.stop()
+    finally:
+        front_httpd.shutdown()
+        rep_httpd.shutdown()
+        router.stop()
+        replica.stop()
+
+
+def test_skylark_top_renders_fleet_table(capsys):
+    from libskylark_tpu.cli.top import main
+
+    r1, r2 = _replica().start(), _replica().start()
+    h1, u1 = _http_server(r1)
+    h2, u2 = _http_server(r2)
+    try:
+        r1.call(op="ls_solve", system="sys", b=RHS[0])
+        assert main(["--url", u1, "--url", u2, "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "fleet (2 replicas)" in out
+        assert "replica" in out and "queue" in out and "heartbeat" in out
+        assert u1 in out and u2 in out
+        # single-url mode keeps the PR-12 detail view
+        assert main(["--url", u1, "--once"]) == 0
+        out = capsys.readouterr().out
+        assert "serve " + u1 in out
+        assert "p50" in out
+    finally:
+        h1.shutdown()
+        h2.shutdown()
+        r1.stop()
+        r2.stop()
+
+
+def test_router_counters_fold_into_snapshot(monkeypatch):
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    telemetry.REGISTRY.reset()
+    r1 = _replica().start()
+    router = serve.Router()
+    router.join("r1", server=r1)
+    for b in RHS[:3]:
+        assert router.call(op="ls_solve", system="sys", b=b)["ok"]
+    snap = telemetry.snapshot()
+    router.stop()
+    r1.stop()
+    telemetry.REGISTRY.reset()
+    assert snap["router"]["placements"] == 3
+    assert snap["router"]["affinity_hits"] == 2
+    assert snap["router"]["joins"] == 1
+    assert 0.0 <= snap["router"]["affinity_ratio"] <= 1.0
