@@ -30,10 +30,17 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=38734)
     p.add_argument("--solver", default="accelerated",
                    choices=["exact", "sketched", "accelerated", "lsrn",
-                            "auto"],
-                   help="'auto' lets the adaptive policy route between "
-                        "sketch-and-solve, Blendenpik, LSRN, and exact "
-                        "from the profile store (docs/autotuning.md)")
+                            "refine", "auto"],
+                   help="'refine' is certified mixed-precision iterative "
+                        "refinement (docs/performance.md); 'auto' lets "
+                        "the adaptive policy route between "
+                        "sketch-and-solve, refine, Blendenpik, LSRN, and "
+                        "exact from the profile store (docs/autotuning.md)")
+    p.add_argument("--cond-est", action="store_true",
+                   help="print a sketched condition / effective-rank "
+                        "report of A before solving — the same numbers "
+                        "the serve layer's cond_est endpoint reports "
+                        "(docs/serving.md), computed locally")
     p.add_argument("--sparse", action="store_true")
     p.add_argument("--x64", action="store_true")
     p.add_argument("--shard", action="store_true",
@@ -115,6 +122,8 @@ def main(argv=None) -> int:
             mesh = default_mesh()
             Aj, n_orig = shard_rows_padded(Aj, mesh)
             b = np.concatenate([b, np.zeros(Aj.shape[0] - n_orig)])
+    if args.cond_est:
+        _print_cond_est(args, Aj)
     t0 = time.perf_counter()
     result = solve_regression(
         RegressionProblem(Aj),
@@ -128,12 +137,46 @@ def main(argv=None) -> int:
     r = np.linalg.norm(np.asarray(Aj @ jnp.asarray(x)) - b)
     print(f"Solved {A.shape[0]}x{A.shape[1]} ({args.solver}) in {dt:.3f}s; "
           f"residual {r:.6e}")
+    info = result[1] if isinstance(result, tuple) else None
+    rf = (info or {}).get("refine") if isinstance(info, dict) else None
+    if rf:
+        gate = rf.get("gate")
+        gate_s = f", gate {gate:.3e}" if isinstance(gate, float) else ""
+        print(f"Refine: {rf.get('iters')} sweeps (rung {rf.get('rung')}, "
+              f"halt {rf.get('halt', 'converged')}{gate_s})")
     np.save(args.solution, x)
     print(f"Solution -> {args.solution}")
     print_perf_report(args)
     print_policy_report(args)
     print_telemetry_report(args)
     return 0
+
+
+def _print_cond_est(args, Aj) -> None:
+    """The serve layer's cond_est report, computed locally: sketch once,
+    QR, short-budget ``cond_est`` on R (which carries S·A's singular
+    values) plus one small SVD for the effective rank — the full (m, n)
+    matrix is never probed directly."""
+    import jax.numpy as jnp
+
+    from .. import plans
+    from ..core.context import SketchContext
+    from ..sketch.base import create_sketch
+    from ..solvers.cond_est import CondEstParams, cond_est
+
+    m, n = (int(d) for d in Aj.shape)
+    s = min(max(4 * n, n + 16), m)
+    S = create_sketch("CWT" if args.sparse else "FJLT", m, s,
+                      SketchContext(seed=args.seed))
+    R = jnp.linalg.qr(plans.apply(S, Aj, "columnwise"), mode="r")
+    rep = cond_est(R, SketchContext(seed=0x5EED),
+                   CondEstParams(iter_lim=60, powerits=25))
+    sv = np.asarray(jnp.linalg.svd(R, compute_uv=False))
+    cutoff = float(np.finfo(sv.dtype).eps) * n * float(sv[0])
+    print(f"Cond-est: cond {float(rep.cond):.4e}, "
+          f"sigma [{float(rep.sigma_min):.4e}, {float(rep.sigma_max):.4e}], "
+          f"effective rank {int((sv > cutoff).sum())}/{n} "
+          f"(sketch size {s})")
 
 
 def _stream_main(args) -> int:

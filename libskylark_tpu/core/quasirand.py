@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils.exceptions import InvalidParameters
+
 __all__ = ["primes", "radical_inverse", "LeapedHaltonSequence"]
 
 
@@ -84,8 +86,27 @@ class LeapedHaltonSequence:
     leap: int = -1
 
     def __post_init__(self):
+        if self.d < 0:
+            raise InvalidParameters(f"Halton dimension must be >= 0, got {self.d}")
         if self.leap == -1:
             object.__setattr__(self, "leap", int(primes(self.d + 1)[-1]))
+            return
+        if self.leap < 1:
+            raise InvalidParameters(
+                f"Halton leap must be a positive integer (or -1 for the "
+                f"default), got {self.leap}"
+            )
+        # A leap sharing a factor with a base prime visits only a strict
+        # subsequence of that base's digit lattice (idx * leap ≡ 0 cycles),
+        # destroying equidistribution in that dimension.  Bases are prime,
+        # so coprimality is exactly "no base divides the leap".
+        bad = [int(p) for p in primes(self.d) if self.leap % int(p) == 0]
+        if bad:
+            raise InvalidParameters(
+                f"Halton leap {self.leap} is not coprime with base(s) {bad}; "
+                f"choose a leap not divisible by any of the first {self.d} "
+                f"primes"
+            )
 
     def coordinate(self, idx, i):
         """Value(s) at sequence index ``idx``, dimension ``i``."""

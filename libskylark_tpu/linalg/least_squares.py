@@ -148,13 +148,13 @@ def approximate_least_squares(
     :func:`~libskylark_tpu.policy.choose_route` with the problem's
     signature.  With no matured profile entry the decision is exactly the
     defaults above (bit-parity contract, ``tests/test_policy.py``); a
-    matured entry may reroute to ``blendenpik``/``lsrn``/``exact``,
-    shrink the sketch dimension toward the smallest certified-OK size, or
-    sketch bf16-first (escalating back to the input dtype when attempt
-    0's certificate is not OK).  ``route`` pins the route explicitly
-    (one of ``"sketch"``, ``"blendenpik"``, ``"lsrn"``, ``"exact"``);
-    pinned ``params`` fields always win.  ``info["policy"]`` carries the
-    decision.
+    matured entry may reroute to ``blendenpik``/``lsrn``/``refine``/
+    ``exact``, shrink the sketch dimension toward the smallest
+    certified-OK size, or sketch bf16-first (escalating back to the
+    input dtype when attempt 0's certificate is not OK).  ``route`` pins
+    the route explicitly (one of ``"sketch"``, ``"refine"``,
+    ``"blendenpik"``, ``"lsrn"``, ``"exact"``); pinned ``params`` fields
+    always win.  ``info["policy"]`` carries the decision.
     """
     from .. import policy
     from ..policy.decide import LS_ROUTES
@@ -223,6 +223,25 @@ def approximate_least_squares(
         info = dict(rinfo)
         info["policy"] = decision.to_dict()
         policy.observe(decision, info, default_size=default_size)
+        telemetry.run_summary("sketch_and_solve_ls", info)
+        return (out, info) if return_info else out
+    if decision.route == "refine":
+        from ..solvers.refine import RefineParams, refine_least_squares
+
+        rp = RefineParams(
+            sketch_type=decision.sketch_type,
+            sketch_size=decision.sketch_size,
+        )
+        X, rinfo = refine_least_squares(
+            A, B, context, rp, fault_plan=fault_plan
+        )
+        out = X[:, 0] if squeeze else X
+        info = dict(rinfo)
+        info["policy"] = decision.to_dict()
+        policy.observe(
+            decision, info, default_size=default_size,
+            refine=rinfo.get("refine"),
+        )
         telemetry.run_summary("sketch_and_solve_ls", info)
         return (out, info) if return_info else out
 

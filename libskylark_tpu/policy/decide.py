@@ -28,6 +28,13 @@ What a matured entry can change:
   dimension toward the smallest size that certified OK (and probes one
   step below it), with the guard ladder as the safety net when the
   probe undershoots.
+- **route (refine)** — once the key has refinement history on record —
+  at least one certified-converged refine run and no recorded
+  stagnation — AND a comfortable cond margin, healthy entries earn the
+  ``refine`` route: certified mixed-precision refinement reaches
+  near-machine accuracy at a fraction of the exact-f64 flops.  A single
+  recorded stagnation retires the route (the history requirement fails)
+  until the key's refine record is clean again.
 - **precision** — bf16-first on MXU backends once the entry is healthy
   and no bf16 failure is on record; the guard certificate checks the
   narrow sketch and the caller escalates back to the input dtype on a
@@ -50,7 +57,7 @@ from .profile import load_entries, profile_key
 __all__ = ["ProblemSignature", "Decision", "choose_route"]
 
 # Valid least-squares routes, in escalation order of cost.
-LS_ROUTES = ("sketch", "blendenpik", "lsrn", "exact")
+LS_ROUTES = ("sketch", "refine", "blendenpik", "lsrn", "exact")
 
 # A certificate is "comfortable" when the estimated cond sits at least
 # this factor under the guard ceiling — margin enough that a smaller
@@ -199,6 +206,28 @@ def choose_route(
                 f"resketch rate {resketch_rate:.2f}: ill-conditioned but "
                 "recoverable; preconditioned iterative route"
             )
+        else:
+            # The refine route must be EARNED through recorded refine
+            # history (an "auto" caller never lands here cold): at least
+            # one certified-converged run, zero recorded stagnations —
+            # a single stagnation retires the route — plus a healthy
+            # guard record and a comfortable cond margin so the
+            # low-precision factorization has headroom.
+            rf = entry.get("refine") or {}
+            cond_seen = (entry.get("cond") or {}).get("max")
+            if (
+                healthy
+                and int(rf.get("ok", 0)) >= 1
+                and int(rf.get("stagnate", 0)) == 0
+                and cond_seen is not None
+                and float(cond_seen) * _COMFORT_MARGIN
+                < _cond_ceiling(sig.dtype)
+            ):
+                d.route = "refine"
+                d.reasons.append(
+                    f"refine earned: {int(rf.get('ok', 0))} certified "
+                    "refine runs, no stagnation, comfortable cond margin"
+                )
 
     # -- sketch dimension ----------------------------------------------------
     if (
@@ -241,6 +270,7 @@ def choose_route(
         sig.dtype == "float32"
         and not sig.sparse
         and sig.kind in ("ls", "krr")
+        and d.route != "refine"  # refine owns its precision rung
         and healthy
         and int(bf.get("fail", 0)) == 0
         and config.bf16_allowed(sig.backend)

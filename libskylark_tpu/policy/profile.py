@@ -19,6 +19,7 @@ Entry schema (one per :func:`profile_key`):
      "cond": {"last": 1.2e3, "max": 4.1e3},
      "sketch": {"type": "FJLT", "min_ok": 512, "default": 2048},
      "bf16": {"ok": 3, "fail": 0},
+     "refine": {"ok": 2, "stagnate": 0, "iters": 47, "rung": "bf16+f32"},
      "routes": {"sketch": 7},
      "escalations": 0,
      "throughput": {"rows_per_s": 1.1e6, "batches": 16}}
@@ -239,8 +240,9 @@ class ProfileStore:
         ``obs`` fields (all optional): ``ok0`` (attempt-0 certificate
         OK), ``resketches``, ``fallback``, ``cond``, ``sketch_type``,
         ``sketch_size`` (certified-OK size), ``default_size``, ``route``,
-        ``bf16`` / ``fp8`` (``"ok"``/``"fail"``), ``escalated``,
-        ``rows_per_s``, ``batches``.
+        ``bf16`` / ``fp8`` (``"ok"``/``"fail"``), ``refine`` (the solve's
+        ``info["refine"]`` dict: ``converged``/``iters``/``rung``),
+        ``escalated``, ``rows_per_s``, ``batches``.
         """
         with _LOCK:
             e = self._seed(key)
@@ -288,6 +290,21 @@ class ProfileStore:
             if obs.get("fp8") in ("ok", "fail"):
                 f8 = e.setdefault("fp8", {"ok": 0, "fail": 0})
                 f8[obs["fp8"]] = f8.get(obs["fp8"], 0) + 1
+            rf_obs = obs.get("refine")
+            if isinstance(rf_obs, dict) and rf_obs.get("converged") is not None:
+                rf = e.setdefault(
+                    "refine",
+                    {"ok": 0, "stagnate": 0, "iters": None, "rung": None},
+                )
+                # A non-converged final state means refinement stagnated
+                # (or fell through the ladder to the exact fallback) —
+                # either way the route's premise failed for this key.
+                which = "ok" if rf_obs.get("converged") else "stagnate"
+                rf[which] = int(rf.get(which, 0)) + 1
+                if rf_obs.get("iters") is not None:
+                    rf["iters"] = int(rf_obs["iters"])
+                if rf_obs.get("rung"):
+                    rf["rung"] = str(rf_obs["rung"])
             if obs.get("escalated"):
                 e["escalations"] = int(e.get("escalations", 0)) + 1
             if obs.get("rows_per_s") is not None:

@@ -150,6 +150,7 @@ def observe(
     default_size: int | None = None,
     bf16: str | None = None,
     fp8: str | None = None,
+    refine: dict | None = None,
     rows_per_s: float | None = None,
     batches: int | None = None,
 ) -> None:
@@ -159,6 +160,8 @@ def observe(
     obs = _recovery_obs(info)
     obs["route"] = decision.route
     obs["sketch_type"] = decision.sketch_type
+    if refine is not None:
+        obs["refine"] = dict(refine)
     if default_size is not None:
         obs["default_size"] = int(default_size)
     if decision.escalated:

@@ -204,7 +204,32 @@ def _execute_predict(registry, entries, device=None):
     return outs, kb
 
 
-_EXECUTORS = {"ls_solve": _execute_ls, "predict": _execute_predict}
+def _execute_cond_est(registry, entries, device=None):
+    """Served cond-est: ONE cached probe of the system's R factor
+    (``LSSystem.cond_report``), fanned to every coalesced rider.  The
+    heavy spectral work happened at registration (QR of S·A); the
+    per-batch cost after the first request is a dict copy per rider."""
+    system = registry.get_system(entries[0].request["system"])
+    rep = system.cond_report()
+    return [dict(rep) for _ in entries], len(entries)
+
+
+_EXECUTORS = {
+    "ls_solve": _execute_ls,
+    "cond_est": _execute_cond_est,
+    "predict": _execute_predict,
+}
+
+
+def _result_finite(out) -> bool:
+    """The per-request finite probe, dict-aware: structured results
+    (cond-est reports) probe their numeric leaves, and NaN alone is
+    unhealthy — an honest ``inf`` cond for a numerically singular
+    system is a legitimate served answer, not a fault."""
+    if isinstance(out, dict):
+        vals = [v for v in out.values() if isinstance(v, (int, float))]
+        return not np.isnan(np.asarray(vals, np.float64)).any()
+    return bool(np.isfinite(np.asarray(out, np.float64)).all())
 
 
 def _decode(entry, out):
@@ -308,7 +333,7 @@ def _dispatch(registry, entries, device=None) -> None:
         return
     t_ms = (time.perf_counter() - t0) * 1e3
     for entry, out in zip(entries, outs):
-        if not np.isfinite(np.asarray(out, np.float64)).all():
+        if not _result_finite(out):
             if n > 1:
                 # this request's own data is bad (padding and batch-mates
                 # cannot leak in — slot purity): solo re-run confirms, and

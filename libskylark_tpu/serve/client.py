@@ -133,6 +133,11 @@ class Client:
             self.call(op="ls_solve", system=system, b=b, **fields), check
         )
 
+    def cond_est(self, system: str, *, check: bool = False, **fields):
+        return self._unwrap(
+            self.call(op="cond_est", system=system, **fields), check
+        )
+
     def predict(self, model: str, x, *, labels: bool = False,
                 check: bool = False, **fields):
         return self._unwrap(

@@ -10,13 +10,16 @@ marshalling.
 Request schema::
 
     {"id": str|int,            # caller-chosen correlation id (optional)
-     "op": "ls_solve" | "predict" | "ping" | "stats",
+     "op": "ls_solve" | "cond_est" | "predict" | "ping" | "stats",
      # ls_solve:
      "system": str,            # registered system name
      "b": [float, ...],        # RHS, length m
      "fresh_sketch": bool,     # per-request sketch from the server's
                                # counter stream (slow path; bitwise-
                                # addressable via trace.counter_base)
+     # cond_est: {"system": str} — result is the system's cached
+     # sketched-spectrum report {cond, sigma_max, sigma_min,
+     # effective_rank, n, sketch_size}; coalesced riders share one probe
      # predict:
      "model": str,             # registered model name
      "x": [..] | [[..], ..],   # one row (d,) or a block (r, d)
@@ -62,7 +65,7 @@ __all__ = [
     "raise_for_error",
 ]
 
-OPS = ("ls_solve", "predict", "ping", "stats")
+OPS = ("ls_solve", "cond_est", "predict", "ping", "stats")
 
 
 def placement_key(request: dict) -> str:
@@ -75,6 +78,8 @@ def placement_key(request: dict) -> str:
     op = request.get("op")
     if op == "ls_solve":
         return f"ls:{request.get('system')}"
+    if op == "cond_est":
+        return f"cond:{request.get('system')}"
     if op == "predict":
         return (
             f"predict:{request.get('model')}"

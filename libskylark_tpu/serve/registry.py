@@ -61,6 +61,38 @@ class LSSystem:
             "sketch_size": int(self.S.s),
         }
 
+    def cond_report(self) -> dict:
+        """Condition / effective-rank report of the sketched system,
+        probed ONCE and cached: R from QR(S·A) carries S·A's singular
+        values (replicated-small n×n), so the probe is a short-budget
+        ``cond_est`` on R plus one small SVD for the effective rank —
+        the full (m, n) A is never touched.  Coalesced ``cond_est``
+        requests for the same placement key all fan out this one dict.
+        """
+        rep = getattr(self, "_cond_report", None)
+        if rep is None:
+            import numpy as np
+
+            from ..solvers.cond_est import CondEstParams, cond_est
+
+            r = cond_est(
+                self.R,
+                SketchContext(seed=0x5EED),
+                CondEstParams(iter_lim=60, powerits=25),
+            )
+            sv = np.asarray(jnp.linalg.svd(self.R, compute_uv=False))
+            cutoff = float(np.finfo(sv.dtype).eps) * self.n * float(sv[0])
+            rep = self._cond_report = {
+                "system": self.name,
+                "cond": float(r.cond),
+                "sigma_max": float(r.sigma_max),
+                "sigma_min": float(r.sigma_min),
+                "effective_rank": int((sv > cutoff).sum()),
+                "n": self.n,
+                "sketch_size": int(self.S.s),
+            }
+        return rep
+
 
 class Registry:
     def __init__(self):

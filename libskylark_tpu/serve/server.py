@@ -203,6 +203,9 @@ class Server:
                         for _ in range(w)
                     ]
                     batcher._execute_ls(self.registry, entries, dev)
+            # cond-est answers from this cached report; probing it here
+            # keeps the first served cond_est request off the probe cost
+            system.cond_report()
             self.primed.append(f"system:{name}:{widths}")
         from .. import plans
 
@@ -426,6 +429,14 @@ class Server:
             else:
                 key = ("ls", request["system"])
             return Entry(request, fut, key, op, payload=b)
+        if op == "cond_est":
+            # validate the name at the door; the executor serves the
+            # system's cached sketched-spectrum report to the batch
+            self.registry.get_system(request.get("system"))
+            return Entry(
+                request, fut, ("cond", request["system"]), op,
+                payload=np.zeros(0),
+            )
         if op == "predict":
             model = self.registry.get_model(request.get("model"))
             dtype = np.dtype(request.get("dtype", "float64"))

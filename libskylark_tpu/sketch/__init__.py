@@ -23,6 +23,7 @@ from .frft import FastGaussianRFT, FastMaternRFT, FastRFT
 from .fut import RFUT, dct, next_pow2, wht
 from .hash import CWT, MMT, SJLT, WZT, HashSketch
 from .ppt import PPT
+from .quasi import QJLT
 from .rft import (
     RFT,
     GaussianQRFT,
@@ -48,6 +49,7 @@ __all__ = [
     "sketch_registry",
     "DenseSketch",
     "JLT",
+    "QJLT",
     "CT",
     "HashSketch",
     "CWT",

@@ -1,0 +1,167 @@
+"""Quasirandom dense sketch: Halton-driven JLT rows (QJLT).
+
+A dense l2 subspace embedding whose rows come from a leaped Halton
+sequence (``core.quasirand.LeapedHaltonSequence``) pushed through the
+normal inverse CDF, instead of iid counter draws: row ``j`` of the
+logical (S, N) sketch matrix is ``ndtri(seq(skip + j, ·)) / sqrt(S)``.
+
+QMC rows cover the sphere more evenly than iid rows, so the same
+embedding distortion is reached at a smaller sketch dimension S — which
+is exactly the axis the policy layer's sketch-dim shrink loop probes.
+Like the QRFT family the transform consumes NO counters: reproducibility
+is carried by ``(d, leap, skip)``, all of which ride the standard sketch
+JSON interchange (plan cache, serve registry, native parity surface).
+
+Unlike the counter stream (integer threefry, bit-stable under jit), the
+radical-inverse/ndtri float pipeline drifts ~1 ulp between jitted and
+eager execution, so windows are realized under
+``jax.ensure_compile_time_eval``: Omega is computed eagerly even while a
+plan traces, and the planned apply stays BITWISE identical to the eager
+apply (the plan embeds the concrete window as a constant).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.context import SketchContext
+from ..core.quasirand import LeapedHaltonSequence, primes, radical_inverse
+from .base import Dimension, SketchTransform, register_sketch
+from .dense import _matmul
+
+__all__ = ["QJLT"]
+
+
+@register_sketch
+class QJLT(SketchTransform):
+    """Quasirandom Johnson-Lindenstrauss: Halton rows through ndtri,
+    scale ``sqrt(1/S)`` — the QMC sibling of :class:`~.dense.JLT`.
+
+    Any window of the logical (S, N) matrix is a pure function of
+    ``(leap, skip, i, j)`` — the same shard-local realization invariant
+    the counter-based dense engine guarantees (P5), with the Halton
+    index replacing the counter.
+    """
+
+    sketch_type = "QJLT"
+
+    def __init__(
+        self,
+        n: int,
+        s: int,
+        context: SketchContext,
+        leap: int | None = None,
+        skip: int | None = None,
+    ):
+        super().__init__(n, s, context)
+        self._sequence = LeapedHaltonSequence(
+            n, -1 if leap is None else int(leap)
+        )
+        self.leap = self._sequence.leap
+        # The sequence itself is deterministic, so the SEED must move the
+        # rows or the guard ladder's fresh-seed resketch would reproduce
+        # the identical sketch.  The default skip is seed-derived (and
+        # then serialized explicitly, so JSON round-trips are exact).
+        self.skip = (
+            int(context.seed) % (1 << 20) if skip is None else int(skip)
+        )
+        self.scale = (1.0 / s) ** 0.5
+
+    # -- lazy realization ---------------------------------------------------
+
+    def realize(
+        self,
+        dtype=jnp.float32,
+        offset: tuple[int, int] = (0, 0),
+        shape: tuple[int, int] | None = None,
+    ):
+        """Materialize a window of the logical (S, N) sketch matrix;
+        bit-identical to the same slice of the full matrix (the radical
+        inverse is evaluated per entry at the full 41-digit bound) AND
+        bit-identical whether the caller is eager or mid-trace (the
+        window is forced to compile-time evaluation)."""
+        r0, c0 = offset
+        h, w = shape if shape is not None else (self.s - r0, self.n - c0)
+        if w <= 0 or h <= 0:
+            return jnp.zeros((max(h, 0), max(w, 0)), dtype)
+        itype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        with jax.ensure_compile_time_eval():
+            p = jnp.asarray(primes(self.n)[c0 : c0 + w], itype)
+            idx = (self.skip + r0 + jnp.arange(h, dtype=itype)) * self.leap
+            u = radical_inverse(p[None, :], idx[:, None])
+            omega = jax.scipy.special.ndtri(u) * jnp.asarray(
+                self.scale, u.dtype
+            )
+            return omega.astype(dtype)
+
+    # -- apply --------------------------------------------------------------
+
+    def apply(self, A, dim: Dimension | str = Dimension.COLUMNWISE):
+        dim = Dimension.of(dim)
+        A = jnp.asarray(A) if not hasattr(A, "todense") else A
+        dtype = A.dtype
+        if not jnp.issubdtype(dtype, jnp.floating):
+            dtype = jnp.float32
+        if dim is Dimension.COLUMNWISE:
+            if A.shape[0] != self.n:
+                raise ValueError(
+                    f"columnwise apply needs A with {self.n} rows, got {A.shape}"
+                )
+            return _matmul(self.realize(dtype), A)
+        if A.shape[-1] != self.n:
+            raise ValueError(
+                f"rowwise apply needs A with {self.n} columns, got {A.shape}"
+            )
+        return _matmul(A, self.realize(dtype).T)
+
+    def _apply_slice_columnwise(self, A_block, start: int):
+        k = A_block.shape[0]
+        dtype = A_block.dtype
+        if not jnp.issubdtype(dtype, jnp.floating):
+            dtype = jnp.float32
+        w = self.realize(dtype, offset=(0, start), shape=(self.s, k))
+        if hasattr(A_block, "todense"):
+            return _matmul(w, A_block)
+        return _matmul(w, A_block.astype(dtype))
+
+    def hoistable_operands(self, dtype):
+        """The realized (S, N) Omega, memoized per dtype (the transform
+        is immutable; realization is compile-time anyway, so this just
+        saves recomputing the radical inverses)."""
+        dtype = jnp.dtype(dtype)
+        if not jnp.issubdtype(dtype, jnp.floating):
+            dtype = jnp.dtype(jnp.float32)
+        cache = self.__dict__.setdefault("_hoist_cache", {})
+        hit = cache.get(dtype.name)
+        if hit is None:
+            hit = cache[dtype.name] = self.realize(dtype)
+        return hit
+
+    def apply_with_operands(
+        self, ops, A, dim: Dimension | str = Dimension.COLUMNWISE
+    ):
+        if ops is None:
+            return self.apply(A, dim)
+        dim = Dimension.of(dim)
+        A = jnp.asarray(A) if not hasattr(A, "todense") else A
+        dtype = A.dtype
+        if not jnp.issubdtype(dtype, jnp.floating):
+            dtype = jnp.float32
+        if ops.dtype != dtype:
+            ops = self.realize(dtype)
+        if dim is Dimension.COLUMNWISE:
+            return _matmul(ops, A)
+        return _matmul(A, ops.T)
+
+    # -- serialization ------------------------------------------------------
+
+    def _param_dict(self):
+        return {"leap": self.leap, "skip": self.skip}
+
+    @classmethod
+    def _from_param_dict(cls, d, context):
+        return cls(
+            d["N"], d["S"], context,
+            leap=d.get("leap"), skip=d.get("skip", 0),
+        )

@@ -5,6 +5,9 @@
 - ``precond``: preconditioner interface (≙ ``algorithms/Krylov/precond.hpp``)
 - ``accelerated``: Blendenpik / LSRN sketch-to-precondition least squares
   (≙ ``algorithms/regression/accelerated_linearl2_regression_solver*``)
+- ``refine``: certified mixed-precision iterative refinement — the
+  sketch-preconditioned factorization runs at low working precision,
+  residuals at f64, and the guard certifies the final gate
 - ``cond_est``: condition-number estimation (≙ ``nla/CondEst.hpp``)
 - ``gauss_seidel``: synchronous randomized block Gauss-Seidel (≙ the
   asynchronous AsyRGS, ``algorithms/asynch/``, re-expressed for TPU)
@@ -29,6 +32,7 @@ from .krylov import (
 )
 from .precond import IdPrecond, MatPrecond, TriInversePrecond
 from .prox import LOSSES, REGULARIZERS, get_loss, get_regularizer
+from .refine import RefineParams, refine_least_squares
 from .regression import RegressionProblem, solve_regression
 
 __all__ = [
@@ -47,6 +51,8 @@ __all__ = [
     "FasterLeastSquaresParams",
     "faster_least_squares",
     "lsrn_least_squares",
+    "RefineParams",
+    "refine_least_squares",
     "cond_est",
     "CondEstParams",
     "CondEstResult",

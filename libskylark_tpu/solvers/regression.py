@@ -9,6 +9,9 @@ to a dataclass + string enums + a dispatching ``solve``:
 - penalty "l2" sketched  → sketch-and-solve (``sketched_regression_solver``)
 - penalty "l2" accelerated → Blendenpik / LSRN
   (``accelerated_regression_solver``)
+- penalty "l2" refine    → certified mixed-precision refinement (sketch-
+  preconditioned low-precision factorization + f64 residual refinement;
+  no reference counterpart — documented deviation)
 - penalty "l1" sketched  → l1 sketch-and-solve via a Cauchy/MMT sketch +
   IRLS on the reduced problem (the reference frames l1 tags in the same
   system; its concrete l1 solvers run sketched problems through an LP —
@@ -86,14 +89,15 @@ def solve_regression(
 ):
     """Dispatch ≙ the regression_solver_t specializations.
 
-    solver ∈ {"exact", "sketched", "accelerated", "lsrn", "auto"}.
-    Returns X (and (X, info) for iterative solvers).
+    solver ∈ {"exact", "sketched", "accelerated", "lsrn", "refine",
+    "auto"}.  Returns X (and (X, info) for iterative solvers, refine
+    included).
 
     ``"auto"`` hands the l2 route to the policy layer: the sketched
     entrypoint consults :func:`~libskylark_tpu.policy.choose_route`
     against the profile store (``SKYLARK_POLICY_DIR``) and a matured
-    entry may reroute to Blendenpik/LSRN/exact — with an empty store it
-    IS ``"sketched"`` (the historical default, bit-identical).
+    entry may reroute to Blendenpik/LSRN/refine/exact — with an empty
+    store it IS ``"sketched"`` (the historical default, bit-identical).
     """
     A = problem.A
     if problem.regularization == "ridge" and problem.lam > 0:
@@ -131,6 +135,16 @@ def solve_regression(
         return approximate_least_squares(
             A, B, context, params or LeastSquaresParams(), alg=alg,
             route="sketch",
+        )
+    if solver == "refine":
+        if context is None:
+            raise ValueError("refine solver needs a SketchContext")
+        # Mixed-precision refinement by name: pin the route (same
+        # privilege split as "sketched" vs "auto") and surface the
+        # iteration/certification info like the iterative solvers do.
+        return approximate_least_squares(
+            A, B, context, params or LeastSquaresParams(), alg=alg,
+            route="refine", return_info=True,
         )
     if solver == "accelerated":
         if context is None:

@@ -22,6 +22,7 @@ __all__ = [
     "AdmissionError",
     "DeadlineExceededError",
     "ReplicaLostError",
+    "RefinementError",
 ]
 
 
@@ -195,3 +196,23 @@ class ReplicaLostError(SkylarkError):
         super().__init__(msg)
         self.replica = replica
         self.last_heartbeat_s = last_heartbeat_s
+
+
+class RefinementError(SkylarkError):
+    """Mixed-precision iterative refinement stagnated or diverged: the
+    f64 residual gate was not reached before the stagnation/divergence
+    detector fired (correction norms stopped contracting, or an iterate
+    went non-finite).  Under the guard ladder this is absorbed as a
+    resketch verdict — the ladder falls back down its existing rungs and
+    ultimately to the exact dense solve — so the error reaches a caller
+    only when guarding is disabled.  ``iters`` is the iteration count at
+    the halt, ``residual`` the best certified gate value observed, and
+    ``stage`` the pipeline stage (``"refine_ls"``)."""
+
+    code = 115
+
+    def __init__(self, msg, iters=None, residual=None, stage=None):
+        super().__init__(msg)
+        self.iters = iters
+        self.residual = residual
+        self.stage = stage

@@ -72,6 +72,10 @@ TRACE_TIMEOUT_S = 120
 # devices; a placement that never resolves or a worker pinned to a
 # wedged device must not stall the tier-1 run.
 FLEET_TIMEOUT_S = 120
+# Refine tests drive host-gated refinement sweeps (certified gates,
+# stagnation/ladder fallback, policy earning); a sweep loop that never
+# meets its gate must not stall the tier-1 run.
+REFINE_TIMEOUT_S = 120
 
 _TIMEOUT_MARKS = {
     "faults": FAULTS_TIMEOUT_S,
@@ -86,6 +90,7 @@ _TIMEOUT_MARKS = {
     "overlap": OVERLAP_TIMEOUT_S,
     "trace": TRACE_TIMEOUT_S,
     "fleet": FLEET_TIMEOUT_S,
+    "refine": REFINE_TIMEOUT_S,
 }
 
 
@@ -171,6 +176,13 @@ def pytest_configure(config):
         "parity, replicated workers, router placement / membership / "
         "failover); tier-1, guarded by a per-test "
         f"{FLEET_TIMEOUT_S}s timeout",
+    )
+    config.addinivalue_line(
+        "markers",
+        "refine: certified mixed-precision refinement tests (route-OFF "
+        "bitwise parity, certified convergence, stagnation/ladder "
+        "fallback, served cond-est, quasirandom sketch interchange); "
+        f"tier-1, guarded by a per-test {REFINE_TIMEOUT_S}s timeout",
     )
 
 

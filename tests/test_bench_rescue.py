@@ -39,8 +39,10 @@ def _run_bench(extra_env, timeout=110):
         SKYLARK_BENCH_SMOKE="1",
         SKYLARK_BENCH_ONLY="zzz-match-nothing",
         SKYLARK_BENCH_BUDGET_S="600",
-        **extra_env,
     )
+    # second update so extra_env may OVERRIDE the defaults (a duplicate
+    # keyword in one update() call is a TypeError)
+    env.update(extra_env)
     return subprocess.run(
         [sys.executable, _BENCH],
         capture_output=True,
@@ -100,6 +102,40 @@ def test_poisoned_rescue_escalates_to_cpu_reexec():
     full artifact (loop guard seeds the cpu-fallback tag across exec).
     """
     out = _run_bench({"SKYLARK_BENCH_SIM_POISON": "1"})
+    assert "backend fallback re-exec" in out.stderr, (
+        f"expected the execvpe escalation marker on stderr:\n{out.stderr}"
+    )
+    _assert_healthy_artifact(out)
+
+
+def test_init_fail_on_healthy_cpu_rescued_in_process():
+    """``SKYLARK_BENCH_SIM_INIT_FAIL`` suppresses backend init even with
+    ``JAX_PLATFORMS=cpu``: on a healthy host rung 1 must still deliver
+    the full artifact without escalating — the init-exhaustion path and
+    the in-process CPU rescue are independent."""
+    out = _run_bench(
+        {"JAX_PLATFORMS": "cpu", "SKYLARK_BENCH_SIM_INIT_FAIL": "1"}
+    )
+    _assert_healthy_artifact(out)
+    assert "backend fallback re-exec" not in out.stderr
+
+
+def test_init_exhaustion_reexecs_even_when_already_on_cpu():
+    """Regression (review BENCH_r05): ``_cpu_fallback`` used to skip the
+    re-exec rescue when the configured platform was ALREADY ``cpu``,
+    reasoning a CPU re-exec could not do better — but an init failure
+    whose cache an in-process ``clear_backends()`` cannot purge
+    (simulated by SIM_INIT_FAIL + SIM_POISON, both ignored by the
+    re-exec'd child via the loop-guard env) is exactly the case a fresh
+    interpreter fixes.  The rescue must be unconditional: healthy host,
+    no -1 rows, artifact delivered by the re-exec'd process."""
+    out = _run_bench(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "SKYLARK_BENCH_SIM_INIT_FAIL": "1",
+            "SKYLARK_BENCH_SIM_POISON": "1",
+        }
+    )
     assert "backend fallback re-exec" in out.stderr, (
         f"expected the execvpe escalation marker on stderr:\n{out.stderr}"
     )

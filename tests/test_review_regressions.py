@@ -441,7 +441,7 @@ def test_no_read_after_donation_lint():
 
 
 def test_error_codes_documented_and_traceable(tmp_path, monkeypatch):
-    """Error-code contract (ISSUE PR 12): the 100-114 ladder is only
+    """Error-code contract (ISSUE PR 12): the 100-115 ladder is only
     useful if every code (a) has a row in docs/fault_tolerance.md's
     matrix a supervisor can act on, and (b) surfaces through
     ``telemetry.error_event`` with a mandatory ``code`` attr so traces,
@@ -460,7 +460,7 @@ def test_error_codes_documented_and_traceable(tmp_path, monkeypatch):
         if issubclass(obj, ex.SkylarkError)
     ]
     codes = {cls.code for cls in classes}
-    assert codes == set(range(100, 115)), codes  # the ladder, no gaps
+    assert codes == set(range(100, 116)), codes  # the ladder, no gaps
 
     doc = (
         pathlib.Path(__file__).parent.parent / "docs" / "fault_tolerance.md"
@@ -499,3 +499,35 @@ def test_error_codes_documented_and_traceable(tmp_path, monkeypatch):
         telemetry.close()
         telemetry.configure(None)
         telemetry.reset()
+
+
+def test_env_knobs_documented():
+    """Env-knob doc contract (ISSUE PR 14): every ``SKYLARK_*``
+    environment variable the library reads must appear somewhere under
+    ``docs/`` — a knob an operator cannot discover is a support
+    incident, not a feature.  Static census: grep the package for
+    environ/getenv reads (with a short window for wrapped call sites)
+    and assert each harvested token has a docs mention."""
+    import pathlib
+    import re
+
+    root = pathlib.Path(__file__).parent.parent
+    knobs = set()
+    for path in (root / "libskylark_tpu").rglob("*.py"):
+        lines = path.read_text(encoding="utf-8").splitlines()
+        for i, line in enumerate(lines):
+            if "environ" in line or "getenv" in line:
+                window = "\n".join(lines[i : i + 3])
+                knobs.update(re.findall(r"SKYLARK_[A-Z0-9_]+", window))
+    # The census going empty means the grep rotted, not that the
+    # library grew knob-free — fail loudly either way.
+    assert len(knobs) >= 20, f"env-knob census looks stale: {sorted(knobs)}"
+    docs = "\n".join(
+        p.read_text(encoding="utf-8")
+        for p in sorted((root / "docs").glob("*.md"))
+    )
+    undocumented = sorted(k for k in knobs if k not in docs)
+    assert not undocumented, (
+        f"SKYLARK_* knobs read by the library but absent from docs/: "
+        f"{undocumented}"
+    )
