@@ -1610,6 +1610,136 @@ def bench_elastic_resume(on_tpu, table):
     )
 
 
+def bench_graph(on_tpu, table):
+    """Graph-analytics rows (docs/graph.md): (a) streamed edge-fold
+    sketch throughput (edges/s) vs the dense route on the SAME graph —
+    the dense baseline materializes the (n, n) adjacency and applies
+    the sketch to it, which is the pre-streaming in-core path; the
+    streamed fold touches O(edges) and must win by >= 1.3x even on CPU
+    (``vs_baseline`` is the speedup).  (b) Elastic ASE kill-resume:
+    wall-seconds from a mid-pass preemption to the FIRST post-resume
+    edge fold landing (same shape as the elastic-resume row, over the
+    graph fold).  (c) Served PPR QPS, coalesced vs serial — same-seed
+    riders share one memoized diffusion, so the coalesced server
+    answers N concurrent requests with ~1 solve."""
+    import concurrent.futures as cf
+    import tempfile
+
+    from libskylark_tpu import serve
+    from libskylark_tpu.graph import SimpleGraph
+    from libskylark_tpu.graph.stream import (
+        adjacency_sketch_fold,
+        graph_block_source,
+        streamed_adjacency_sketch,
+    )
+    from libskylark_tpu.resilient import FaultPlan, SimulatedPreemption
+    from libskylark_tpu.sketch.hash import SJLT
+    from libskylark_tpu.streaming import ElasticParams, RowPartition
+    from libskylark_tpu.streaming.elastic import elastic_run_stream
+
+    n, m = (16384, 400_000) if on_tpu else (2048, 30_000)
+    if _SMOKE:
+        n, m = 256, 2_000
+    s = 128
+    rng = np.random.default_rng(23)
+    G = SimpleGraph(map(tuple, rng.integers(0, n, (m, 2)).tolist()))
+    E = G.volume // 2
+    S = SJLT(G.n, s, SketchContext(seed=23))
+    src = graph_block_source(G, batch_edges=max(E, 1))
+
+    def streamed():
+        return streamed_adjacency_sketch(src, S, ncols=G.n)
+
+    def dense():
+        return S.apply(jnp.asarray(G.adjacency()), "columnwise")
+
+    _timed(streamed), _timed(dense)  # compile both routes
+    reps = 1 if _SMOKE else 3
+    t_st = min(_timed(streamed) for _ in range(reps))
+    t_dn = min(_timed(dense) for _ in range(reps))
+    _emit(
+        f"graph streamed sketch ({E} edges, n={G.n}, s={s})",
+        E / t_st, "edges/s", t_dn / t_st, table, contention=None,
+    )
+
+    # (b) kill -> first post-resume fold, world=1 edge partition.
+    br = max(E // 16, 1)
+    init_at, step = adjacency_sketch_fold(S, G.n)
+    part = RowPartition(nrows=E, batch_rows=br, world_size=1)
+    first_fold: list[float] = []
+
+    def timed_step(acc, block, index):
+        out = step(acc, block, index)
+        if not first_fold:
+            jax.block_until_ready(out["sa"])
+            first_fold.append(time.perf_counter())
+        return out
+
+    fold_src = graph_block_source(G, batch_edges=br)
+    with tempfile.TemporaryDirectory() as root:
+        try:
+            elastic_run_stream(
+                fold_src, timed_step, init_at(0), part,
+                ElasticParams(
+                    checkpoint_dir=root, checkpoint_every=1, prefetch=0
+                ),
+                fault_plan=FaultPlan(preempt_after_chunk=3),
+            )
+            raise RuntimeError("preemption never fired")
+        except SimulatedPreemption:
+            t_kill = time.perf_counter()
+        first_fold.clear()
+        elastic_run_stream(
+            fold_src, timed_step, init_at(0), part,
+            ElasticParams(
+                checkpoint_dir=root, checkpoint_every=1, prefetch=0,
+                resume=True,
+            ),
+        )
+    _emit(
+        f"graph ASE resume kill-to-first-fold ({E} edges)",
+        first_fold[0] - t_kill, "s", 1.0, table, contention=None,
+    )
+
+    # (c) served PPR QPS: coalesced vs serial, fresh same-seed servers.
+    total = 16 if _SMOKE else 96
+    workers = 16
+    Gq = SimpleGraph(
+        map(tuple, rng.integers(0, 256, (2_000, 2)).tolist())
+    )
+
+    def drive(max_coalesce):
+        srv = serve.Server(
+            serve.ServeParams(
+                max_coalesce=max_coalesce, max_queue=4 * total,
+                warm_start=False,
+            ),
+            seed=23,
+        )
+        srv.register_graph("g", Gq, k=8)
+        srv.start()
+
+        def one(i):
+            r = srv.call(op="ppr", graph="g", seeds=[i % 8])
+            if not r["ok"]:
+                raise RuntimeError(r["error"]["message"])
+
+        with cf.ThreadPoolExecutor(max_workers=workers) as pool:
+            list(pool.map(one, range(8)))  # warm the memo per seed
+            t0 = time.perf_counter()
+            list(pool.map(one, range(total)))
+            wall = time.perf_counter() - t0
+        srv.stop()
+        return total / wall
+
+    qps_s = drive(1)
+    qps_c = drive(32)
+    _emit("serve graph PPR serial QPS", qps_s, "req/s", 1.0, table,
+          contention=None)
+    _emit("serve graph PPR coalesced QPS", qps_c, "req/s", qps_c / qps_s,
+          table, contention=None)
+
+
 _FINAL: dict | None = None
 _FINAL_PRINTED = False
 
@@ -2005,7 +2135,10 @@ def main() -> None:
     # FJLT f32 row also moves up — it is the round-5 fused-kernel
     # measurement).  Rows with round-2/3 captures queue behind them.
     secondaries = [
-        # Round-14 rows lead (never captured): the certified
+        # Round-15 row leads (never captured): streamed graph sketching
+        # + elastic ASE resume + served PPR QPS (docs/graph.md).
+        ("graph analytics", 60, lambda: bench_graph(on_tpu, table)),
+        # Round-14 rows next (never captured): the certified
         # mixed-precision refine solve (docs/performance.md) and the
         # served cond-est endpoint (docs/serving.md).
         ("refine solve", 60, lambda: bench_refine(on_tpu, table)),

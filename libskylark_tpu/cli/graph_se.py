@@ -18,6 +18,12 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=38734)
     p.add_argument("--num-iterations", "-i", type=int, default=2)
     p.add_argument("--sparse", action="store_true")
+    p.add_argument(
+        "--streamed", action="store_true",
+        help="one-pass streamed Nystrom ASE: folds edge blocks, never "
+        "materializes the adjacency (forces --num-iterations 0)",
+    )
+    p.add_argument("--batch-edges", type=int, default=65536)
     p.add_argument("--prefix", default="embedding")
     args = p.parse_args(argv)
 
@@ -26,11 +32,19 @@ def main(argv=None) -> int:
 
     G = read_arc_list(args.graphfile)
     print(f"Read graph: {G.n} vertices, {G.volume // 2} edges")
+    if args.streamed:
+        params = ASEParams(
+            num_iterations=0, streamed=True, batch_edges=args.batch_edges
+        )
+    else:
+        params = ASEParams(
+            num_iterations=args.num_iterations, sparse=args.sparse
+        )
     X, lam = approximate_ase(
         G,
         args.rank,
         SketchContext(seed=args.seed),
-        ASEParams(num_iterations=args.num_iterations, sparse=args.sparse),
+        params,
     )
     np.save(f"{args.prefix}.X.npy", np.asarray(X))
     with open(f"{args.prefix}.index.txt", "w") as f:

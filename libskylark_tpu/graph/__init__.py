@@ -4,6 +4,15 @@ embedding and seed-set local community detection."""
 from .ase import ASEParams, approximate_ase
 from .community import find_local_cluster, time_dependent_ppr
 from .graph import SimpleGraph, read_arc_list
+from .stream import (
+    adjacency_sketch_fold,
+    ase_from_sketch,
+    chained_adjacency_sketch,
+    graph_block_source,
+    incore_adjacency_sketch,
+    streamed_adjacency_sketch,
+    streaming_ase,
+)
 
 __all__ = [
     "SimpleGraph",
@@ -12,4 +21,11 @@ __all__ = [
     "approximate_ase",
     "time_dependent_ppr",
     "find_local_cluster",
+    "graph_block_source",
+    "adjacency_sketch_fold",
+    "incore_adjacency_sketch",
+    "streamed_adjacency_sketch",
+    "chained_adjacency_sketch",
+    "ase_from_sketch",
+    "streaming_ase",
 ]

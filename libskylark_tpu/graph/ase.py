@@ -22,9 +22,18 @@ __all__ = ["ASEParams", "approximate_ase"]
 @dataclass
 class ASEParams(SVDParams):
     """≙ ``approximate_ase_params_t`` (inherits the SVD oversampling/
-    iteration knobs)."""
+    iteration knobs).
+
+    ``streamed=True`` routes a ``SimpleGraph`` through the one-pass
+    streaming eigensolve (``graph.stream.streaming_ase``): the adjacency
+    is never materialized — edge blocks of ``batch_edges`` undirected
+    edges fold into ``Ω·A`` and the embedding follows from replicated
+    small math.  One-pass, so it requires ``num_iterations == 0``.
+    """
 
     sparse: bool = False  # use BCOO adjacency
+    streamed: bool = False  # fold edge blocks; never build A
+    batch_edges: int = 65536  # undirected edges per streamed block
 
 
 def approximate_ase(
@@ -38,6 +47,13 @@ def approximate_ase(
     ``G`` may be a ``SimpleGraph`` or an (n, n) adjacency array/BCOO.
     """
     params = params or ASEParams()
+    if isinstance(G, SimpleGraph) and params.streamed:
+        from .stream import graph_block_source, streaming_ase
+
+        return streaming_ase(
+            graph_block_source(G, batch_edges=params.batch_edges),
+            G.n, k, context, params,
+        )
     if isinstance(G, SimpleGraph):
         A = G.adjacency_bcoo() if params.sparse else jnp.asarray(G.adjacency())
     else:

@@ -4,6 +4,13 @@
 arc-list reader (``utility/io``): text lines ``u v`` (comments ``#``/``%``),
 symmetrized, self-loops dropped, duplicate edges collapsed.  Vertex names
 may be arbitrary hashables; ``index`` maps name → contiguous id.
+
+The constructor is vectorized: interning runs through one C-speed
+``dict.fromkeys`` pass (first-seen order, scanning ``u`` then ``v`` per
+edge — identical to the original per-edge loop), and symmetrization /
+dedup / CSR assembly are numpy ``unique``/``lexsort``/``bincount`` calls,
+so building a multi-hundred-thousand-edge graph costs milliseconds of
+interpreter time instead of seconds.
 """
 
 from __future__ import annotations
@@ -16,31 +23,35 @@ __all__ = ["SimpleGraph", "read_arc_list"]
 class SimpleGraph:
     def __init__(self, edges):
         """edges: iterable of (u, v) pairs (strings or ints)."""
-        names = {}
-        pairs = set()
-        for u, v in edges:
-            if u == v:
-                continue
-            for w in (u, v):
-                if w not in names:
-                    names[w] = len(names)
-            a, b = names[u], names[v]
-            pairs.add((min(a, b), max(a, b)))
+        # Self-loops drop before interning: a vertex appearing only in
+        # self-loops gets no id (pinned by tests).
+        pairs = [(u, v) for u, v in edges if u != v]
+        flat = [w for pair in pairs for w in pair]
+        # dict.fromkeys dedups in insertion order in one C call.
+        names = {w: i for i, w in enumerate(dict.fromkeys(flat))}
         self.vertices = list(names)
         self.index = names
         n = len(names)
-        rows = np.empty(2 * len(pairs), dtype=np.int64)
-        cols = np.empty(2 * len(pairs), dtype=np.int64)
-        for i, (a, b) in enumerate(pairs):
-            rows[2 * i], cols[2 * i] = a, b
-            rows[2 * i + 1], cols[2 * i + 1] = b, a
+        self.n = n
+        if not pairs:
+            self.indptr = np.zeros(n + 1, dtype=np.int64)
+            self.indices = np.empty(0, dtype=np.int64)
+            return
+        ids = np.fromiter(
+            (names[w] for w in flat), dtype=np.int64, count=len(flat)
+        ).reshape(-1, 2)
+        lo = ids.min(axis=1)
+        hi = ids.max(axis=1)
+        und = np.unique(np.stack([lo, hi], axis=1), axis=0)
+        rows = np.concatenate([und[:, 0], und[:, 1]])
+        cols = np.concatenate([und[:, 1], und[:, 0]])
         order = np.lexsort((cols, rows))
         rows, cols = rows[order], cols[order]
-        self.indptr = np.zeros(n + 1, dtype=np.int64)
-        np.add.at(self.indptr, rows + 1, 1)
-        self.indptr = np.cumsum(self.indptr)
+        counts = np.bincount(rows, minlength=n)
+        self.indptr = np.concatenate(
+            [np.zeros(1, dtype=np.int64), np.cumsum(counts, dtype=np.int64)]
+        )
         self.indices = cols
-        self.n = n
 
     # -- accessors (≙ the GraphType concept used by the algorithms) ---------
 
@@ -82,14 +93,19 @@ class SimpleGraph:
 
 
 def read_arc_list(path) -> SimpleGraph:
-    edges = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line or line[0] in "#%":
-                continue
-            parts = line.split()
-            if len(parts) < 2:
-                continue
-            edges.append((parts[0], parts[1]))
+    """Build a :class:`SimpleGraph` from an arc list.
+
+    Accepts anything ``io.open_source`` does: a local path, ``file://``
+    or fsspec URL, raw bytes, or a ``ByteSource``.  For graphs too large
+    to hold, use ``io.stream_arc_list`` and the streamed sketch path
+    (``graph.stream``) instead.
+    """
+    from ..io.arclist import _chunk_lines, _parse_edge_block
+    from ..io.source import open_source
+
+    src = open_source(path)
+    edges: list[tuple[str, str]] = []
+    for block in _chunk_lines(src, 8 << 20):
+        us, vs = _parse_edge_block(block)
+        edges.extend(zip(us, vs))
     return SimpleGraph(edges)

@@ -1,6 +1,7 @@
 """Dataset IO (≙ reference ``ml/io.hpp``, ``utility/io/libsvm_io.hpp``;
 byte-source seam ≙ the HDFS reader variants at ``libsvm_io.hpp:1495-1638``)."""
 
+from .arclist import arc_list_source, scan_arc_list, stream_arc_list
 from .hdf5 import read_hdf5, stream_hdf5, write_hdf5
 from .libsvm import read_libsvm, scan_libsvm_dims, stream_libsvm, write_libsvm
 from .source import (
@@ -20,6 +21,9 @@ __all__ = [
     "read_hdf5",
     "write_hdf5",
     "stream_hdf5",
+    "scan_arc_list",
+    "stream_arc_list",
+    "arc_list_source",
     "ByteSource",
     "LocalSource",
     "MemorySource",

@@ -373,7 +373,7 @@ def rowwise_sharded(S, A, mesh: Mesh):
     def local(a):
         return S.apply(a, Dimension.ROWWISE)
 
-    return jax.shard_map(
+    return _shard_map_fn()(
         local,
         mesh=mesh,
         in_specs=P(axes, None),
@@ -466,7 +466,7 @@ def columnwise_sharded(S: DenseSketch, A, mesh: Mesh, scatter: bool = False):
         return jax.lax.psum(partial_out, axes)
 
     out_spec = P(axes, None) if scatter else P(None, None)
-    return jax.shard_map(
+    return _shard_map_fn()(
         local, mesh=mesh, in_specs=P(axes, None), out_specs=out_spec
     )(A)
 
@@ -577,7 +577,7 @@ def _columnwise_sparse_program(S, m: int, block: int, mesh: Mesh,
         return jax.lax.psum(out, axes)
 
     out_spec = P(axes, None) if scatter else P(None, None)
-    return jax.shard_map(
+    return _shard_map_fn()(
         local,
         mesh=mesh,
         in_specs=(P(axes, None), P(axes, None), P(axes, None)),
@@ -680,7 +680,7 @@ def _columnwise_sparse_2d_program(S, rblock: int, cblock: int, mesh: Mesh):
         out = acc.reshape(S.s, cblock)
         return jax.lax.psum(out, ax_r)
 
-    return jax.shard_map(
+    return _shard_map_fn()(
         local,
         mesh=mesh,
         in_specs=(
@@ -728,7 +728,7 @@ def _rowwise_sparse_program(S, block: int, mesh: Mesh):
             ).astype(dtype)
         return acc.reshape(block, S.s)
 
-    return jax.shard_map(
+    return _shard_map_fn()(
         local,
         mesh=mesh,
         in_specs=(P(axes, None), P(axes, None), P(axes, None)),
@@ -1035,7 +1035,7 @@ def _columnwise_sparse_out_program(S, block: int, out_block: int, cap: int,
             rc.reshape(flat),
         )
 
-    return jax.shard_map(
+    return _shard_map_fn()(
         local,
         mesh=mesh,
         in_specs=(P(axes, None), P(axes, None), P(axes, None)),
@@ -1121,7 +1121,7 @@ def _columnwise_sparse_out_2d_program(S, rblock: int, out_rblock: int,
             rc.reshape(flat),
         )
 
-    return jax.shard_map(
+    return _shard_map_fn()(
         local,
         mesh=mesh,
         in_specs=(
@@ -1178,7 +1178,7 @@ def _rowwise_sparse_out_program(S, mesh: Mesh):
             jnp.concatenate(cols).reshape(flat),
         )
 
-    return jax.shard_map(
+    return _shard_map_fn()(
         local,
         mesh=mesh,
         in_specs=(P(axes, None), P(axes, None), P(axes, None)),

@@ -214,10 +214,36 @@ def _execute_cond_est(registry, entries, device=None):
     return [dict(rep) for _ in entries], len(entries)
 
 
+def _execute_ppr(registry, entries, device=None):
+    """Served PPR: each rider's canonical seed-set payload resolves
+    through ``GraphSystem.ppr_report`` — memoized, so coalesce-mates
+    (and repeat queries) with the same seed set share ONE active-support
+    diffusion, the graph analogue of the cached cond-est probe.  The
+    fan-out is a dict copy per rider, which is what makes coalesced ≡
+    solo trivially bitwise."""
+    gsys = registry.get_graph(entries[0].request["graph"])
+    return [dict(gsys.ppr_report(e.payload)) for e in entries], len(entries)
+
+
+def _execute_ase_embed(registry, entries, device=None):
+    """Served embedding queries against the resident ASE matrix: row
+    lookup (``"rows"`` payloads) or out-of-sample neighbor projection
+    (``"oos"``).  Pure host-array indexing per rider — per-slot purity
+    is structural, no padding or tile discipline involved."""
+    gsys = registry.get_graph(entries[0].request["graph"])
+    outs = []
+    for e in entries:
+        mode, idx = e.payload
+        outs.append(gsys.rows(idx) if mode == "rows" else gsys.project(idx))
+    return outs, len(entries)
+
+
 _EXECUTORS = {
     "ls_solve": _execute_ls,
     "cond_est": _execute_cond_est,
     "predict": _execute_predict,
+    "ppr": _execute_ppr,
+    "ase_embed": _execute_ase_embed,
 }
 
 
@@ -240,7 +266,11 @@ def _decode(entry, out):
         classes = entry.request.get("_classes")
         idx = np.argmax(out, axis=-1)
         out = np.asarray(classes)[idx] if classes is not None else idx
-    if entry.squeeze and getattr(out, "ndim", 0) > 0 and entry.op == "predict":
+    if (
+        entry.squeeze
+        and getattr(out, "ndim", 0) > 0
+        and entry.op in ("predict", "ase_embed")
+    ):
         out = out[0]
     return out
 

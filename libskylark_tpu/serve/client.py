@@ -145,6 +145,18 @@ class Client:
             check,
         )
 
+    def ppr(self, graph: str, seeds, *, check: bool = False, **fields):
+        return self._unwrap(
+            self.call(op="ppr", graph=graph, seeds=seeds, **fields), check
+        )
+
+    def ase_embed(self, graph: str, *, check: bool = False, **fields):
+        """Embedding queries: pass ``ids=`` for row lookups or
+        ``neighbors=`` for an out-of-sample projection (exactly one)."""
+        return self._unwrap(
+            self.call(op="ase_embed", graph=graph, **fields), check
+        )
+
     def ping(self) -> bool:
         return bool(self.call(op="ping").get("ok"))
 

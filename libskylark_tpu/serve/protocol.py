@@ -10,7 +10,8 @@ marshalling.
 Request schema::
 
     {"id": str|int,            # caller-chosen correlation id (optional)
-     "op": "ls_solve" | "cond_est" | "predict" | "ping" | "stats",
+     "op": "ls_solve" | "cond_est" | "predict" | "ppr" | "ase_embed"
+           | "ping" | "stats",
      # ls_solve:
      "system": str,            # registered system name
      "b": [float, ...],        # RHS, length m
@@ -24,6 +25,15 @@ Request schema::
      "model": str,             # registered model name
      "x": [..] | [[..], ..],   # one row (d,) or a block (r, d)
      "labels": bool,           # decode through the model's classes
+     # ppr: {"graph": str, "seeds": [id|name, ...],
+     #       "alpha"/"gamma"/"epsilon": float (optional)} — result is
+     # the memoized seed-set community report {graph, seeds, cluster,
+     # conductance, alpha, gamma, epsilon}; same-seed riders share one
+     # active-support diffusion
+     # ase_embed: {"graph": str} plus EXACTLY ONE of
+     #   "ids": id|name|[...]       — embedding row lookup
+     #   "neighbors": [id|name,...] — out-of-sample projection from a
+     #                                new vertex's neighbor list
      # either:
      "deadline_ms": float}     # shed if not dispatched in time
 
@@ -65,7 +75,8 @@ __all__ = [
     "raise_for_error",
 ]
 
-OPS = ("ls_solve", "cond_est", "predict", "ping", "stats")
+OPS = ("ls_solve", "cond_est", "predict", "ppr", "ase_embed",
+       "ping", "stats")
 
 
 def placement_key(request: dict) -> str:
@@ -85,6 +96,10 @@ def placement_key(request: dict) -> str:
             f"predict:{request.get('model')}"
             f":{np.dtype(request.get('dtype', 'float64')).name}"
         )
+    if op == "ppr":
+        return f"ppr:{request.get('graph')}"
+    if op == "ase_embed":
+        return f"ase:{request.get('graph')}"
     return str(op)
 
 # code -> exception class, for client-side re-raising (raise_for_error)

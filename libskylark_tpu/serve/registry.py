@@ -27,7 +27,7 @@ from ..core.context import SketchContext
 from ..sketch import base as sketch_base
 from ..utils.exceptions import InvalidParameters
 
-__all__ = ["LSSystem", "Registry"]
+__all__ = ["GraphSystem", "LSSystem", "Registry"]
 
 
 class LSSystem:
@@ -94,10 +94,112 @@ class LSSystem:
         return rep
 
 
+class GraphSystem:
+    """A registered graph with its ASE embedding resident.
+
+    The heavy work — the randomized symmetric eigensolve behind
+    ``approximate_ase`` — runs ONCE at registration; every served query
+    afterwards is a host-array lookup (``ase_embed``) or a memoized
+    active-support diffusion (``ppr``).  The embedding is kept as host
+    numpy: graph queries are small-row traffic, and pinning them off
+    device keeps the chips free for the sketch executors.
+    """
+
+    def __init__(self, name: str, G, *, k: int = 8, context=None,
+                 params=None):
+        from ..graph.ase import ASEParams, approximate_ase
+        from ..graph.graph import SimpleGraph
+
+        if not isinstance(G, SimpleGraph):
+            raise InvalidParameters(
+                f"graph {name!r}: register a SimpleGraph, got "
+                f"{type(G).__name__}"
+            )
+        if not (1 <= int(k) <= max(G.n, 1)):
+            raise InvalidParameters(
+                f"graph {name!r}: embedding rank {k} outside [1, {G.n}]"
+            )
+        self.name = name
+        self.G = G
+        self.k = int(k)
+        context = context if context is not None else SketchContext(
+            seed=0x5EED
+        )
+        params = params or ASEParams()
+        import numpy as np
+
+        X, lam = approximate_ase(G, self.k, context, params)
+        self.X = np.asarray(X)
+        self.lam = np.asarray(lam)
+        self._streamed = bool(getattr(params, "streamed", False))
+        self._ppr_reports: dict[tuple, dict] = {}
+
+    def describe(self) -> dict:
+        return {
+            "n": int(self.G.n),
+            "volume": int(self.G.volume),
+            "k": self.k,
+            "streamed": self._streamed,
+        }
+
+    def rows(self, idx) -> "np.ndarray":  # noqa: F821 — doc type
+        """Embedding rows for vertex ids (the ``ase_embed`` lookup)."""
+        return self.X[idx]
+
+    def project(self, neighbor_ids) -> "np.ndarray":  # noqa: F821
+        """Out-of-sample projection from a neighbor id list.
+
+        For ``A = V Λ Vᵀ`` and a new vertex whose adjacency row is
+        ``a``, the ASE position is ``x̂_c = (Σ_{j∈nb} X[j,c]) / λ_c``
+        — for an existing vertex's own neighbor list this reproduces
+        its embedding row exactly (``a_i·V = V[i,:]·Λ``).  Components
+        with |λ| at the spectral floor contribute zero rather than a
+        division blow-up.
+        """
+        import numpy as np
+
+        s = self.X[np.asarray(neighbor_ids, np.int64)].sum(axis=0)
+        floor = np.abs(self.lam).max(initial=0.0) * np.finfo(
+            self.lam.dtype
+        ).eps * max(self.G.n, 1)
+        safe = np.abs(self.lam) > floor
+        return np.divide(
+            s, self.lam, out=np.zeros_like(s), where=safe
+        )
+
+    def ppr_report(self, payload: tuple) -> dict:
+        """Seed-set PPR community report, memoized by the canonical
+        payload ``(sorted-unique seed ids, alpha, gamma, epsilon)`` the
+        server validated — coalesced riders with the same seed set share
+        one diffusion, mirroring ``LSSystem.cond_report``.  The solve is
+        ``find_local_cluster``'s active-support diffusion: work scales
+        with the cluster found, not with the graph held."""
+        rep = self._ppr_reports.get(payload)
+        if rep is None:
+            from ..graph.community import find_local_cluster
+
+            seeds, alpha, gamma, epsilon = payload
+            cluster, cond = find_local_cluster(
+                self.G, list(seeds),
+                alpha=alpha, gamma=gamma, epsilon=epsilon,
+            )
+            rep = self._ppr_reports[payload] = {
+                "graph": self.name,
+                "seeds": [int(v) for v in seeds],
+                "cluster": sorted(int(v) for v in cluster),
+                "conductance": float(cond),
+                "alpha": float(alpha),
+                "gamma": float(gamma),
+                "epsilon": float(epsilon),
+            }
+        return rep
+
+
 class Registry:
     def __init__(self):
         self.models: dict[str, object] = {}
         self.systems: dict[str, LSSystem] = {}
+        self.graphs: dict[str, GraphSystem] = {}
         # per-model jitted predict closures, built lazily by the batcher
         self.model_jits: dict[str, object] = {}
 
@@ -169,6 +271,33 @@ class Registry:
                 f"unknown system {name!r}; registered: {sorted(self.systems)}"
             ) from None
 
+    # -- graphs -------------------------------------------------------------
+
+    def register_graph(
+        self,
+        name: str,
+        G,
+        *,
+        k: int = 8,
+        context: SketchContext | None = None,
+        params=None,
+    ) -> GraphSystem:
+        """Register a graph: the ASE embedding is computed here, once
+        (``params.streamed=True`` folds edge blocks — the adjacency is
+        never materialized); ``ppr`` / ``ase_embed`` requests afterwards
+        serve from the resident embedding and the memoized diffusion."""
+        gsys = GraphSystem(name, G, k=k, context=context, params=params)
+        self.graphs[name] = gsys
+        return gsys
+
+    def get_graph(self, name: str) -> GraphSystem:
+        try:
+            return self.graphs[name]
+        except KeyError:
+            raise InvalidParameters(
+                f"unknown graph {name!r}; registered: {sorted(self.graphs)}"
+            ) from None
+
     def describe(self) -> dict:
         models = {}
         for name, model in self.models.items():
@@ -180,4 +309,5 @@ class Registry:
         return {
             "models": models,
             "systems": {k: s.describe() for k, s in self.systems.items()},
+            "graphs": {k: g.describe() for k, g in self.graphs.items()},
         }

@@ -139,6 +139,10 @@ class Server:
         kw.setdefault("context", self.ctx)
         return self.registry.register_system(name, A, **kw)
 
+    def register_graph(self, name, G, **kw):
+        kw.setdefault("context", self.ctx)
+        return self.registry.register_graph(name, G, **kw)
+
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> "Server":
@@ -225,6 +229,20 @@ class Server:
                     ]
                     batcher._execute_predict(self.registry, entries, dev)
             self.primed.append(f"model:{name}:{rungs}")
+        for name, gsys in self.registry.graphs.items():
+            # Graph queries serve from host arrays — nothing to compile;
+            # one executor pass makes the first request's path identical
+            # to every later one (and catches a broken embedding NOW).
+            if gsys.G.n:
+                entries = [
+                    Entry(
+                        {"op": "ase_embed", "graph": name}, Future(), None,
+                        "ase_embed",
+                        payload=("rows", np.zeros(1, np.int64)),
+                    )
+                ]
+                batcher._execute_ase_embed(self.registry, entries, None)
+            self.primed.append(f"graph:{name}:k={gsys.k}")
         return self.primed
 
     def stop(self, timeout: float = 10.0) -> None:
@@ -336,6 +354,7 @@ class Server:
         return {
             "models": sorted(d["models"]),
             "systems": sorted(d["systems"]),
+            "graphs": sorted(d["graphs"]),
         }
 
     def signature(self) -> int:
@@ -458,9 +477,86 @@ class Server:
             )
             entry.squeeze = squeeze
             return entry
+        if op == "ppr":
+            gsys = self.registry.get_graph(request.get("graph"))
+            seeds = request.get("seeds")
+            if not isinstance(seeds, (list, tuple)) or not seeds:
+                raise InvalidParameters(
+                    "ppr seeds must be a non-empty list of vertex "
+                    f"ids/names, got {seeds!r}"
+                )
+            ids = self._graph_ids(gsys, seeds, "ppr seeds")
+            # Canonical payload: the memo key in GraphSystem.ppr_report.
+            # Sorting/deduping HERE means riders with the same seed set
+            # in any order coalesce onto one diffusion.
+            payload = (
+                tuple(sorted(set(ids))),
+                float(request.get("alpha", 0.85)),
+                float(request.get("gamma", 5.0)),
+                float(request.get("epsilon", 0.001)),
+            )
+            return Entry(
+                request, fut, ("ppr", request["graph"]), op, payload=payload
+            )
+        if op == "ase_embed":
+            gsys = self.registry.get_graph(request.get("graph"))
+            has_ids = "ids" in request
+            has_nb = "neighbors" in request
+            if has_ids == has_nb:
+                raise InvalidParameters(
+                    "ase_embed takes exactly one of 'ids' (embedding row "
+                    "lookup) or 'neighbors' (out-of-sample projection)"
+                )
+            if has_ids:
+                items = request["ids"]
+                squeeze = not isinstance(items, (list, tuple))
+                if squeeze:
+                    items = [items]
+                idx = self._graph_ids(gsys, items, "ase_embed ids")
+                payload = ("rows", np.asarray(idx, np.int64))
+            else:
+                items = request["neighbors"]
+                squeeze = False
+                if not isinstance(items, (list, tuple)) or not items:
+                    raise InvalidParameters(
+                        "ase_embed neighbors must be a non-empty list of "
+                        f"vertex ids/names, got {items!r}"
+                    )
+                idx = self._graph_ids(gsys, items, "ase_embed neighbors")
+                payload = ("oos", np.asarray(idx, np.int64))
+            entry = Entry(
+                request, fut, ("ase", request["graph"]), op, payload=payload
+            )
+            entry.squeeze = squeeze
+            return entry
         raise InvalidParameters(
             f"unknown op {op!r}; supported: {list(protocol.OPS)}"
         )
+
+    @staticmethod
+    def _graph_ids(gsys, items, what: str) -> list:
+        """Resolve a seed/id list to vertex ids at the door: ints are
+        range-checked, anything else goes through the graph's name
+        index — so executors never see an unresolvable vertex."""
+        n = gsys.G.n
+        ids = []
+        for v in items:
+            if isinstance(v, (int, np.integer)) and not isinstance(v, bool):
+                i = int(v)
+                if not (0 <= i < n):
+                    raise InvalidParameters(
+                        f"{what}: vertex id {i} outside [0, {n})"
+                    )
+            else:
+                try:
+                    i = gsys.G.index[v]
+                except (KeyError, TypeError):
+                    raise InvalidParameters(
+                        f"{what}: unknown vertex {v!r} in graph "
+                        f"{gsys.name!r}"
+                    ) from None
+            ids.append(i)
+        return ids
 
     def _on_admit(self, entry: Entry) -> None:
         """Admission-ordered side effects, under the queue lock: the

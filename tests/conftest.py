@@ -76,6 +76,10 @@ FLEET_TIMEOUT_S = 120
 # stagnation/ladder fallback, policy earning); a sweep loop that never
 # meets its gate must not stall the tier-1 run.
 REFINE_TIMEOUT_S = 120
+# Graph tests fold streamed edge blocks through elastic runs and drive
+# served PPR/embed queries behind the worker thread; a wedged fold or
+# an unresolved future must not stall the tier-1 run.
+GRAPH_TIMEOUT_S = 120
 
 _TIMEOUT_MARKS = {
     "faults": FAULTS_TIMEOUT_S,
@@ -91,6 +95,7 @@ _TIMEOUT_MARKS = {
     "trace": TRACE_TIMEOUT_S,
     "fleet": FLEET_TIMEOUT_S,
     "refine": REFINE_TIMEOUT_S,
+    "graph": GRAPH_TIMEOUT_S,
 }
 
 
@@ -183,6 +188,12 @@ def pytest_configure(config):
         "bitwise parity, certified convergence, stagnation/ladder "
         "fallback, served cond-est, quasirandom sketch interchange); "
         f"tier-1, guarded by a per-test {REFINE_TIMEOUT_S}s timeout",
+    )
+    config.addinivalue_line(
+        "markers",
+        "graph: graph-analytics tests (streamed edge-list folds, chained "
+        "sharded sketches, streaming ASE, served PPR/embed queries); "
+        f"tier-1, guarded by a per-test {GRAPH_TIMEOUT_S}s timeout",
     )
 
 

@@ -17,6 +17,8 @@ from libskylark_tpu.graph import (
 from libskylark_tpu.io import read_hdf5, write_hdf5
 from libskylark_tpu.linalg.spectral import chebyshev_diff_matrix, chebyshev_points
 
+pytestmark = pytest.mark.graph
+
 
 def two_community_graph(rng, n_per=30, p_in=0.5, p_out=0.02):
     n = 2 * n_per

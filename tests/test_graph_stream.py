@@ -1,0 +1,394 @@
+"""Streamed graph sketching tests: edge-block folds are BITWISE equal
+to the in-core BCOO apply (the dyadic-exactness contract of
+``graph/stream.py``), across block sizes, simulated rank partitions,
+kill-resume, and the chained sharded schedule; ``stream_arc_list``
+matches ``SimpleGraph`` edge-for-edge on messy files; served PPR/embed
+queries coalesce without changing a bit."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from libskylark_tpu import SketchContext
+from libskylark_tpu.graph import (
+    ASEParams,
+    SimpleGraph,
+    approximate_ase,
+    chained_adjacency_sketch,
+    graph_block_source,
+    incore_adjacency_sketch,
+    streamed_adjacency_sketch,
+    streaming_ase,
+)
+from libskylark_tpu.io import arc_list_source, scan_arc_list, stream_arc_list
+from libskylark_tpu.sketch import CWT, SJLT
+from libskylark_tpu.utils.exceptions import InvalidParameters
+
+pytestmark = pytest.mark.graph
+
+
+def random_graph(rng, n=64, m=400):
+    e = rng.integers(0, n, (m, 2))
+    return SimpleGraph(map(tuple, e.tolist()))
+
+
+def edges_of(G):
+    """Canonical undirected (lo, hi) pairs, CSR order."""
+    rows = np.repeat(np.arange(G.n), G.degrees)
+    keep = rows < G.indices
+    return np.stack([rows[keep], G.indices[keep]], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# streamed fold ≡ in-core apply (bitwise)
+# ---------------------------------------------------------------------------
+
+
+class TestStreamedSketch:
+    @pytest.mark.parametrize("Skind", [CWT, SJLT])
+    @pytest.mark.parametrize("batch_edges", [7, 64, 10_000])
+    def test_streamed_equals_incore_bitwise(self, rng, Skind, batch_edges):
+        G = random_graph(rng)
+        S = Skind(G.n, 24, SketchContext(seed=1))
+        want = np.asarray(incore_adjacency_sketch(G, S))
+        got = np.asarray(
+            streamed_adjacency_sketch(
+                graph_block_source(G, batch_edges=batch_edges),
+                S, ncols=G.n,
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_elastic_world1_partition_route(self, rng):
+        from libskylark_tpu.streaming.elastic import (
+            ElasticParams,
+            RowPartition,
+        )
+
+        G = random_graph(rng)
+        E = G.volume // 2
+        S = SJLT(G.n, 24, SketchContext(seed=2))
+        part = RowPartition(nrows=E, batch_rows=50, world_size=1)
+        got = np.asarray(
+            streamed_adjacency_sketch(
+                graph_block_source(G, batch_edges=50), S, ncols=G.n,
+                partition=part, params=ElasticParams(),
+            )
+        )
+        want = np.asarray(incore_adjacency_sketch(G, S))
+        np.testing.assert_array_equal(got, want)
+
+    def test_two_rank_simulated_merge(self, rng):
+        """Rank partials folded independently psum to the in-core bits
+        (simulated world: ElasticParams(rank=, world_size=2) drives the
+        fold directly; the merge is an explicit sum)."""
+        from libskylark_tpu.graph.stream import adjacency_sketch_fold
+        from libskylark_tpu.streaming.elastic import (
+            ElasticParams,
+            RowPartition,
+            elastic_run_stream,
+        )
+
+        G = random_graph(rng)
+        E = G.volume // 2
+        S = CWT(G.n, 16, SketchContext(seed=3))
+        init_at, step = adjacency_sketch_fold(S, G.n)
+        part = RowPartition(nrows=E, batch_rows=37, world_size=2)
+        parts = []
+        for r in range(2):
+            e0, e1 = part.row_range(r)
+            acc, _ = elastic_run_stream(
+                graph_block_source(G, batch_edges=37), step, init_at(e0),
+                part, ElasticParams(rank=r, world_size=2),
+                kind="graph_distributed_sketch",
+            )
+            assert int(acc["edge"]) == e1  # partition end-check holds
+            parts.append(np.asarray(acc["sa"]))
+        merged = parts[0] + parts[1]
+        want = np.asarray(incore_adjacency_sketch(G, S))
+        np.testing.assert_array_equal(merged, want)
+
+    @pytest.mark.faults
+    def test_kill_resume_bitwise(self, rng, tmp_path):
+        from libskylark_tpu.resilient import FaultPlan, SimulatedPreemption
+        from libskylark_tpu.streaming import StreamParams
+
+        G = random_graph(rng)
+        S = SJLT(G.n, 24, SketchContext(seed=4))
+        src = graph_block_source(G, batch_edges=30)
+        want = np.asarray(
+            streamed_adjacency_sketch(src, S, ncols=G.n)
+        )
+        ck = str(tmp_path / "ck")
+        with pytest.raises(SimulatedPreemption):
+            streamed_adjacency_sketch(
+                src, S, ncols=G.n,
+                params=StreamParams(checkpoint_dir=ck, checkpoint_every=2),
+                fault_plan=FaultPlan(preempt_after_chunk=1),
+            )
+        got = np.asarray(
+            streamed_adjacency_sketch(
+                src, S, ncols=G.n,
+                params=StreamParams(
+                    checkpoint_dir=ck, checkpoint_every=2, resume=True
+                ),
+            )
+        )
+        np.testing.assert_array_equal(got, want)
+
+    def test_chained_sharded_equals_streamed_chain(self, rng):
+        """S₂·(S₁·A) through the sharded sparse-out schedule ≡ the
+        streamed-fold chain, bitwise."""
+        G = random_graph(rng)
+        ctx = SketchContext(seed=5)
+        S1 = CWT(G.n, 16, ctx)
+        S2 = CWT(16, 8, ctx)
+        incore = np.asarray(chained_adjacency_sketch(G, S1, S2))
+        streamed = np.asarray(
+            chained_adjacency_sketch(G, S1, S2, streamed=True,
+                                     batch_edges=23)
+        )
+        np.testing.assert_array_equal(streamed, incore)
+
+    def test_chained_size_mismatch_rejected(self, rng):
+        G = random_graph(rng, n=16, m=40)
+        ctx = SketchContext(seed=6)
+        with pytest.raises(InvalidParameters, match="S2.n == S1.s"):
+            chained_adjacency_sketch(G, CWT(G.n, 8, ctx), CWT(12, 4, ctx))
+
+    def test_non_hash_sketch_rejected(self, rng):
+        from libskylark_tpu.graph.stream import adjacency_sketch_fold
+        from libskylark_tpu.sketch import JLT
+
+        with pytest.raises(InvalidParameters, match="hash sketch"):
+            adjacency_sketch_fold(JLT(32, 8, SketchContext(seed=7)), 32)
+
+
+# ---------------------------------------------------------------------------
+# stream_arc_list (file → blocks)
+# ---------------------------------------------------------------------------
+
+
+class TestStreamArcList:
+    def test_matches_simple_graph_on_messy_file(self, tmp_path):
+        """Comments, duplicates, reversed duplicates, self-loops, extra
+        columns, and a torn last line: the streamed blocks hold exactly
+        SimpleGraph's edge set, ids from the same first-seen interning."""
+        text = (
+            "# comment\n"
+            "% another\n"
+            "a b\n"
+            "b c 3.5\n"
+            "a b\n"        # duplicate
+            "b a\n"        # reversed duplicate
+            "c c\n"        # self-loop, dropped by name
+            "\n"
+            "d\n"          # short line, skipped
+            "c d"          # torn last line: no trailing newline
+        )
+        (tmp_path / "g").write_text(text)
+        G = SimpleGraph([("a", "b"), ("b", "c"), ("c", "d")])
+        index, E = scan_arc_list(tmp_path / "g")
+        assert E == 3
+        assert index == G.index
+        blocks = list(stream_arc_list(tmp_path / "g", index=index))
+        rows = np.concatenate([b["rows"] for b in blocks])
+        cols = np.concatenate([b["cols"] for b in blocks])
+        assert rows.size == 2 * E
+        got = {(int(min(u, v)), int(max(u, v))) for u, v in zip(rows, cols)}
+        assert got == {tuple(e) for e in edges_of(G).tolist()}
+
+    @pytest.mark.parametrize("chunk_bytes", [7, 64, 1 << 20])
+    def test_blocks_independent_of_chunk_bytes(self, tmp_path, rng,
+                                               chunk_bytes):
+        lines = [
+            f"{rng.integers(0, 40)} {rng.integers(0, 40)}"
+            for _ in range(300)
+        ]
+        (tmp_path / "g").write_text("\n".join(lines) + "\n")
+        ref = list(stream_arc_list(tmp_path / "g", batch_edges=17))
+        got = list(
+            stream_arc_list(
+                tmp_path / "g", batch_edges=17, chunk_bytes=chunk_bytes
+            )
+        )
+        assert len(got) == len(ref)
+        for a, b in zip(got, ref):
+            np.testing.assert_array_equal(a["rows"], b["rows"])
+            np.testing.assert_array_equal(a["cols"], b["cols"])
+            np.testing.assert_array_equal(a["vals"], b["vals"])
+
+    def test_fixed_block_sizes(self, tmp_path):
+        lines = [f"{i} {i + 1}" for i in range(10)]
+        (tmp_path / "g").write_text("\n".join(lines) + "\n")
+        blocks = list(stream_arc_list(tmp_path / "g", batch_edges=4))
+        assert [b["rows"].size // 2 for b in blocks] == [4, 4, 2]
+
+    def test_streamed_file_sketch_equals_incore(self, tmp_path, rng):
+        """End-to-end: file → arc_list_source → fold ≡ SimpleGraph →
+        BCOO apply, bitwise."""
+        e = rng.integers(0, 48, (250, 2))
+        (tmp_path / "g").write_text(
+            "".join(f"{u} {v}\n" for u, v in e.tolist())
+        )
+        G = SimpleGraph(map(tuple, e.tolist()))
+        index, E = scan_arc_list(tmp_path / "g")
+        assert E == G.volume // 2
+        S = SJLT(G.n, 24, SketchContext(seed=8))
+        got = np.asarray(
+            streamed_adjacency_sketch(
+                arc_list_source(tmp_path / "g", index=index, batch_edges=31),
+                S, ncols=G.n,
+            )
+        )
+        want = np.asarray(incore_adjacency_sketch(G, S))
+        np.testing.assert_array_equal(got, want)
+
+
+# ---------------------------------------------------------------------------
+# streaming ASE
+# ---------------------------------------------------------------------------
+
+
+class TestStreamingASE:
+    def test_exact_on_low_rank_graph(self):
+        """K_{10,14} has rank-2 adjacency with eigenvalues ±√140: the
+        one-pass Nyström route recovers spectrum and reconstruction to
+        fp accuracy once s ≥ rank."""
+        G = SimpleGraph(
+            [(f"l{i}", f"r{j}") for i in range(10) for j in range(14)]
+        )
+        X, lam = streaming_ase(
+            graph_block_source(G, batch_edges=13), G.n, 2,
+            SketchContext(seed=9),
+        )
+        lam = np.asarray(lam)
+        np.testing.assert_allclose(
+            np.sort(lam), [-np.sqrt(140), np.sqrt(140)], rtol=1e-10
+        )
+        X = np.asarray(X)
+        A_hat = (X * np.sign(lam)[None, :]) @ X.T
+        np.testing.assert_allclose(A_hat, G.adjacency(), atol=1e-6)
+
+    def test_ase_params_streamed_routes_bitwise(self, rng):
+        G = random_graph(rng, n=40, m=150)
+        X1, lam1 = approximate_ase(
+            G, 3, SketchContext(seed=10),
+            ASEParams(num_iterations=0, streamed=True, batch_edges=29),
+        )
+        X2, lam2 = streaming_ase(
+            graph_block_source(G, batch_edges=29), G.n, 3,
+            SketchContext(seed=10),
+        )
+        np.testing.assert_array_equal(np.asarray(X1), np.asarray(X2))
+        np.testing.assert_array_equal(np.asarray(lam1), np.asarray(lam2))
+
+    def test_streamed_independent_of_block_size(self, rng):
+        G = random_graph(rng, n=40, m=150)
+        outs = [
+            np.asarray(
+                streaming_ase(
+                    graph_block_source(G, batch_edges=be), G.n, 3,
+                    SketchContext(seed=11),
+                )[0]
+            )
+            for be in (11, 150)
+        ]
+        np.testing.assert_array_equal(outs[0], outs[1])
+
+    def test_subspace_iteration_rejected(self, rng):
+        from libskylark_tpu.linalg.svd import SVDParams
+
+        G = random_graph(rng, n=20, m=60)
+        with pytest.raises(InvalidParameters, match="one-pass"):
+            streaming_ase(
+                graph_block_source(G), G.n, 2, SketchContext(seed=12),
+                SVDParams(num_iterations=2),
+            )
+
+
+# ---------------------------------------------------------------------------
+# served graph queries
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.serve
+class TestServedGraph:
+    def _graph(self):
+        return SimpleGraph(
+            [(f"v{i}", f"v{j}") for i in range(8) for j in range(8, 20)]
+        )
+
+    def _server(self, max_coalesce):
+        from libskylark_tpu.serve.server import ServeParams, Server
+
+        srv = Server(
+            ServeParams(max_coalesce=max_coalesce, warm_start=False)
+        )
+        srv.register_graph("web", self._graph(), k=4)
+        return srv
+
+    def test_ppr_coalesced_equals_solo(self):
+        def run(mc):
+            with self._server(mc) as srv:
+                futs = [
+                    srv.submit(
+                        {"op": "ppr", "graph": "web",
+                         "seeds": ["v0", "v1"], "id": i}
+                    )
+                    for i in range(12)
+                ]
+                return [f.result() for f in futs]
+
+        solo, coal = run(1), run(16)
+        for a, b in zip(solo, coal):
+            assert a["ok"] and b["ok"]
+            assert a["result"] == b["result"]
+
+    def test_ppr_seed_order_and_names_canonicalize(self):
+        G = self._graph()
+        with self._server(16) as srv:
+            by_name = srv.call(op="ppr", graph="web", seeds=["v0", "v1"])
+            by_id = srv.call(
+                op="ppr", graph="web",
+                seeds=[G.index["v1"], G.index["v0"]],
+            )
+            assert by_name["ok"]
+            assert by_name["result"] == by_id["result"]
+
+    def test_ase_embed_rows_and_oos(self):
+        G = self._graph()
+        with self._server(16) as srv:
+            one = srv.call(op="ase_embed", graph="web", ids="v3")
+            row = np.asarray(one["result"])
+            assert row.shape == (4,)  # scalar id squeezes
+            many = np.asarray(
+                srv.call(
+                    op="ase_embed", graph="web",
+                    ids=[G.index["v3"], G.index["v5"]],
+                )["result"]
+            )
+            assert many.shape == (2, 4)
+            np.testing.assert_array_equal(many[0], row)
+            # OOS projection from an existing vertex's own neighbor
+            # list reproduces its embedding row (a_i·V = V[i,:]·Λ).
+            nb = [int(x) for x in G.neighbors(G.index["v3"])]
+            proj = np.asarray(
+                srv.call(
+                    op="ase_embed", graph="web", neighbors=nb
+                )["result"]
+            )
+            np.testing.assert_allclose(proj, row, atol=1e-10)
+
+    def test_client_wrappers_and_census(self):
+        from libskylark_tpu.serve.client import Client
+
+        with self._server(16) as srv:
+            assert srv.census()["graphs"] == ["web"]
+            assert any(p.startswith("graph:web:k=4") for p in srv.primed)
+            c = Client(srv)
+            rep = c.ppr("web", ["v0"], check=True)
+            assert rep["graph"] == "web" and 0 <= rep["conductance"] <= 1
+            row = np.asarray(c.ase_embed("web", ids="v0", check=True))
+            assert row.shape == (4,)

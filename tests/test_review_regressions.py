@@ -531,3 +531,85 @@ def test_env_knobs_documented():
         f"SKYLARK_* knobs read by the library but absent from docs/: "
         f"{undocumented}"
     )
+
+
+@pytest.mark.graph
+@pytest.mark.serve
+def test_graph_serve_ops_error_envelopes():
+    """Served graph-op contract (ISSUE PR 15): ``ppr``/``ase_embed``
+    are first-class protocol ops (graph-scoped placement keys), a bad
+    graph name or malformed query resolves to a structured 102 envelope
+    AT THE DOOR (never raised across the serving boundary), and the new
+    ops shed through the same 112/113 admission/deadline ladder as
+    every other op."""
+    import time
+
+    from libskylark_tpu import serve
+    from libskylark_tpu.graph import SimpleGraph
+    from libskylark_tpu.serve import protocol
+    from libskylark_tpu.utils import exceptions as ex
+
+    assert "ppr" in protocol.OPS and "ase_embed" in protocol.OPS
+    assert protocol.placement_key({"op": "ppr", "graph": "g"}) == "ppr:g"
+    assert protocol.placement_key({"op": "ase_embed", "graph": "g"}) == "ase:g"
+
+    G = SimpleGraph([(i, j) for i in range(4) for j in range(4, 9)])
+    srv = serve.Server(
+        serve.ServeParams(max_queue=2, warm_start=False, prime=False)
+    )
+    srv.register_graph("g", G, k=2)
+
+    # 102 at the door: validation failures resolve without a worker.
+    for req in (
+        dict(op="ppr", graph="nope", seeds=[0]),
+        dict(op="ppr", graph="g", seeds=[]),
+        dict(op="ppr", graph="g", seeds=["ghost"]),
+        dict(op="ppr", graph="g", seeds=[999]),
+        dict(op="ase_embed", graph="nope", ids=[0]),
+        dict(op="ase_embed", graph="g"),
+        dict(op="ase_embed", graph="g", ids=[0], neighbors=[1]),
+        dict(op="ase_embed", graph="g", neighbors=[]),
+    ):
+        resp = srv.submit(req).result()
+        assert not resp["ok"], req
+        assert resp["error"]["code"] == 102, (req, resp["error"])
+        with pytest.raises(ex.InvalidParameters):
+            serve.raise_for_error(resp)
+
+    # 112: queue full (worker not started) sheds the third request;
+    # the first admitted one carries a deadline for the 113 check below.
+    fd = srv.submit(dict(op="ppr", graph="g", seeds=[2], deadline_ms=1))
+    f1 = srv.submit(dict(op="ase_embed", graph="g", ids=[1]))
+    shed = srv.call(op="ppr", graph="g", seeds=[1])
+    assert not shed["ok"] and shed["error"]["code"] == 112
+    with pytest.raises(ex.AdmissionError):
+        serve.raise_for_error(shed)
+
+    # 113: the lapsed deadline sheds at dispatch once the worker drains.
+    time.sleep(0.05)
+    srv.start()
+    assert f1.result()["ok"]
+    late = fd.result()
+    srv.stop()
+    assert not late["ok"] and late["error"]["code"] == 113
+    with pytest.raises(ex.DeadlineExceededError):
+        serve.raise_for_error(late)
+
+
+@pytest.mark.graph
+def test_graph_marker_registered_tier1():
+    """Marker contract (ISSUE PR 15): the ``graph`` marker must stay a
+    registered tier-1 mark with a hard per-test alarm — graph tests
+    drive elastic folds and a live serve worker, either of which could
+    otherwise wedge the tier-1 run.  Static over conftest so dropping
+    the mark (or demoting it to slow) fails here."""
+    import pathlib
+
+    src = (pathlib.Path(__file__).parent / "conftest.py").read_text()
+    assert '"graph": GRAPH_TIMEOUT_S' in src, (
+        "the graph marker lost its _TIMEOUT_MARKS alarm entry"
+    )
+    assert "GRAPH_TIMEOUT_S = 120" in src
+    assert '"markers",\n        "graph:' in src, (
+        "the graph marker is no longer registered via addinivalue_line"
+    )
