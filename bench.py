@@ -1274,6 +1274,173 @@ def bench_fleet(on_tpu, table):
           (verified / probed) if probed else 0.0, table, contention=None)
 
 
+def bench_autoscale(on_tpu, table):
+    """Serve through change (docs/serving.md "serve through change" +
+    docs/fault_tolerance.md): the round-16 robustness measurements.
+    Two registry rows first: wall ms for a live graph edge fold and a
+    live LS row append — each publishes a NEW epoch-stamped version
+    while in-flight batches keep the old bits (the bitwise contract is
+    pinned in tests/test_live_registry.py; this row is what a caller
+    pays for it).  Then two fleet rows: scale-up reaction — wall ms
+    from a hot p99 signal to the autoscaler's spawned replica joined
+    behind the fence (prime-before-placeable, so the number includes
+    the full plan-ladder compile); and rolling-drain QPS — the mixed
+    drive sustained WHILE the autoscaler drains the fleet 2 -> 1
+    mid-traffic.  The ratio on the QPS row is the fraction of calls
+    that returned ok; the zero-downtime discipline (drain to zero,
+    clean leave, never a 114) makes 1.0 the acceptance target."""
+    import concurrent.futures as cf
+
+    from libskylark_tpu import serve
+    from libskylark_tpu import telemetry as _tel
+    from libskylark_tpu.graph.graph import SimpleGraph
+    from libskylark_tpu.serve.registry import Registry
+
+    # -- live-registry epoch bumps (no server: Registry-level timing) --
+    nv = 2048 if on_tpu else 256
+    ring = [(i, (i + 1) % nv) for i in range(nv)]
+    chords = [(i, (i + 7) % nv) for i in range(0, nv, 3)]
+
+    def fold_once():
+        reg = Registry()
+        reg.register_graph(
+            "g", SimpleGraph(ring), k=4, context=SketchContext(seed=5)
+        )
+        # readback of the refreshed embedding forces the whole delta
+        return _timed(lambda: reg.fold_graph_edges("g", chords)[0].X)
+
+    fold_s = min(fold_once() for _ in range(2 if _SMOKE else 3))
+
+    m, n = (8192, 64) if on_tpu else (512, 16)
+    blk = 128 if on_tpu else 32
+    reps = 2 if _SMOKE else 3
+    rng = np.random.default_rng(23)
+    A = rng.standard_normal((m, n))
+    reg = Registry()
+    # SJLT: the only baked-in transform with the columnwise apply_slice
+    # a live append needs; capacity reserves sketch-domain rows for it.
+    reg.register_system(
+        "sys", A, context=SketchContext(seed=3),
+        sketch_type="SJLT", capacity=m + (reps + 1) * blk,
+    )
+    app_s = min(
+        _timed(
+            lambda: reg.append_system_rows(
+                "sys", rng.standard_normal((blk, n))
+            )[0].R
+        )
+        for _ in range(reps)
+    )
+    _emit(
+        f"registry live graph fold {nv}v epoch bump", fold_s * 1e3, "ms",
+        1.0, table, contention=None,
+    )
+    _emit(
+        f"registry live row append {blk}x{n} epoch bump", app_s * 1e3,
+        "ms", 1.0, table, contention=None,
+    )
+
+    # -- autoscaled fleet: scale-up reaction + rolling-drain QPS --
+    total = 48 if _SMOKE else 160
+    clients = 8
+    rhs = [rng.standard_normal(m) for _ in range(8)]
+
+    def make_server():
+        srv = serve.Server(
+            serve.ServeParams(
+                max_coalesce=16, max_queue=8 * total,
+                warm_start=False, prime=True, workers=1,
+            ),
+            seed=13,
+        )
+        srv.registry.register_system(
+            "sys", A, context=SketchContext(seed=29)
+        )
+        return srv
+
+    prev = os.environ.get("SKYLARK_TELEMETRY")
+    stoppers = []
+    try:
+        # telemetry ON: the p99 the autoscaler steers on only records
+        # under the flag, and the shed counter certifies the QPS row.
+        os.environ["SKYLARK_TELEMETRY"] = "1"
+        _tel.reset()
+        core = make_server().start()
+        router = serve.Router()
+        router.join("core", server=core)
+        stoppers = [router, core]
+        scaler = serve.Autoscaler(
+            router,
+            lambda name: make_server(),
+            serve.AutoscaleParams(
+                min_replicas=1, max_replicas=2,
+                queue_high=1e9, queue_low=1e9,
+                p99_high_ms=1e-4,  # any recorded latency reads as hot
+                cooldown_ticks=0, idle_ticks=10**9,
+            ),
+        )
+
+        def one(i):
+            r = router.call(serve.make_request(
+                "ls_solve", system="sys", b=rhs[i % len(rhs)]
+            ))
+            return bool(r["ok"])
+
+        with cf.ThreadPoolExecutor(max_workers=clients) as pool:
+            list(pool.map(one, range(clients)))  # warm + record the p99
+            t0 = time.perf_counter()
+            for _ in range(64):
+                if scaler.step().get("action") == "scale_up":
+                    break
+            else:
+                raise RuntimeError(
+                    "autoscaler never scaled up under a hot p99"
+                )
+            react_ms = (time.perf_counter() - t0) * 1e3
+            members = router.fleet_report()["members"]
+            if sum(1 for v in members.values() if v.get("placeable")) != 2:
+                raise RuntimeError(
+                    "scaled-up replica is not placeable behind the fence"
+                )
+
+            # flip the loop to idle so it drains back to 1 mid-drive
+            scaler.params.p99_high_ms = None
+            scaler.params.idle_ticks = 1
+            deadline = time.monotonic() + 120.0
+            t0 = time.perf_counter()
+            futs = [pool.submit(one, i) for i in range(total)]
+            while len(router.fleet_report()["members"]) > 1:
+                scaler.step()
+                if time.monotonic() > deadline:
+                    raise RuntimeError("rolling drain did not converge")
+                time.sleep(0.002)
+            oks = sum(1 for f in futs if f.result())
+            wall = time.perf_counter() - t0
+        counters = _tel.REGISTRY.snapshot()["counters"]
+        shed = counters.get("serve.shed_admission", 0)
+        lost = counters.get("router.ejects", 0)
+        if shed or lost:
+            raise RuntimeError(
+                f"rolling drain was not clean (shed={shed}, ejects={lost})"
+            )
+    finally:
+        for s in stoppers:
+            s.stop()
+        _tel.reset()
+        if prev is None:
+            os.environ.pop("SKYLARK_TELEMETRY", None)
+        else:
+            os.environ["SKYLARK_TELEMETRY"] = prev
+    _emit(
+        "serve autoscale scale-up reaction (prime->placeable)", react_ms,
+        "ms", 1.0, table, contention=None,
+    )
+    _emit(
+        "serve autoscale rolling-drain QPS (2->1 mid-traffic)",
+        total / wall, "req/s", oks / total, table, contention=None,
+    )
+
+
 def bench_plan_cache(on_tpu, table):
     """Plan-cache cold vs warm: what one compiled sketch-apply plan costs
     to build (trace + compile + first exec) against what the cached
@@ -2135,7 +2302,12 @@ def main() -> None:
     # FJLT f32 row also moves up — it is the round-5 fused-kernel
     # measurement).  Rows with round-2/3 captures queue behind them.
     secondaries = [
-        # Round-15 row leads (never captured): streamed graph sketching
+        # Round-16 rows lead (never captured): chaos-driven autoscaler +
+        # epoch-versioned live registries (docs/serving.md, "serve
+        # through change") — live fold/append epoch-bump latency,
+        # scale-up reaction, and rolling-drain QPS.
+        ("serve autoscale", 60, lambda: bench_autoscale(on_tpu, table)),
+        # Round-15 row next (never captured): streamed graph sketching
         # + elastic ASE resume + served PPR QPS (docs/graph.md).
         ("graph analytics", 60, lambda: bench_graph(on_tpu, table)),
         # Round-14 rows next (never captured): the certified

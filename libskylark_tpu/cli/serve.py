@@ -22,7 +22,13 @@ Fleet mode:
   is placed by key affinity + load, ``GET /fleet`` shows membership;
 - ``--join URL`` announces THIS server to a router front door at URL
   once it is primed and serving (zero-downtime rollout: the router
-  fences the registry signature and only then places traffic here).
+  fences the registry signature and only then places traffic here);
+- ``--autoscale`` runs the membership control loop over the router
+  front door: replicas spawn against ``--queue-high``/``--p99-high-ms``
+  targets (primed before placeable, join-fenced) and drain to zero
+  in-flight before leaving, bounded by ``--min-replicas`` /
+  ``--max-replicas``; every decision is ledgered and visible on
+  ``/healthz`` (the ``skylark-top`` autoscale panel).
 """
 
 from __future__ import annotations
@@ -107,6 +113,25 @@ def main(argv=None) -> int:
                    help="announce this server to a router front door at "
                         "URL after priming (requires --http); registry "
                         "signatures are fenced at join")
+    p.add_argument("--autoscale", action="store_true",
+                   help="run the membership control loop over the router "
+                        "front door (requires --http): replicas are "
+                        "spawned against queue-depth/p99 targets and "
+                        "drained to zero in-flight when idle")
+    p.add_argument("--min-replicas", type=int, default=1,
+                   help="autoscale floor: never drain below this many "
+                        "placeable replicas")
+    p.add_argument("--max-replicas", type=int, default=4,
+                   help="autoscale ceiling: never spawn past this many "
+                        "placeable replicas")
+    p.add_argument("--queue-high", type=float, default=8.0,
+                   help="mean placeable queue depth above which the "
+                        "autoscaler spawns a replica")
+    p.add_argument("--p99-high-ms", type=float, default=None,
+                   help="optional p99 latency target; above it the "
+                        "autoscaler spawns even with shallow queues")
+    p.add_argument("--autoscale-interval", type=float, default=2.0,
+                   help="autoscale decision period in seconds")
     p.add_argument("--x64", action="store_true")
     add_perf_args(p)
     add_policy_args(p)
@@ -130,6 +155,9 @@ def main(argv=None) -> int:
     if args.join and args.http is None:
         raise SystemExit("--join needs --http (the router heartbeats this "
                          "server's /healthz)")
+    if args.autoscale and args.http is None:
+        raise SystemExit("--autoscale needs --http (the control loop runs "
+                         "over a router front door)")
 
     params = serve.ServeParams(
         max_queue=args.max_queue,
@@ -161,7 +189,8 @@ def main(argv=None) -> int:
 
     servers = [make_server() for _ in range(max(1, args.replicas))]
     router = None
-    if args.replicas > 1:
+    autoscaler = None
+    if args.replicas > 1 or args.autoscale:
         router = serve.Router(
             serve.RouterParams(heartbeat_interval_s=1.0)
         ).start()
@@ -171,6 +200,24 @@ def main(argv=None) -> int:
             print(f"replica-{i} joined (epoch {rec['epoch']})",
                   file=sys.stderr)
         front = router
+        if args.autoscale:
+            autoscaler = serve.Autoscaler(
+                router, lambda name: make_server(),
+                serve.AutoscaleParams(
+                    min_replicas=args.min_replicas,
+                    max_replicas=args.max_replicas,
+                    queue_high=args.queue_high,
+                    p99_high_ms=args.p99_high_ms,
+                    interval_s=args.autoscale_interval,
+                ),
+            )
+            for i, s in enumerate(servers):
+                autoscaler.adopt(f"replica-{i}", s)
+            router.autoscaler = autoscaler  # /healthz autoscale panel
+            autoscaler.start()
+            print(f"autoscale [{args.min_replicas}, {args.max_replicas}] "
+                  f"queue_high {args.queue_high} every "
+                  f"{args.autoscale_interval}s", file=sys.stderr)
     else:
         servers[0].start()
         front = servers[0]
@@ -200,6 +247,8 @@ def main(argv=None) -> int:
             served = serve.serve_stdio(front, sys.stdin, sys.stdout)
             print(f"served {served} requests", file=sys.stderr)
     finally:
+        if autoscaler is not None:
+            autoscaler.stop()
         if router is not None:
             router.stop()
         for s in servers:

@@ -8,7 +8,9 @@ Tails whichever observability surfaces it is pointed at — any mix of:
   Repeatable: several ``--url`` flags render one per-replica fleet
   table (queue depth, QPS, primed rungs, heartbeat age); pointing one
   ``--url`` at a router front door expands its membership table the
-  same way.
+  same way, plus — when the front door runs an autoscaler — the
+  membership control-loop panel (bounds, owned/draining replicas, the
+  decision-ledger tail).
 - ``--telemetry-dir``: the JSONL run-ledger directory
   (``ledger-<pid>.jsonl``); shows event-kind totals and the most recent
   guard verdicts / dumped traces.
@@ -152,6 +154,36 @@ def _rank_lines(hosts: dict) -> list[str]:
     return out
 
 
+def _autoscale_lines(scale: dict) -> list[str]:
+    """The membership control-loop panel: current shape vs targets and
+    the tail of the decision ledger."""
+    params = scale.get("params", {})
+    out = [
+        f"  tick {scale.get('tick')}  bounds"
+        f" [{params.get('min_replicas')}, {params.get('max_replicas')}]"
+        f"  queue_high {_fmt(params.get('queue_high'))}"
+        f"  p99_high {_fmt(params.get('p99_high_ms'))} ms"
+        f"  cooldown {scale.get('cooldown')}"
+    ]
+    owned = scale.get("owned") or []
+    draining = scale.get("draining") or []
+    out.append(
+        f"  owned {', '.join(owned) or '(none)'}"
+        f"  draining {', '.join(draining) or '(none)'}"
+    )
+    for rec in (scale.get("ledger") or [])[-4:]:
+        bits = [f"  tick {rec.get('tick'):>4}: {rec.get('action', '?')}"]
+        if rec.get("replica"):
+            bits.append(str(rec["replica"]))
+        bits.append(
+            f"placeable {rec.get('placeable')}"
+            f"  depth {_fmt(rec.get('mean_depth'))}"
+            f"  p99 {_fmt(rec.get('p99_ms'))} ms"
+        )
+        out.append(" ".join(bits))
+    return out
+
+
 def _fleet_table(rows: list) -> list[str]:
     """Per-replica rows of (name, load report | None, heartbeat age)."""
     out = [
@@ -196,10 +228,19 @@ def render_frame(args) -> str:
             fleet_rows.append((base, load, None))
         if fleet:  # a router front door: expand its membership table
             for name, m in sorted(fleet.get("members", {}).items()):
-                tag = name if m.get("placeable") else f"{name} (unplaceable)"
+                if m.get("draining"):
+                    tag = f"{name} (draining)"
+                elif not m.get("placeable"):
+                    tag = f"{name} (unplaceable)"
+                else:
+                    tag = name
                 fleet_rows.append(
                     (tag, m.get("report"), m.get("heartbeat_age_s"))
                 )
+        scale = health.get("autoscale") if "_error" not in health else None
+        if scale:
+            lines.append(f"autoscale {base}")
+            lines += _autoscale_lines(scale)
     if len(fleet_rows) > 1:
         lines.append(f"fleet ({len(fleet_rows)} replicas)")
         lines += _fleet_table(fleet_rows)

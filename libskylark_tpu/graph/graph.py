@@ -53,6 +53,69 @@ class SimpleGraph:
         )
         self.indices = cols
 
+    def with_edges(self, pairs):
+        """New graph absorbing extra edges over the EXISTING vertex set.
+
+        ``pairs``: iterable of (u, v) vertex ids or names.  Returns
+        ``(G2, new_pairs)``: the merged graph — same vertex interning,
+        same ids, CSR rebuilt — and the (r, 2) int64 array of undirected
+        (lo, hi) pairs that were genuinely NEW (self-loops and edges
+        already present are dropped, duplicates collapsed).  The live
+        serve registry folds exactly ``new_pairs`` into its retained
+        adjacency sketch, so the delta fold counts each edge once —
+        the same dedup the constructor applies from scratch.
+
+        Vertices must already exist: sketch domains are sized to the
+        registered vertex set, so growth is rejected (register with
+        isolated capacity vertices if the universe must grow).
+        """
+        ids = []
+        for u, v in pairs:
+            iu = u if isinstance(u, (int, np.integer)) else self.index.get(u)
+            iv = v if isinstance(v, (int, np.integer)) else self.index.get(v)
+            if iu is None or iv is None or not (
+                0 <= int(iu) < self.n and 0 <= int(iv) < self.n
+            ):
+                raise KeyError(
+                    f"with_edges: unknown vertex in ({u!r}, {v!r}); live "
+                    "folds are over the registered vertex set"
+                )
+            if int(iu) != int(iv):
+                ids.append((int(iu), int(iv)))
+        g2 = object.__new__(SimpleGraph)
+        g2.vertices = self.vertices
+        g2.index = self.index
+        g2.n = self.n
+        if not ids:
+            g2.indptr = self.indptr
+            g2.indices = self.indices
+            return g2, np.empty((0, 2), np.int64)
+        arr = np.asarray(ids, np.int64)
+        lo = arr.min(axis=1)
+        hi = arr.max(axis=1)
+        cand = np.unique(np.stack([lo, hi], axis=1), axis=0)
+        # Drop pairs already present (CSR membership on the lo row).
+        old_rows = np.repeat(np.arange(self.n, dtype=np.int64),
+                             np.diff(self.indptr))
+        have = set(zip(old_rows.tolist(), self.indices.tolist()))
+        fresh = np.asarray(
+            [p for p in cand.tolist() if (p[0], p[1]) not in have], np.int64
+        ).reshape(-1, 2)
+        if not fresh.size:
+            g2.indptr = self.indptr
+            g2.indices = self.indices
+            return g2, fresh
+        rows = np.concatenate([old_rows, fresh[:, 0], fresh[:, 1]])
+        cols = np.concatenate([self.indices, fresh[:, 1], fresh[:, 0]])
+        order = np.lexsort((cols, rows))
+        rows, cols = rows[order], cols[order]
+        counts = np.bincount(rows, minlength=self.n)
+        g2.indptr = np.concatenate(
+            [np.zeros(1, dtype=np.int64), np.cumsum(counts, dtype=np.int64)]
+        )
+        g2.indices = cols
+        return g2, fresh
+
     # -- accessors (≙ the GraphType concept used by the algorithms) ---------
 
     def degree(self, i: int) -> int:

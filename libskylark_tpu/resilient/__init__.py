@@ -12,6 +12,7 @@ notes the MPI fail-stop model; this subsystem is the TPU-production answer).
 from .chunked import ChunkedSolver
 from .faults import (
     FaultPlan,
+    FleetFaultPlan,
     HostFaultPlan,
     SimulatedPreemption,
     corrupt_checkpoint,
@@ -26,6 +27,7 @@ __all__ = [
     "ResilientParams",
     "ResilientRunner",
     "FaultPlan",
+    "FleetFaultPlan",
     "HostFaultPlan",
     "SimulatedPreemption",
     "corrupt_checkpoint",

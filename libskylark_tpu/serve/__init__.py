@@ -27,10 +27,17 @@ Layout (see ``docs/serving.md``):
   profile-aware placement (key affinity → coalescing), 112/114
   shedding, heartbeat ejection with in-flight re-placement;
 - :mod:`.transport` / :mod:`.client` — stdio + HTTP/1.1 keep-alive
-  fronts and the Python client (``skylark-serve`` is the CLI wrapper).
+  fronts and the Python client (``skylark-serve`` is the CLI wrapper);
+- :mod:`.autoscale` — the chaos-tested membership control loop: spawns
+  replicas against queue-depth/p99 targets (prime-before-placeable,
+  join-fenced) and drains idle ones to zero in-flight before they
+  leave; registries are LIVE — epoch-versioned edge folds, row
+  appends/downdates (code 116 for retired-epoch pins), with in-flight
+  batches pinned bitwise to the version they admitted under.
 """
 
 from .admission import AdmissionQueue, Entry
+from .autoscale import AutoscaleParams, Autoscaler
 from .client import Client
 from .protocol import (
     decode,
@@ -43,7 +50,7 @@ from .protocol import (
     placement_key,
     raise_for_error,
 )
-from .registry import LSSystem, Registry
+from .registry import GraphSystem, LSSystem, Registry
 from .router import (
     HttpReplica,
     InProcessReplica,
@@ -56,8 +63,11 @@ from .transport import serve_http, serve_stdio
 
 __all__ = [
     "AdmissionQueue",
+    "AutoscaleParams",
+    "Autoscaler",
     "Client",
     "Entry",
+    "GraphSystem",
     "HttpReplica",
     "InProcessReplica",
     "LSSystem",

@@ -37,7 +37,8 @@ class Entry:
 
     __slots__ = (
         "request", "future", "key", "op", "payload", "squeeze",
-        "t_admit", "deadline", "sketch", "counter_base", "trace", "tctx",
+        "t_admit", "deadline", "sketch", "counter_base", "entity",
+        "trace", "tctx",
     )
 
     def __init__(self, request, future, key, op, payload=None):
@@ -51,6 +52,11 @@ class Entry:
         self.deadline = None
         self.sketch = None
         self.counter_base = None
+        # The registry version object PINNED at validation: live-registry
+        # updates publish NEW version objects, so an in-flight coalesced
+        # batch executes against exactly the epoch it admitted under —
+        # bitwise, regardless of folds landing while it queued.
+        self.entity = None
         self.trace = {"events": []}
         # TraceContext minted at admission when telemetry is on; its
         # event list ALIASES trace["events"] so everything attached

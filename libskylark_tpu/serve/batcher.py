@@ -105,10 +105,24 @@ def _pad_cols(Bt: np.ndarray) -> tuple[np.ndarray, int]:
 
 
 def _execute_ls(registry, entries, device=None):
-    system = registry.get_system(entries[0].request["system"])
+    # The entity PINNED at validation, not the registry head: a live
+    # row-append/downdate landing while this batch queued published a
+    # new version object — this batch still executes against the exact
+    # epoch it admitted under (prime() entries carry no pin and take
+    # the current head).
+    system = entries[0].entity or registry.get_system(
+        entries[0].request["system"]
+    )
     S = entries[0].sketch or system.S
     Bt = np.stack([e.payload for e in entries])  # (k, m)
     B, kb = _pad_cols(Bt)  # (m, kb)
+    if B.shape[0] < S.n:
+        # Capacity-reserved system: rows [m, capacity) are virtual
+        # zeros in the registered S·A, so the RHS pads with exact
+        # zeros to the sketch domain (zero rows contribute zero).
+        B = np.concatenate(
+            [B, np.zeros((S.n - B.shape[0], kb), B.dtype)]
+        )
     Bj = jnp.asarray(B, system.A.dtype)
 
     def single():
@@ -157,19 +171,23 @@ def _feature_z(model, Xp, true_rows):
 
 
 def _kernel_jit(registry, name, model):
-    fn = registry.model_jits.get(name)
+    # Keyed by (name, epoch): a pinned in-flight batch rebuilding the
+    # OLD version's closure after a live model update must never leave
+    # it where new-epoch traffic would pick it up.
+    key = (name, int(getattr(model, "epoch", 0)))
+    fn = registry.model_jits.get(key)
     if fn is None:
         def gram_predict(X):
             return model.kernel.gram(X, model.X_train) @ model.A
 
         fn = jax.jit(gram_predict)
-        registry.model_jits[name] = fn
+        registry.model_jits[key] = fn
     return fn
 
 
 def _execute_predict(registry, entries, device=None):
     name = entries[0].request["model"]
-    model = registry.get_model(name)
+    model = entries[0].entity or registry.get_model(name)
     X = np.concatenate([e.payload for e in entries])  # (R, d)
     R_tot = X.shape[0]
     kb = plans.bucket_for(R_tot)
@@ -209,7 +227,9 @@ def _execute_cond_est(registry, entries, device=None):
     (``LSSystem.cond_report``), fanned to every coalesced rider.  The
     heavy spectral work happened at registration (QR of S·A); the
     per-batch cost after the first request is a dict copy per rider."""
-    system = registry.get_system(entries[0].request["system"])
+    system = entries[0].entity or registry.get_system(
+        entries[0].request["system"]
+    )
     rep = system.cond_report()
     return [dict(rep) for _ in entries], len(entries)
 
@@ -221,7 +241,9 @@ def _execute_ppr(registry, entries, device=None):
     diffusion, the graph analogue of the cached cond-est probe.  The
     fan-out is a dict copy per rider, which is what makes coalesced ≡
     solo trivially bitwise."""
-    gsys = registry.get_graph(entries[0].request["graph"])
+    gsys = entries[0].entity or registry.get_graph(
+        entries[0].request["graph"]
+    )
     return [dict(gsys.ppr_report(e.payload)) for e in entries], len(entries)
 
 
@@ -230,11 +252,32 @@ def _execute_ase_embed(registry, entries, device=None):
     lookup (``"rows"`` payloads) or out-of-sample neighbor projection
     (``"oos"``).  Pure host-array indexing per rider — per-slot purity
     is structural, no padding or tile discipline involved."""
-    gsys = registry.get_graph(entries[0].request["graph"])
+    gsys = entries[0].entity or registry.get_graph(
+        entries[0].request["graph"]
+    )
     outs = []
     for e in entries:
         mode, idx = e.payload
         outs.append(gsys.rows(idx) if mode == "rows" else gsys.project(idx))
+    return outs, len(entries)
+
+
+def _execute_update(registry, entries, device=None):
+    """Live-registry mutation executor.  Updates NEVER coalesce (the
+    server keys each uniquely) and never solo-retry — a mutation must
+    apply at most once, so a raise here surfaces as this one request's
+    structured error, with nothing re-run.  The result is the minted
+    epoch-ledger record: {entity, kind, epoch, ...delta counts}."""
+    outs = []
+    for e in entries:
+        p = e.payload
+        if p["kind"] == "graph_fold":
+            _, rec = registry.fold_graph_edges(p["name"], p["edges"])
+        elif p["kind"] == "row_append":
+            _, rec = registry.append_system_rows(p["name"], p["rows"])
+        else:
+            _, rec = registry.downdate_system_rows(p["name"], p["drop"])
+        outs.append(dict(rec))
     return outs, len(entries)
 
 
@@ -244,6 +287,7 @@ _EXECUTORS = {
     "predict": _execute_predict,
     "ppr": _execute_ppr,
     "ase_embed": _execute_ase_embed,
+    "update": _execute_update,
 }
 
 
@@ -284,6 +328,12 @@ def _finish_ok(entry, out, batch_size, bucket, t_exec_ms):
     )
     if entry.counter_base is not None:
         entry.trace["counter_base"] = entry.counter_base
+    if entry.entity is not None:
+        # The epoch this request was actually served at — the auditable
+        # half of the live-registry bitwise contract.
+        entry.trace["registry_epoch"] = int(
+            getattr(entry.entity, "epoch", 0)
+        )
     telemetry.inc("serve.ok")
     # a request that answered OK but only after a solo-retry / guard
     # rung is still an SLO incident: keep it in the violation ring

@@ -11,7 +11,7 @@ Request schema::
 
     {"id": str|int,            # caller-chosen correlation id (optional)
      "op": "ls_solve" | "cond_est" | "predict" | "ppr" | "ase_embed"
-           | "ping" | "stats",
+           | "update" | "ping" | "stats",
      # ls_solve:
      "system": str,            # registered system name
      "b": [float, ...],        # RHS, length m
@@ -34,7 +34,18 @@ Request schema::
      #   "ids": id|name|[...]       — embedding row lookup
      #   "neighbors": [id|name,...] — out-of-sample projection from a
      #                                new vertex's neighbor list
+     # update: live-registry mutation — EXACTLY ONE target of
+     #   {"graph": str, "edges": [[u, v], ...]}       — edge fold
+     #   {"system": str, "append": [[...], ...]}      — row append
+     #   {"system": str, "drop": [int, ...]}          — row downdate
+     # result is the minted epoch-ledger record {name, kind, epoch,
+     # ...delta counts}; updates never coalesce and apply exactly once,
+     # in admission order
      # either:
+     "registry_epoch": int,    # pin to an exact registry version: served
+                               # bitwise at that epoch, or refused with a
+                               # code-116 RegistryEpochError envelope
+                               # carrying {requested, current, entity}
      "deadline_ms": float}     # shed if not dispatched in time
 
 Response schema::
@@ -44,13 +55,14 @@ Response schema::
      "trace": {"queue_ms", "exec_ms", "batch_size", "bucket",
                "coalesced", "events": [...], ...}}
     {"id": ...,
-     "ok": false, "error": {"code": int,    # the 100-114 ladder
+     "ok": false, "error": {"code": int,    # the 100-116 ladder
                             "type": str, "message": str},
      "trace": {...}}
 
 Error codes ride ``utils.exceptions``: admission shed = 112
 (``AdmissionError``), deadline shed = 113 (``DeadlineExceededError``),
-serve-probe numerical failures = 108 (``NumericalHealthError``); foreign
+retired registry version = 116 (``RegistryEpochError``), serve-probe
+numerical failures = 108 (``NumericalHealthError``); foreign
 exceptions degrade to the base code 100.
 """
 
@@ -76,7 +88,7 @@ __all__ = [
 ]
 
 OPS = ("ls_solve", "cond_est", "predict", "ppr", "ase_embed",
-       "ping", "stats")
+       "update", "ping", "stats")
 
 
 def placement_key(request: dict) -> str:
@@ -100,6 +112,10 @@ def placement_key(request: dict) -> str:
         return f"ppr:{request.get('graph')}"
     if op == "ase_embed":
         return f"ase:{request.get('graph')}"
+    if op == "update":
+        name = (request.get("graph") or request.get("system")
+                or request.get("model"))
+        return f"update:{name}"
     return str(op)
 
 # code -> exception class, for client-side re-raising (raise_for_error)
@@ -152,6 +168,7 @@ def error_payload(e: BaseException) -> dict:
     }
     for attr in (
         "queue_depth", "max_depth", "deadline_ms", "waited_ms", "stage",
+        "requested", "current", "entity",
     ):
         v = getattr(e, attr, None)
         if v is not None:

@@ -1,4 +1,4 @@
-"""Model and solve-system registry: load once, serve device-resident.
+"""Model and solve-system registry: load once, serve device-resident — live.
 
 The registry is the serve layer's "load amplification" half: a model's
 weights (``ml/model.py`` JSON + binary sidecars) or an LS system's
@@ -16,24 +16,63 @@ padded batch through an already-compiled plan.
   polymorphic ``load_model`` dispatch the CLIs use, so the save→load
   round-trip contract pinned in ``tests/test_ml.py`` is exactly the
   serving contract.
+
+**Live registries** (epoch discipline).  Sketches are linear, so a
+registered entity can absorb updates without a re-register-the-world
+restart:
+
+- a :class:`GraphSystem` retains its SJLT ``Ω`` and the folded sketch
+  ``SA = Ω·A`` from registration; new edge batches fold in through the
+  same ``adjacency_sketch_fold`` scatter the streamed route uses and
+  the embedding refreshes via ``ase_from_sketch``'s cheap replicated
+  small math — bitwise identical to re-registering the merged graph
+  from scratch, by the dyadic-exactness argument of ``graph/stream.py``;
+- an :class:`LSSystem` registered with ``capacity > m`` takes
+  row-append and row-downdate deltas: the sketch contribution of the
+  touched rows (``S.apply_slice``) adds/subtracts into the retained
+  ``S·A`` and only the small (s, n) QR re-runs;
+- a model can be swapped wholesale, or a :class:`~..ml.model.KernelModel`
+  can append/drop training centers (predict is linear in the center
+  rows, so the delta is exact concatenation).
+
+Every update MINTS a registry epoch (one global counter; the updated
+entity is stamped with it, the decision appended to ``epoch_log`` and
+the telemetry ledger).  Updated versions are NEW immutable objects —
+the superseded version object stays untouched, so in-flight batches
+pinned at admission keep serving its exact bits; a request that pins
+``registry_epoch`` to a retired version gets a structured code-116
+:class:`~..utils.exceptions.RegistryEpochError` envelope instead of
+silently-new bits.
 """
 
 from __future__ import annotations
 
+import threading
+
 import jax.numpy as jnp
 
-from .. import plans
+from .. import plans, telemetry
 from ..core.context import SketchContext
 from ..sketch import base as sketch_base
-from ..utils.exceptions import InvalidParameters
+from ..utils.exceptions import InvalidParameters, UnsupportedError
 
 __all__ = ["GraphSystem", "LSSystem", "Registry"]
 
 
 class LSSystem:
-    """A registered (A, S) pair with its sketched QR cached on device."""
+    """A registered (A, S) pair with its sketched QR cached on device.
 
-    def __init__(self, name: str, A, S):
+    ``capacity`` (optional) sizes the sketch domain BEYOND the live row
+    count: rows [m, capacity) are virtual zeros, so the registered
+    factorization is unchanged math, and later ``appended`` rows land
+    in reserved sketch-domain positions (the counter-addressed hash of
+    a row depends only on its absolute index, so pre-sizing the domain
+    is what makes append deltas exact).  Without it the system is
+    frozen exactly as before.
+    """
+
+    def __init__(self, name: str, A, S, *, capacity: int | None = None,
+                 retired=frozenset()):
         self.name = name
         self.A = jnp.asarray(A)
         if self.A.ndim != 2:
@@ -41,17 +80,100 @@ class LSSystem:
                 f"system {name!r}: A must be 2-D, got shape {self.A.shape}"
             )
         self.m, self.n = (int(d) for d in self.A.shape)
-        if S.n != self.m:
+        self.capacity = int(capacity) if capacity else self.m
+        if self.capacity < self.m:
             raise InvalidParameters(
-                f"system {name!r}: sketch domain {S.n} != A rows {self.m}"
+                f"system {name!r}: capacity {self.capacity} < A rows "
+                f"{self.m}"
+            )
+        if S.n != self.capacity:
+            raise InvalidParameters(
+                f"system {name!r}: sketch domain {S.n} != row capacity "
+                f"{self.capacity}"
             )
         self.S = S
         self.dtype = self.A.dtype
-        SA = plans.apply(S, self.A, "columnwise")
+        self.retired = frozenset(int(i) for i in retired)
+        self.epoch = 0  # stamped by Registry._mint
+        if self.capacity == self.m:
+            SA = plans.apply(S, self.A, "columnwise")
+        else:
+            Ap = jnp.zeros((self.capacity, self.n), self.dtype)
+            SA = plans.apply(S, Ap.at[: self.m].set(self.A), "columnwise")
+        self._set_sa(SA)
+
+    def _set_sa(self, SA) -> None:
+        self.SA = SA
         Q, R = jnp.linalg.qr(SA)
         # Stored transposed: the per-batch solve consumes Qᵀ directly.
         self.Qt = jnp.asarray(Q).T
         self.R = R
+
+    # -- live deltas (return NEW versions; self stays frozen) ---------------
+
+    def appended(self, rows) -> "LSSystem":
+        """New version with ``rows`` appended at [m, m+r).
+
+        The delta is ``S.apply_slice(rows, m)`` — the exact sketch
+        contribution of those row positions — added into the retained
+        ``S·A``; only the (s, n) QR re-runs.  Needs reserved capacity.
+        """
+        rows = jnp.asarray(rows, self.dtype)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        if rows.ndim != 2 or int(rows.shape[1]) != self.n:
+            raise InvalidParameters(
+                f"system {self.name!r}: appended rows must be (r, {self.n})"
+                f", got shape {tuple(rows.shape)}"
+            )
+        r = int(rows.shape[0])
+        if self.m + r > self.capacity:
+            raise InvalidParameters(
+                f"system {self.name!r}: append of {r} rows exceeds "
+                f"capacity {self.capacity} (live rows {self.m}); register "
+                "with a larger capacity="
+            )
+        new = object.__new__(LSSystem)
+        new.name, new.n, new.S = self.name, self.n, self.S
+        new.capacity, new.dtype = self.capacity, self.dtype
+        new.retired = self.retired
+        new.epoch = self.epoch
+        new.A = jnp.concatenate([self.A, rows], axis=0)
+        new.m = self.m + r
+        new._set_sa(self.SA + self.S.apply_slice(rows, self.m, "columnwise"))
+        return new
+
+    def downdated(self, indices) -> "LSSystem":
+        """New version with the given live rows RETIRED: their sketch
+        contribution is subtracted from the retained ``S·A`` and the
+        rows zeroed in place (positions are kept so later appends stay
+        addressable; the server zeroes the matching ``b`` entries at
+        validation so retired rows drop out of the solve exactly)."""
+        idx = sorted({int(i) for i in indices})
+        for i in idx:
+            if not (0 <= i < self.m):
+                raise InvalidParameters(
+                    f"system {self.name!r}: downdate index {i} outside "
+                    f"[0, {self.m})"
+                )
+            if i in self.retired:
+                raise InvalidParameters(
+                    f"system {self.name!r}: row {i} is already retired"
+                )
+        new = object.__new__(LSSystem)
+        new.name, new.n, new.S = self.name, self.n, self.S
+        new.capacity, new.dtype = self.capacity, self.dtype
+        new.m = self.m
+        new.epoch = self.epoch
+        new.retired = self.retired | frozenset(idx)
+        SA = self.SA
+        A = self.A
+        for i in idx:
+            SA = SA - self.S.apply_slice(self.A[i : i + 1], i, "columnwise")
+            A = A.at[i].set(0.0)
+        new.A = A
+        new._set_sa(SA)
+        return new
 
     def describe(self) -> dict:
         return {
@@ -59,6 +181,9 @@ class LSSystem:
             "dtype": str(self.dtype),
             "sketch": type(self.S).__name__,
             "sketch_size": int(self.S.s),
+            "capacity": self.capacity,
+            "retired": len(self.retired),
+            "epoch": self.epoch,
         }
 
     def cond_report(self) -> dict:
@@ -90,24 +215,38 @@ class LSSystem:
                 "effective_rank": int((sv > cutoff).sum()),
                 "n": self.n,
                 "sketch_size": int(self.S.s),
+                "epoch": self.epoch,
             }
         return rep
 
 
 class GraphSystem:
-    """A registered graph with its ASE embedding resident.
+    """A registered graph with its ASE embedding resident — and live.
 
-    The heavy work — the randomized symmetric eigensolve behind
-    ``approximate_ase`` — runs ONCE at registration; every served query
+    The heavy work runs ONCE at registration; every served query
     afterwards is a host-array lookup (``ase_embed``) or a memoized
     active-support diffusion (``ppr``).  The embedding is kept as host
     numpy: graph queries are small-row traffic, and pinning them off
     device keeps the chips free for the sketch executors.
+
+    The embedding route is the streaming eigensolve's: an SJLT ``Ω``
+    drawn once from the registration context, the folded sketch
+    ``SA = Ω·A`` (in-core BCOO apply, bit-identical to the streamed
+    edge-block fold), and ``ase_from_sketch``'s replicated small math.
+    Both ``Ω`` and ``SA`` are RETAINED, which is what makes the system
+    live: :meth:`folded` absorbs an edge batch by one delta fold plus
+    the small-math refresh — never re-touching the edges already held —
+    and lands bit-identical to a from-scratch registration of the
+    merged graph (adjacency entries are 0/1 and SJLT values ±2⁻¹:
+    every partial sum is exact dyadic, so the fold is order-invariant
+    to the last bit).  ``params.num_iterations > 0`` opts back into the
+    subspace-iterated ``approximate_ase`` route — polished spectra, but
+    frozen (no fold state is retained).
     """
 
     def __init__(self, name: str, G, *, k: int = 8, context=None,
                  params=None):
-        from ..graph.ase import ASEParams, approximate_ase
+        from ..graph.ase import ASEParams
         from ..graph.graph import SimpleGraph
 
         if not isinstance(G, SimpleGraph):
@@ -126,13 +265,92 @@ class GraphSystem:
             seed=0x5EED
         )
         params = params or ASEParams()
+        self.epoch = 0  # stamped by Registry._mint
+        self._streamed = bool(getattr(params, "streamed", False))
         import numpy as np
 
-        X, lam = approximate_ase(G, self.k, context, params)
+        if getattr(params, "num_iterations", 0):
+            from ..graph.ase import approximate_ase
+
+            X, lam = approximate_ase(G, self.k, context, params)
+            self._S = None
+            self._sa = None
+        else:
+            from ..graph.stream import (
+                ase_from_sketch,
+                graph_block_source,
+                incore_adjacency_sketch,
+                streamed_adjacency_sketch,
+            )
+            from ..linalg.svd import _sketch_size
+            from ..sketch.hash import SJLT
+
+            k_, s = _sketch_size(self.k, params, G.n)
+            self._S = SJLT(G.n, s, context)
+            if self._streamed:
+                self._sa = streamed_adjacency_sketch(
+                    graph_block_source(
+                        G, batch_edges=getattr(params, "batch_edges", 65536)
+                    ),
+                    self._S, ncols=G.n,
+                )
+            else:
+                self._sa = incore_adjacency_sketch(G, self._S)
+            V, lam = ase_from_sketch(self._sa, self._S, k_)
+            X = V * jnp.sqrt(jnp.abs(lam))[None, :]
         self.X = np.asarray(X)
         self.lam = np.asarray(lam)
-        self._streamed = bool(getattr(params, "streamed", False))
         self._ppr_reports: dict[tuple, dict] = {}
+
+    # -- live edge folds (return NEW versions; self stays frozen) -----------
+
+    def folded(self, pairs) -> tuple["GraphSystem", int]:
+        """New version absorbing an edge batch; returns ``(gsys, r)``
+        with ``r`` the count of genuinely-new undirected edges folded.
+
+        One ``adjacency_sketch_fold`` step over the delta block (the
+        same scatter kernel the streamed registration route uses) plus
+        the ``ase_from_sketch`` refresh — O(Δedges + s·n) work, and
+        bitwise ≡ registering the merged graph from scratch."""
+        if self._S is None:
+            raise UnsupportedError(
+                f"graph {self.name!r} was registered through the "
+                "subspace-iterated route (num_iterations > 0); live edge "
+                "folds need the retained-sketch route (num_iterations=0)"
+            )
+        import numpy as np
+
+        from ..graph.stream import adjacency_sketch_fold, ase_from_sketch
+
+        try:
+            G2, fresh = self.G.with_edges(pairs)
+        except KeyError as e:
+            raise InvalidParameters(str(e)) from None
+        new = object.__new__(GraphSystem)
+        new.name, new.k, new.G = self.name, self.k, G2
+        new._S, new._streamed = self._S, self._streamed
+        new.epoch = self.epoch
+        if fresh.size:
+            _, step = adjacency_sketch_fold(self._S, self.G.n)
+            acc = step(
+                {"sa": self._sa, "edge": np.asarray(0, np.int64)},
+                {
+                    "rows": np.concatenate([fresh[:, 0], fresh[:, 1]]),
+                    "cols": np.concatenate([fresh[:, 1], fresh[:, 0]]),
+                    "vals": np.ones(2 * fresh.shape[0], np.float64),
+                },
+                0,
+            )
+            new._sa = acc["sa"]
+            V, lam = ase_from_sketch(new._sa, self._S, self.k)
+            new.X = np.asarray(V * jnp.sqrt(jnp.abs(lam))[None, :])
+            new.lam = np.asarray(lam)
+        else:
+            new._sa = self._sa
+            new.X, new.lam = self.X, self.lam
+        # The graph changed: cached diffusions belong to the old version.
+        new._ppr_reports = {}
+        return new, int(fresh.shape[0])
 
     def describe(self) -> dict:
         return {
@@ -140,6 +358,7 @@ class GraphSystem:
             "volume": int(self.G.volume),
             "k": self.k,
             "streamed": self._streamed,
+            "epoch": self.epoch,
         }
 
     def rows(self, idx) -> "np.ndarray":  # noqa: F821 — doc type
@@ -202,6 +421,29 @@ class Registry:
         self.graphs: dict[str, GraphSystem] = {}
         # per-model jitted predict closures, built lazily by the batcher
         self.model_jits: dict[str, object] = {}
+        # -- live-registry epoch discipline ---------------------------------
+        # One monotone counter over ALL mutations (registrations and live
+        # updates alike); each current version object carries the epoch
+        # it was minted at.  epoch_log is the in-process decision ledger.
+        self.epoch = 0
+        self.epoch_log: list[dict] = []
+        self._lock = threading.RLock()
+
+    def _mint(self, kind: str, name: str, obj=None, **attrs) -> dict:
+        with self._lock:
+            self.epoch += 1
+            epoch = self.epoch
+            if obj is not None:
+                try:
+                    obj.epoch = epoch
+                except AttributeError:  # exotic model classes with slots
+                    pass
+            rec = {"epoch": epoch, "kind": kind, "name": name, **attrs}
+            self.epoch_log.append(rec)
+        telemetry.inc("registry.epoch.bumps")
+        telemetry.inc(f"registry.epoch.{kind}")
+        telemetry.event("registry", "epoch", rec)
+        return rec
 
     # -- models -------------------------------------------------------------
 
@@ -211,7 +453,8 @@ class Registry:
                 f"model {name!r} has no predict(); got {type(model).__name__}"
             )
         self.models[name] = model
-        self.model_jits.pop(name, None)
+        self._drop_jits(name)
+        self._mint("register", name, model, entity="model")
 
     def load_model(self, name: str, path: str):
         """Load a saved ``ml/model.py`` JSON model once; serve forever."""
@@ -220,6 +463,70 @@ class Registry:
         model = load_model(path)
         self.register_model(name, model)
         return model
+
+    def update_model(self, name: str, model=None, *, append=None,
+                     drop=None):
+        """Live model update: swap wholesale (``model=``), or for a
+        :class:`~..ml.model.KernelModel` append/drop training centers —
+        predict is linear in the center rows, so the delta is exact
+        concatenation/deletion.  Mints an epoch; the superseded model
+        object is untouched (in-flight batches keep its bits)."""
+        old = self.get_model(name)
+        if sum(x is not None for x in (model, append, drop)) != 1:
+            raise InvalidParameters(
+                "update_model takes exactly one of model=, append=, drop="
+            )
+        if model is None:
+            from ..ml.model import KernelModel
+
+            if not isinstance(old, KernelModel):
+                raise UnsupportedError(
+                    f"model {name!r} ({type(old).__name__}) supports only "
+                    "wholesale swap (model=); center deltas need a "
+                    "KernelModel"
+                )
+            import numpy as np
+
+            X_tr = np.asarray(old.X_train)
+            A = np.asarray(old.A)
+            if append is not None:
+                X_new, A_new = append
+                X_new = np.atleast_2d(np.asarray(X_new, X_tr.dtype))
+                A_new = np.asarray(A_new, A.dtype).reshape(
+                    X_new.shape[0], *A.shape[1:]
+                )
+                X_tr = np.concatenate([X_tr, X_new])
+                A = np.concatenate([A, A_new])
+                delta = {"appended": int(X_new.shape[0])}
+            else:
+                keep = np.setdiff1d(
+                    np.arange(X_tr.shape[0]), np.asarray(drop, np.int64)
+                )
+                dropped = int(X_tr.shape[0]) - int(keep.shape[0])
+                X_tr, A = X_tr[keep], A[keep]
+                delta = {"dropped": dropped}
+            model = KernelModel(old.kernel, X_tr, A, classes=old.classes)
+        elif not hasattr(model, "predict"):
+            raise InvalidParameters(
+                f"model {name!r} update has no predict(); got "
+                f"{type(model).__name__}"
+            )
+        else:
+            delta = {"swapped": True}
+        with self._lock:
+            self.models[name] = model
+            self._drop_jits(name)
+            rec = self._mint("model_update", name, model, **delta)
+        return model, rec
+
+    def _drop_jits(self, name: str) -> None:
+        """Invalidate every cached predict closure for ``name`` — the
+        batcher keys them by (name, epoch) so a pinned in-flight batch
+        rebuilding the OLD version's closure can never be served to
+        new-epoch traffic."""
+        for k in [k for k in self.model_jits
+                  if k == name or (isinstance(k, tuple) and k[0] == name)]:
+            self.model_jits.pop(k, None)
 
     def get_model(self, name: str):
         try:
@@ -240,6 +547,7 @@ class Registry:
         sketch=None,
         sketch_type: str = "FJLT",
         sketch_size: int | None = None,
+        capacity: int | None = None,
     ) -> LSSystem:
         """Register a least-squares design matrix.
 
@@ -247,10 +555,12 @@ class Registry:
         string, or a dict (the ``native/`` interchange forms); absent,
         a fresh ``sketch_type`` transform is drawn from ``context`` —
         the server's counter stream, so registration order addresses it
-        deterministically.
+        deterministically.  ``capacity`` reserves sketch-domain rows
+        beyond ``A``'s for later live appends.
         """
         A = jnp.asarray(A)
         m = int(A.shape[0])
+        dom = int(capacity) if capacity else m
         if isinstance(sketch, str):
             sketch = sketch_base.from_json(sketch)
         elif isinstance(sketch, dict):
@@ -258,10 +568,40 @@ class Registry:
         if sketch is None:
             n = int(A.shape[1]) if A.ndim == 2 else 1
             s = int(sketch_size or min(m, max(4 * n, n + 16)))
-            sketch = sketch_base.create_sketch(sketch_type, m, s, context)
-        system = LSSystem(name, A, sketch)
+            sketch = sketch_base.create_sketch(sketch_type, dom, s, context)
+        system = LSSystem(name, A, sketch, capacity=capacity)
         self.systems[name] = system
+        self._mint("register", name, system, entity="system")
         return system
+
+    def append_system_rows(self, name: str, rows) -> tuple[LSSystem, int]:
+        """Live row append: publish a NEW version with ``rows`` folded
+        into the retained ``S·A`` (exact ``apply_slice`` delta), mint an
+        epoch, and leave the superseded version's bits untouched for
+        whatever batches admitted under it."""
+        with self._lock:
+            old = self.get_system(name)
+            new = old.appended(rows)
+            self.systems[name] = new
+            rec = self._mint(
+                "row_append", name, new,
+                rows=int(new.m - old.m), m=new.m,
+            )
+        return new, rec
+
+    def downdate_system_rows(self, name: str, indices) -> tuple[LSSystem, int]:
+        """Live row downdate (retirement): the mirror of append —
+        subtract the rows' sketch contribution, re-QR, mint an epoch."""
+        with self._lock:
+            old = self.get_system(name)
+            new = old.downdated(indices)
+            self.systems[name] = new
+            rec = self._mint(
+                "row_downdate", name, new,
+                rows=len(new.retired) - len(old.retired),
+                retired=len(new.retired),
+            )
+        return new, rec
 
     def get_system(self, name: str) -> LSSystem:
         try:
@@ -282,13 +622,31 @@ class Registry:
         context: SketchContext | None = None,
         params=None,
     ) -> GraphSystem:
-        """Register a graph: the ASE embedding is computed here, once
-        (``params.streamed=True`` folds edge blocks — the adjacency is
-        never materialized); ``ppr`` / ``ase_embed`` requests afterwards
-        serve from the resident embedding and the memoized diffusion."""
+        """Register a graph: the ASE embedding is computed here, once,
+        through the retained-sketch streaming route (``Ω`` and ``S·A``
+        are kept, so the system is live — :meth:`fold_graph_edges`);
+        ``ppr`` / ``ase_embed`` requests afterwards serve from the
+        resident embedding and the memoized diffusion."""
         gsys = GraphSystem(name, G, k=k, context=context, params=params)
         self.graphs[name] = gsys
+        self._mint("register", name, gsys, entity="graph")
         return gsys
+
+    def fold_graph_edges(self, name: str, pairs) -> tuple[GraphSystem, int]:
+        """Live edge fold: publish a NEW version whose retained ``Ω·A``
+        absorbed the batch (one delta fold + the small-math embedding
+        refresh — bitwise ≡ re-registration of the merged graph), mint
+        an epoch.  In-flight batches pinned to the old version keep its
+        exact bits; the old object is simply no longer the head."""
+        with self._lock:
+            old = self.get_graph(name)
+            new, folded = old.folded(pairs)
+            self.graphs[name] = new
+            rec = self._mint(
+                "graph_fold", name, new,
+                edges=folded, volume=int(new.G.volume),
+            )
+        return new, rec
 
     def get_graph(self, name: str) -> GraphSystem:
         try:
@@ -305,9 +663,11 @@ class Registry:
                 "kind": type(model).__name__,
                 "input_dim": getattr(model, "input_dim", None),
                 "classes": getattr(model, "classes", None) is not None,
+                "epoch": getattr(model, "epoch", 0),
             }
         return {
             "models": models,
             "systems": {k: s.describe() for k, s in self.systems.items()},
             "graphs": {k: g.describe() for k, g in self.graphs.items()},
+            "epoch": self.epoch,
         }

@@ -91,13 +91,20 @@ class HttpReplica:
     failover path converts into ejection + re-placement."""
 
     def __init__(self, name: str, url: str, *, timeout: float = 60.0,
-                 pool: ThreadPoolExecutor | None = None):
+                 pool: ThreadPoolExecutor | None = None,
+                 retries: int = 3, backoff: float = 0.05):
+        import random
+
         from .client import Client
 
         self.name = name
         self.url = url.rstrip("/")
         self._client = Client(url=url, timeout=timeout)
         self._pool = pool
+        self.retries = int(retries)
+        self.backoff = float(backoff)
+        self._sleep = time.sleep  # injectable: tests skip the real wait
+        self._jitter = random.random  # likewise
 
     def submit(self, request: dict) -> Future:
         if self._pool is None:
@@ -110,7 +117,36 @@ class HttpReplica:
         return self._pool.submit(self._client.call, request)
 
     def load_report(self) -> dict:
-        health = self._client.healthz()
+        """Fetch the replica's load report, retrying transient transport
+        errors with jittered exponential backoff (the ``FsspecSource.
+        open`` ladder): ONE dropped connection must not read as a dead
+        heartbeat — ejection is for real silence, which the poll loop
+        measures against ``heartbeat_timeout_s``, not for a flaky TCP
+        accept."""
+        attempt = 0
+        while True:
+            try:
+                health = self._client.healthz()
+                break
+            except Exception as e:  # noqa: BLE001 — transport loss
+                if attempt >= self.retries:
+                    raise
+                # Full jitter on the exponential step: a fleet's router
+                # re-polling K replicas must not thunder in lockstep.
+                delay = self.backoff * (2**attempt) * (0.5 + self._jitter())
+                if telemetry.enabled():
+                    telemetry.inc("router.report_retries")
+                    telemetry.event(
+                        "router", "report_retry",
+                        {
+                            "replica": self.name,
+                            "attempt": attempt + 1,
+                            "delay": round(delay, 4),
+                            "error": f"{type(e).__name__}: {e}"[:200],
+                        },
+                    )
+                self._sleep(delay)
+                attempt += 1
         load = health.get("load")
         if not isinstance(load, dict):
             raise ReplicaLostError(
@@ -145,6 +181,11 @@ class _Member:
     report: dict = field(default_factory=dict)
     last_heartbeat: float = 0.0
     placeable: bool = False
+    # Draining members stay in the fleet (their in-flight work finishes,
+    # their reports keep flowing) but take no NEW placements; the
+    # autoscaler removes them once their queue reads zero.  A drain is a
+    # deliberate decision, never a fault — no 114 is minted for it.
+    draining: bool = False
 
 
 def _saturated(report: dict) -> bool:
@@ -273,6 +314,45 @@ class Router:
             timeout=float(payload.get("timeout", 60.0)),
         )
 
+    def drain(self, name: str) -> bool:
+        """Take a member out of NEW placements without ejecting it: its
+        in-flight and queued work finishes on the replica, its heartbeat
+        keeps flowing, and :meth:`remove` retires it once idle.  This is
+        the scale-down half of zero-downtime membership — the mirror of
+        the join fence's prime-before-placeable.  Returns False for an
+        unknown member."""
+        with self._lock:
+            member = self._members.get(name)
+            if member is None:
+                return False
+            member.draining = True
+            member.placeable = False
+            for key in [k for k, n in self._affinity.items() if n == name]:
+                del self._affinity[key]
+        telemetry.inc("router.drains")
+        telemetry.event("router", "drain", {"replica": name})
+        return True
+
+    def remove(self, name: str, reason: str = "drained") -> bool:
+        """Clean departure: pop the member, bump the fleet epoch, ledger
+        a ``leave`` event.  Unlike :meth:`eject` this mints NO code-114
+        error — the member left on purpose with zero work in flight.
+        Returns False for an unknown member."""
+        with self._lock:
+            member = self._members.pop(name, None)
+            if member is None:
+                return False
+            for key in [k for k, n in self._affinity.items() if n == name]:
+                del self._affinity[key]
+            self._epoch += 1
+            epoch = self._epoch
+        telemetry.inc("router.leaves")
+        telemetry.event(
+            "router", "leave",
+            {"replica": name, "epoch": epoch, "reason": reason},
+        )
+        return True
+
     def eject(self, name: str, reason: str = "heartbeat lost",
               heartbeat_age_s: float | None = None) -> None:
         """Remove a member: epoch bump, affinity entries dropped (their
@@ -302,27 +382,45 @@ class Router:
         members whose reports fail (or whose workers are dead) past the
         timeout are ejected.  Returns ``{name: placeable}`` for the
         survivors.  Deterministic — tests call this directly instead of
-        racing the background thread."""
+        racing the background thread.
+
+        Stale-but-alive discipline: a member whose report FETCH failed
+        this sweep (transport hiccup, replica mid-GC) keeps its last
+        report — stamped with ``report_age_s`` so placement reads its
+        age honestly — and stays placeable until the silence crosses
+        ``heartbeat_timeout_s``.  Ejection fires on real silence only;
+        one dropped poll is not a dead replica."""
         now = time.monotonic() if now is None else now
         with self._lock:
             snapshot = list(self._members.items())
         lost = []
         for name, member in snapshot:
+            fetched = True
             try:
                 report = member.replica.load_report()
                 alive = bool(report.get("worker_alive"))
             except Exception:  # noqa: BLE001 — a dead peer must not kill the sweep
-                report, alive = None, False
+                report, alive, fetched = None, False, False
             with self._lock:
                 if self._members.get(name) is not member:
                     continue
-                if report is not None:
+                age = now - member.last_heartbeat
+                if fetched:
                     member.report = report
-                member.placeable = alive
-                if alive:
-                    member.last_heartbeat = now
-                elif now - member.last_heartbeat > self.params.heartbeat_timeout_s:
-                    lost.append((name, now - member.last_heartbeat))
+                    member.placeable = alive and not member.draining
+                    if alive:
+                        member.last_heartbeat = now
+                    elif age > self.params.heartbeat_timeout_s:
+                        lost.append((name, age))
+                elif age > self.params.heartbeat_timeout_s:
+                    member.placeable = False
+                    lost.append((name, age))
+                else:
+                    # stale-but-alive: keep serving on the last report,
+                    # visibly aged so placement can discount it
+                    member.report = dict(
+                        member.report, report_age_s=round(age, 3)
+                    )
         for name, age in lost:
             self.eject(name, heartbeat_age_s=round(age, 3))
         with self._lock:
@@ -442,6 +540,33 @@ class Router:
                         )
                     )
                 return
+            # The placement→dispatch race: the replica was chosen while
+            # placeable but stopped (or was ejected) before this request
+            # reached its worker.  Its shutdown envelope is a 112 with
+            # no queue depth (a saturation shed always carries one) —
+            # that, or an infrastructure error from a member the fleet
+            # already dropped, fails over transparently exactly like a
+            # raised transport loss; a 114 reaches the caller only when
+            # no placeable replica remains.
+            err = None if resp.get("ok") else (resp.get("error") or {})
+            if err is not None and attempt < self.params.max_failover:
+                shutdown = (
+                    err.get("code") == 112
+                    and err.get("queue_depth") is None
+                )
+                with self._lock:
+                    gone = self._members.get(name) is not member
+                if shutdown or (gone and err.get("code") in (112, 114)):
+                    if not gone:
+                        self.eject(name, reason="shut down in flight")
+                    telemetry.inc("router.failovers")
+                    telemetry.event(
+                        "router", "failover",
+                        {"key": key, "replica": name,
+                         "code": err.get("code"), "attempt": attempt + 1},
+                    )
+                    self._dispatch(request, outer, attempt + 1)
+                    return
             trace = resp.setdefault("trace", {})
             trace["replica"] = name
             trace["fleet_epoch"] = epoch
@@ -460,6 +585,7 @@ class Router:
                 "members": {
                     n: {
                         "placeable": m.placeable,
+                        "draining": m.draining,
                         "heartbeat_age_s": round(now - m.last_heartbeat, 3),
                         "report": m.report,
                     }

@@ -38,6 +38,7 @@ from ..core.context import SketchContext
 from ..utils.exceptions import (
     DeadlineExceededError,
     InvalidParameters,
+    RegistryEpochError,
     SkylarkError,
 )
 from . import batcher, protocol
@@ -391,6 +392,7 @@ class Server:
         report = {
             "queue_depth": len(self.queue),
             "max_queue": self.params.max_queue,
+            "epoch": self.registry.epoch,
             "workers": max(1, self.params.workers),
             "worker_alive": any(t.is_alive() for t in self._threads),
             "throughput": throughput,
@@ -435,6 +437,7 @@ class Server:
             return None
         if op == "ls_solve":
             system = self.registry.get_system(request.get("system"))
+            self._check_epoch(request, system, "system")
             b = np.asarray(request.get("b"), np.float64)
             if b.ndim != 1 or b.shape[0] != system.m:
                 raise InvalidParameters(
@@ -442,22 +445,36 @@ class Server:
                     f"got shape {b.shape} (coalesce multi-RHS as "
                     "multiple requests)"
                 )
+            if system.retired:
+                # Retired rows are zero in the held S·A; zeroing their b
+                # entries drops them from the solve exactly (the caller's
+                # other rows are untouched).
+                b = b.copy()
+                b[sorted(system.retired)] = 0.0
+            ep = getattr(system, "epoch", 0)
             if request.get("fresh_sketch"):
                 self._fresh_seq += 1
-                key = ("ls", request["system"], "fresh", self._fresh_seq)
+                key = ("ls", request["system"], ep, "fresh", self._fresh_seq)
             else:
-                key = ("ls", request["system"])
-            return Entry(request, fut, key, op, payload=b)
+                key = ("ls", request["system"], ep)
+            entry = Entry(request, fut, key, op, payload=b)
+            entry.entity = system
+            return entry
         if op == "cond_est":
             # validate the name at the door; the executor serves the
             # system's cached sketched-spectrum report to the batch
-            self.registry.get_system(request.get("system"))
-            return Entry(
-                request, fut, ("cond", request["system"]), op,
-                payload=np.zeros(0),
+            system = self.registry.get_system(request.get("system"))
+            self._check_epoch(request, system, "system")
+            entry = Entry(
+                request, fut,
+                ("cond", request["system"], getattr(system, "epoch", 0)),
+                op, payload=np.zeros(0),
             )
+            entry.entity = system
+            return entry
         if op == "predict":
             model = self.registry.get_model(request.get("model"))
+            self._check_epoch(request, model, "model")
             dtype = np.dtype(request.get("dtype", "float64"))
             x = np.asarray(request.get("x"), dtype)
             squeeze = x.ndim == 1
@@ -472,13 +489,17 @@ class Server:
             if request.get("labels"):
                 request["_classes"] = getattr(model, "classes", None)
             entry = Entry(
-                request, fut, ("predict", request["model"], str(dtype)),
+                request, fut,
+                ("predict", request["model"], str(dtype),
+                 getattr(model, "epoch", 0)),
                 op, payload=x,
             )
             entry.squeeze = squeeze
+            entry.entity = model
             return entry
         if op == "ppr":
             gsys = self.registry.get_graph(request.get("graph"))
+            self._check_epoch(request, gsys, "graph")
             seeds = request.get("seeds")
             if not isinstance(seeds, (list, tuple)) or not seeds:
                 raise InvalidParameters(
@@ -495,11 +516,16 @@ class Server:
                 float(request.get("gamma", 5.0)),
                 float(request.get("epsilon", 0.001)),
             )
-            return Entry(
-                request, fut, ("ppr", request["graph"]), op, payload=payload
+            entry = Entry(
+                request, fut,
+                ("ppr", request["graph"], getattr(gsys, "epoch", 0)),
+                op, payload=payload,
             )
+            entry.entity = gsys
+            return entry
         if op == "ase_embed":
             gsys = self.registry.get_graph(request.get("graph"))
+            self._check_epoch(request, gsys, "graph")
             has_ids = "ids" in request
             has_nb = "neighbors" in request
             if has_ids == has_nb:
@@ -525,13 +551,93 @@ class Server:
                 idx = self._graph_ids(gsys, items, "ase_embed neighbors")
                 payload = ("oos", np.asarray(idx, np.int64))
             entry = Entry(
-                request, fut, ("ase", request["graph"]), op, payload=payload
+                request, fut,
+                ("ase", request["graph"], getattr(gsys, "epoch", 0)),
+                op, payload=payload,
             )
             entry.squeeze = squeeze
+            entry.entity = gsys
             return entry
+        if op == "update":
+            return self._validate_update(request, fut)
         raise InvalidParameters(
             f"unknown op {op!r}; supported: {list(protocol.OPS)}"
         )
+
+    def _validate_update(self, request: dict, fut: Future) -> Entry:
+        """Door validation for live-registry mutations.  The mutation
+        itself runs in the WORKER (the update executor) — updates ride
+        the same admission queue as traffic, so a coalesced batch that
+        admitted before the update keeps its pinned pre-update version
+        and everything admitted after sees the new epoch: the queue
+        order IS the epoch order.  Each update gets a UNIQUE coalesce
+        key: mutations must apply exactly once, so they never batch and
+        never enter the solo-retry path."""
+        targets = [t for t in ("graph", "system", "model") if t in request]
+        if targets != ["graph"] and targets != ["system"]:
+            raise InvalidParameters(
+                "update takes exactly one target: 'graph' (with 'edges') "
+                "or 'system' (with 'append' or 'drop'); model updates are "
+                "a server-side API (Registry.update_model), got "
+                f"targets {targets!r}"
+            )
+        if targets == ["graph"]:
+            name = request["graph"]
+            self.registry.get_graph(name)  # validate at the door
+            edges = request.get("edges")
+            if not isinstance(edges, (list, tuple)) or not all(
+                isinstance(p, (list, tuple)) and len(p) == 2 for p in edges
+            ):
+                raise InvalidParameters(
+                    "graph update needs 'edges': a list of (u, v) pairs, "
+                    f"got {type(edges).__name__}"
+                )
+            payload = {"kind": "graph_fold", "name": name,
+                       "edges": [tuple(p) for p in edges]}
+        else:
+            name = request["system"]
+            self.registry.get_system(name)
+            has_append = "append" in request
+            if has_append == ("drop" in request):
+                raise InvalidParameters(
+                    "system update takes exactly one of 'append' (row "
+                    "block) or 'drop' (row index list)"
+                )
+            if has_append:
+                payload = {
+                    "kind": "row_append", "name": name,
+                    "rows": np.asarray(request["append"], np.float64),
+                }
+            else:
+                payload = {
+                    "kind": "row_downdate", "name": name,
+                    "drop": [int(i) for i in request["drop"]],
+                }
+        self._fresh_seq += 1
+        entry = Entry(
+            request, fut, ("update", name, self._fresh_seq), "update",
+            payload=payload,
+        )
+        return entry
+
+    def _check_epoch(self, request: dict, entity, kind: str) -> None:
+        """The code-116 fence: a request may pin ``registry_epoch`` to
+        demand the exact version it knows; if the entity has moved on
+        (or has not reached that epoch), refuse with the two epochs in
+        the envelope rather than serve silently-different bits."""
+        want = request.get("registry_epoch")
+        if want is None:
+            return
+        current = int(getattr(entity, "epoch", 0))
+        if int(want) != current:
+            telemetry.inc("registry.epoch.misses")
+            raise RegistryEpochError(
+                f"{kind} {getattr(entity, 'name', '?')!r} is at registry "
+                f"epoch {current}, request pinned epoch {int(want)} — the "
+                "pinned version is retired (or not yet minted)",
+                requested=int(want), current=current,
+                entity=getattr(entity, "name", None),
+            )
 
     @staticmethod
     def _graph_ids(gsys, items, what: str) -> list:

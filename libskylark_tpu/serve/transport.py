@@ -87,6 +87,9 @@ def _healthz(srv) -> dict:
         out["load"] = srv.load_report()
     if hasattr(srv, "fleet_report"):
         out["fleet"] = srv.fleet_report()
+    scaler = getattr(srv, "autoscaler", None)
+    if scaler is not None:  # a Router front door with a control loop
+        out["autoscale"] = scaler.report()
     return out
 
 

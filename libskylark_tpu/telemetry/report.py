@@ -115,6 +115,26 @@ def snapshot(fleet: bool = False, root=None) -> dict:
             counters.get("router.placements", 0),
         )
         snap["router"] = router
+    autoscale = {
+        k.split(".", 1)[1]: v
+        for k, v in counters.items()
+        if k.startswith("autoscale.")
+    }
+    if autoscale:
+        # Membership control-loop counters (ticks, scale_ups,
+        # scale_downs, drains_done, spawn_failures) fold only when an
+        # autoscaler ran.
+        snap["autoscale"] = autoscale
+    registry_live = {
+        k.split(".", 1)[1]: v
+        for k, v in counters.items()
+        if k.startswith("registry.")
+    }
+    if registry_live:
+        # Live-registry epoch counters (epoch.bumps, per-kind mints,
+        # epoch.misses = code-116 refusals) — present only once an
+        # entity registered or mutated.
+        snap["registry"] = registry_live
     return snap
 
 

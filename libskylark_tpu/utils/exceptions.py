@@ -23,6 +23,7 @@ __all__ = [
     "DeadlineExceededError",
     "ReplicaLostError",
     "RefinementError",
+    "RegistryEpochError",
 ]
 
 
@@ -216,3 +217,24 @@ class RefinementError(SkylarkError):
         self.iters = iters
         self.residual = residual
         self.stage = stage
+
+
+class RegistryEpochError(SkylarkError):
+    """A served request pinned a registry version the entity no longer
+    (or does not yet) serves: live registries mint a new epoch per
+    update (edge fold, row append/downdate, model swap) and retire the
+    superseded version once its in-flight batches drain.  Failing fast
+    with the two epochs — instead of serving the CURRENT version to a
+    caller that asked for a retired one — is what keeps the bitwise
+    contract honest: a pinned caller either gets the exact bits of the
+    version it named or a structured refusal, never silently-new bits.
+    ``requested``/``current`` carry the two epochs; ``entity`` names
+    the registered system/model/graph."""
+
+    code = 116
+
+    def __init__(self, msg, requested=None, current=None, entity=None):
+        super().__init__(msg)
+        self.requested = requested
+        self.current = current
+        self.entity = entity

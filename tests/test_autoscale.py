@@ -1,0 +1,388 @@
+"""Chaos-driven fleet autoscaler (ISSUE PR 16): serve through change.
+
+Two layers of drills:
+
+- **Deterministic control-loop tests** over fake load-report-only
+  replicas: scale-up on pressure with cooldown hysteresis, idle-streak
+  scale-down that drains to zero before leaving membership, min/max
+  bounds, spawn failure as a ledgered decision (never a crash), and the
+  forced removal of a wedged drain.
+- **Chaos drills** with real serving replicas and a
+  :class:`FleetFaultPlan` firing at exact control ticks: die-under-load
+  (the autoscaler restores capacity; no caller sees a 114 while a
+  placeable replica remains), a join storm (every joiner rides the
+  signature fence and is placeable only with a live worker), a slow
+  heartbeat (stale-but-alive, never ejected for one dropped poll), and
+  a flapping replica (membership converges, zero shed work).
+"""
+
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from libskylark_tpu import serve, telemetry
+from libskylark_tpu.core.context import SketchContext
+from libskylark_tpu.resilient import FleetFaultPlan
+
+pytestmark = [pytest.mark.fleet, pytest.mark.chaos]
+
+M, N = 64, 5
+_rng = np.random.default_rng(77)
+A = _rng.standard_normal((M, N))
+RHS = [_rng.standard_normal(M) for _ in range(8)]
+
+
+class FakeServer:
+    """A load-report-only replica: the control loop reads reports and
+    membership, so deterministic loop tests need no real workers."""
+
+    def __init__(self, name, depth=0.0):
+        self.name = name
+        self.depth = depth
+        self.started = False
+        self.stopped = False
+        self.fail_reports = 0  # raise on the next N load_report fetches
+
+    def start(self):
+        self.started = True
+        return self
+
+    def stop(self, timeout=None):
+        self.stopped = True
+
+    def submit(self, request):
+        fut = Future()
+        fut.set_result(
+            {"ok": True, "result": "pong", "trace": {"events": []}}
+        )
+        return fut
+
+    def load_report(self):
+        if self.fail_reports > 0:
+            self.fail_reports -= 1
+            raise OSError("report fetch timed out")
+        return {
+            "queue_depth": self.depth,
+            "max_queue": 64,
+            "worker_alive": self.started and not self.stopped,
+            "throughput": {},
+            "latency": {},
+            "primed": [],
+            "census": {},
+            "signature": 1234,
+        }
+
+
+def _fake_fleet(params, fault_plan=None, cores=2, timeout_s=60.0):
+    router = serve.Router(
+        serve.RouterParams(heartbeat_timeout_s=timeout_s)
+    )
+    core = [FakeServer(f"core-{i}").start() for i in range(cores)]
+    for s in core:
+        router.join(s.name, server=s)
+    spawned = []
+
+    def factory(name):
+        s = FakeServer(name)
+        spawned.append(s)
+        return s
+
+    scaler = serve.Autoscaler(
+        router, factory, params, fault_plan=fault_plan
+    )
+    return router, core, spawned, scaler
+
+
+def _params(**kw):
+    base = dict(
+        min_replicas=2, max_replicas=4, queue_high=4.0, queue_low=1.0,
+        cooldown_ticks=2, idle_ticks=2, drain_timeout_s=30.0,
+    )
+    base.update(kw)
+    return serve.AutoscaleParams(**base)
+
+
+# ---------------------------------------------------------------------------
+# the control loop, deterministically
+
+
+def test_scale_up_on_pressure_with_cooldown_and_max_bound():
+    router, core, spawned, scaler = _fake_fleet(_params())
+    for s in core:
+        s.depth = 10.0
+    d = scaler.step()
+    assert d["action"] == "scale_up" and d["replica"] == "auto-1"
+    assert spawned[0].started  # factory server started BEFORE joining
+    assert router.fleet_report()["members"]["auto-1"]["placeable"]
+    # cooldown: one replica's worth of effect must land first
+    assert [scaler.step()["action"] for _ in range(2)] == [
+        "cooldown", "cooldown",
+    ]
+    # still hot -> second spawn; then the max bound holds the line
+    spawned[0].depth = 10.0
+    assert scaler.step()["action"] == "scale_up"
+    scaler.step(), scaler.step()  # cooldown x2
+    spawned[1].depth = 10.0
+    assert scaler.step()["action"] == "hold"
+    assert len(router.fleet_report()["members"]) == 4
+    router.stop()
+
+
+def test_idle_drain_returns_fleet_to_floor_lifo():
+    router, core, spawned, scaler = _fake_fleet(_params())
+    for s in core:
+        s.depth = 10.0
+    scaler.step()  # -> auto-1
+    scaler.step(), scaler.step()  # cooldown
+    for s in core:
+        s.depth = 10.0
+    scaler.step()  # -> auto-2
+    scaler.step(), scaler.step()  # cooldown
+    for s in core + spawned:
+        s.depth = 0.0
+
+    drained = []
+    for _ in range(16):
+        d = scaler.step()
+        if d["action"] == "scale_down":
+            drained.append(d["replica"])
+        if len(router.fleet_report()["members"]) == 2:
+            break
+    # newest owned replica first (LIFO), drained to zero then removed,
+    # and the owned server is stopped after it leaves
+    assert drained == ["auto-2", "auto-1"]
+    assert all(s.stopped for s in spawned)
+    assert set(router.fleet_report()["members"]) == {"core-0", "core-1"}
+    # at the floor: further idle ticks hold, the core is never drained
+    for _ in range(4):
+        assert scaler.step()["action"] in ("hold", "cooldown")
+    assert set(router.fleet_report()["members"]) == {"core-0", "core-1"}
+    assert not any(s.stopped for s in core)
+    router.stop()
+
+
+def test_spawn_failure_is_a_ledgered_decision_not_a_crash():
+    router = serve.Router()
+    core = [FakeServer("core-0").start()]
+    router.join("core-0", server=core[0])
+
+    def factory(name):
+        raise RuntimeError("no capacity in the cell")
+
+    scaler = serve.Autoscaler(
+        router, factory, _params(min_replicas=1, cooldown_ticks=0)
+    )
+    core[0].depth = 10.0
+    d = scaler.step()
+    assert d["action"] == "scale_up_failed" and "RuntimeError" in d["error"]
+    # the loop keeps deciding; membership is unchanged
+    assert scaler.step()["action"] == "scale_up_failed"
+    assert set(router.fleet_report()["members"]) == {"core-0"}
+    assert any(
+        r["action"] == "scale_up_failed" for r in scaler.ledger
+    )
+    router.stop()
+
+
+def test_drain_timeout_forces_removal_of_wedged_replica():
+    router, core, spawned, scaler = _fake_fleet(
+        _params(cooldown_ticks=0, idle_ticks=1, drain_timeout_s=5.0)
+    )
+    for s in core:
+        s.depth = 10.0
+    scaler.step()  # -> auto-1
+    for s in core:
+        s.depth = 0.0
+    spawned[0].depth = 3.0  # never reaches zero: a wedged queue
+    d = scaler.step()
+    assert d["action"] == "scale_down" and d["replica"] == "auto-1"
+    # within the window the drain waits ...
+    scaler.step()
+    assert "auto-1" in router.fleet_report()["members"]
+    # ... past it the replica is removed anyway and stopped
+    scaler.step(now=time.monotonic() + 6.0)
+    assert "auto-1" not in router.fleet_report()["members"]
+    assert spawned[0].stopped
+    router.stop()
+
+
+def test_report_shape_and_ledger_tail():
+    router, core, spawned, scaler = _fake_fleet(_params())
+    for s in core:
+        s.depth = 10.0
+    scaler.step()
+    rep = scaler.report()
+    assert rep["tick"] == 1 and rep["owned"] == ["auto-1"]
+    assert rep["draining"] == [] and rep["cooldown"] == 2
+    assert rep["params"]["max_replicas"] == 4
+    last = rep["ledger"][-1]
+    assert last["action"] == "scale_up" and last["tick"] == 1
+    assert {"placeable", "mean_depth", "p99_ms"} <= set(last)
+    router.stop()
+
+
+def test_slow_heartbeat_is_stale_but_alive_never_ejected():
+    plan = FleetFaultPlan(slow_heartbeat_at=2, slow_heartbeat_s=1.0)
+    router, core, spawned, scaler = _fake_fleet(_params(), fault_plan=plan)
+    plan.bind_fleet(
+        slow_report=lambda s: setattr(core[0], "fail_reports", 1)
+    )
+    scaler.step()
+    d = scaler.step()  # the fault fires; core-0's fetch fails this sweep
+    # one dropped poll is not a dead replica: still placeable, its last
+    # report stamped with its age
+    assert d["placeable"] == 2
+    member = router.fleet_report()["members"]["core-0"]
+    assert member["placeable"]
+    assert member["report"]["report_age_s"] >= 0.0
+    # the next sweep recovers the live report
+    scaler.step()
+    report = router.fleet_report()["members"]["core-0"]["report"]
+    assert "report_age_s" not in report
+    router.stop()
+
+
+def test_flapping_replica_membership_converges():
+    plan = FleetFaultPlan(flap_at=2, flap_times=2)
+    router, core, spawned, scaler = _fake_fleet(
+        _params(min_replicas=1, idle_ticks=10**6), fault_plan=plan,
+        timeout_s=0.0,
+    )
+    flappers = []
+
+    def kill():
+        core[1].stop()
+
+    def spawn():
+        s = FakeServer(f"flap-{len(flappers)}").start()
+        flappers.append(s)
+        router.join(s.name, server=s)
+
+    plan.bind_fleet(kill=kill, spawn=spawn)
+    transitions = []
+    for _ in range(6):
+        scaler.step()
+        transitions.append(len(router.fleet_report()["members"]))
+    fleet = router.fleet_report()
+    router.stop()
+    # tick 2 killed core-1 (ejected by the sweep), tick 3 spawned a
+    # replacement; membership converged and stayed converged
+    assert transitions[-1] == 2 and transitions[-1] == transitions[-2]
+    assert "core-1" not in fleet["members"]
+    assert "flap-0" in fleet["members"]
+    assert fleet["members"]["flap-0"]["placeable"]
+
+
+# ---------------------------------------------------------------------------
+# chaos drills on real serving replicas
+
+
+def _real_replica():
+    srv = serve.Server(
+        serve.ServeParams(
+            max_coalesce=8, warm_start=False, prime=False
+        ),
+        seed=42,
+    )
+    srv.registry.register_system("sys", A, context=SketchContext(seed=9))
+    return srv
+
+
+def test_die_under_load_drill_restores_capacity_no_visible_114(
+    monkeypatch,
+):
+    """A replica dies abruptly under traffic at tick 2.  The router
+    fails the in-flight work over to survivors, the sweep ejects the
+    corpse, and the autoscaler (p99 target tripped) restores the fleet
+    to two placeable replicas — every caller answer ok throughout."""
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    telemetry.REGISTRY.reset()
+    r1, r2 = _real_replica().start(), _real_replica().start()
+    router = serve.Router(serve.RouterParams(heartbeat_timeout_s=0.0))
+    router.join("r1", server=r1)
+    router.join("r2", server=r2)
+    plan = FleetFaultPlan(die_under_load_at=2)
+    plan.bind_fleet(kill=lambda: r2.stop(0.5))
+    scaler = serve.Autoscaler(
+        router, lambda name: _real_replica(),
+        serve.AutoscaleParams(
+            min_replicas=1, max_replicas=2, queue_high=1e9,
+            queue_low=-1.0, p99_high_ms=1e-4, cooldown_ticks=0,
+            idle_ticks=10**6,
+        ),
+        fault_plan=plan,
+    )
+    responses = []
+    for tick in range(5):
+        responses += [
+            router.call(op="ls_solve", system="sys", b=b)
+            for b in RHS[:2]
+        ]
+        scaler.step()
+    fleet = router.fleet_report()
+    snap = telemetry.snapshot()
+    router.stop()
+    r1.stop()
+    for srv in scaler._owned.values():
+        srv.stop()
+    telemetry.REGISTRY.reset()
+
+    # no caller ever saw a 114 (or any error) while placeable remained
+    assert all(r["ok"] for r in responses)
+    placeable = [
+        n for n, m in fleet["members"].items() if m["placeable"]
+    ]
+    assert len(placeable) == 2 and "r2" not in fleet["members"]
+    assert any(n.startswith("auto-") for n in placeable)
+    assert snap["router"]["ejects"] >= 1  # the corpse was fenced out
+    assert snap["autoscale"]["scale_ups"] >= 1
+
+
+def test_join_storm_every_joiner_fenced_and_placeable():
+    r1 = _real_replica().start()
+    router = serve.Router()
+    router.join("r1", server=r1)
+    joined = []
+
+    def spawn():
+        srv = _real_replica().start()
+        joined.append(srv)
+        router.join(f"storm-{len(joined)}", server=srv)
+
+    plan = FleetFaultPlan(join_storm_at=1, join_storm_size=3)
+    plan.bind_fleet(spawn=spawn)
+    scaler = serve.Autoscaler(
+        router, lambda name: _real_replica(),
+        serve.AutoscaleParams(min_replicas=1, max_replicas=8,
+                              idle_ticks=10**6),
+        fault_plan=plan,
+    )
+    scaler.step()
+    fleet = router.fleet_report()
+    # all three joiners cleared the signature fence and are placeable
+    assert len(fleet["members"]) == 4
+    assert all(m["placeable"] for m in fleet["members"].values())
+    # traffic through the stormed fleet stays clean
+    results = [
+        router.call(op="ls_solve", system="sys", b=b) for b in RHS[:4]
+    ]
+    assert all(r["ok"] for r in results)
+    # a registry-mismatched joiner is still refused outright (109)
+    odd = serve.Server(
+        serve.ServeParams(warm_start=False, prime=False), seed=42
+    )
+    odd.registry.register_system(
+        "other", A, context=SketchContext(seed=9)
+    )
+    odd.start()
+    from libskylark_tpu.utils import exceptions as ex
+
+    with pytest.raises(ex.WorldMismatchError):
+        router.join("odd", server=odd)
+    router.stop()
+    odd.stop()
+    r1.stop()
+    for srv in joined:
+        srv.stop()

@@ -559,3 +559,155 @@ def test_router_counters_fold_into_snapshot(monkeypatch):
     assert snap["router"]["affinity_hits"] == 2
     assert snap["router"]["joins"] == 1
     assert 0.0 <= snap["router"]["affinity_ratio"] <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# serve through change (ISSUE PR 16): races, retries, staleness, autoscale
+
+
+def test_placement_dispatch_race_fails_over_transparently(monkeypatch):
+    """A replica chosen while placeable but stopped before the request
+    reached its worker: the shutdown envelope (112 with no queue depth)
+    fails over to a survivor transparently — the caller never sees it."""
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    telemetry.REGISTRY.reset()
+    r1, r2 = _replica().start(), _replica().start()
+    router = serve.Router()
+    router.join("r1", server=r1)
+    router.join("r2", server=r2)
+    first = router.call(op="ls_solve", system="sys", b=RHS[0])
+    assert first["ok"]
+    pinned = first["trace"]["replica"]
+    survivor = "r2" if pinned == "r1" else "r1"
+    # the pinned replica dies with NO poll in between: the router still
+    # believes it placeable when it places the next request
+    (r1 if pinned == "r1" else r2).stop()
+    resp = router.call(op="ls_solve", system="sys", b=RHS[1])
+    snap = telemetry.snapshot()
+    fleet = router.fleet_report()
+    router.stop()
+    (r1 if survivor == "r1" else r2).stop()
+    telemetry.REGISTRY.reset()
+
+    assert resp["ok"] and resp["trace"]["replica"] == survivor
+    assert snap["router"]["failovers"] >= 1
+    # the corpse was ejected in flight ("shut down in flight")
+    assert pinned not in fleet["members"]
+    assert snap["router"]["ejects"] >= 1
+
+
+def test_http_replica_load_report_retries_with_jittered_backoff(
+    monkeypatch,
+):
+    """ONE dropped connection must not read as a dead heartbeat: the
+    report fetch walks a 3-attempt jittered exponential ladder before
+    surfacing the failure to the ejection logic."""
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    telemetry.REGISTRY.reset()
+    rep = serve.HttpReplica("r", "http://127.0.0.1:1")  # never dialed
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 2:
+            raise OSError("connection reset")
+        return {"load": {"queue_depth": 0, "worker_alive": True}}
+
+    rep._client.healthz = flaky
+    slept: list = []
+    rep._sleep = slept.append
+    rep._jitter = lambda: 0.5  # pin the jitter draw: delay = b * 2^a
+    load = rep.load_report()
+    counters = telemetry.REGISTRY.snapshot()["counters"]
+    assert load["worker_alive"] and calls["n"] == 3
+    assert slept == [pytest.approx(0.05), pytest.approx(0.10)]
+    assert counters.get("router.report_retries") == 2
+
+    # a permanently dead peer exhausts the ladder and raises
+    dead = serve.HttpReplica("d", "http://127.0.0.1:1")
+    dead._client.healthz = flaky  # keeps succeeding -> use a raiser
+    def raiser():
+        raise OSError("refused")
+    dead._client.healthz = raiser
+    dead._sleep = slept.append
+    dead._jitter = lambda: 0.5
+    with pytest.raises(OSError):
+        dead.load_report()
+    assert len(slept) == 2 + 3  # three more backoffs before giving up
+    telemetry.REGISTRY.reset()
+
+
+def test_poll_stale_but_alive_keeps_placing_then_ejects_on_silence():
+    r1 = _replica().start()
+    router = serve.Router(serve.RouterParams(heartbeat_timeout_s=5.0))
+    router.join("r1", server=r1)
+    member = router._members["r1"]
+
+    def hiccup():
+        raise OSError("transport hiccup")
+
+    member.replica.load_report = hiccup
+    now = time.monotonic()
+    # one dropped poll is not a dead replica: still placeable, its last
+    # report honestly stamped with its age
+    assert router.poll_once(now=now + 1.0) == {"r1": True}
+    fleet = router.fleet_report()
+    assert fleet["members"]["r1"]["report"]["report_age_s"] >= 0.9
+    assert router.call(op="ls_solve", system="sys", b=RHS[0])["ok"]
+    # real silence past the timeout: ejected (the 114 ladder)
+    assert router.poll_once(now=now + 10.0) == {}
+    assert router.fleet_report()["members"] == {}
+    router.stop()
+    r1.stop()
+
+
+def test_autoscale_smoke_drill_2_3_2_zero_sheds(monkeypatch):
+    """The tier-1 drill: a 2-replica fleet under traffic scales to 3 on
+    a tripped p99 target, then drains back to 2 when the pressure is
+    declared gone — every caller answer ok, zero sheds, zero 114s, and
+    the scale-down is a clean ledgered leave, never an eject."""
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    telemetry.REGISTRY.reset()
+    r1, r2 = _replica().start(), _replica().start()
+    router = serve.Router()
+    router.join("r1", server=r1)
+    router.join("r2", server=r2)
+    params = serve.AutoscaleParams(
+        min_replicas=2, max_replicas=3, queue_high=1e9, queue_low=1e9,
+        p99_high_ms=1e-4, cooldown_ticks=1, idle_ticks=2,
+        drain_timeout_s=30.0,
+    )
+    scaler = serve.Autoscaler(router, lambda name: _replica(), params)
+
+    responses = [
+        router.call(op="ls_solve", system="sys", b=b) for b in RHS[:3]
+    ]
+    d = scaler.step()  # the p99 target trips: 2 -> 3
+    assert d["action"] == "scale_up"
+    assert len(router.fleet_report()["members"]) == 3
+    responses += [
+        router.call(op="ls_solve", system="sys", b=b) for b in RHS[3:6]
+    ]
+    # pressure declared gone: cooldown, idle streak, drain back to 2
+    params.p99_high_ms = None
+    while len(router.fleet_report()["members"]) > 2 and scaler._tick < 12:
+        responses.append(
+            router.call(op="ls_solve", system="sys",
+                        b=RHS[scaler._tick % len(RHS)])
+        )
+        scaler.step()
+    snap = telemetry.snapshot()
+    fleet = router.fleet_report()
+    router.stop()
+    r1.stop()
+    r2.stop()
+    telemetry.REGISTRY.reset()
+
+    assert all(r["ok"] for r in responses)  # zero sheds, zero 114s
+    assert set(fleet["members"]) == {"r1", "r2"}  # the core survives
+    assert snap["autoscale"]["scale_ups"] == 1
+    assert snap["autoscale"]["scale_downs"] == 1
+    assert snap["autoscale"]["drains_done"] == 1
+    assert snap["router"]["leaves"] == 1  # a clean leave ...
+    assert snap["router"].get("ejects", 0) == 0  # ... never a 114
+    assert snap["serve"].get("shed_admission", 0) == 0

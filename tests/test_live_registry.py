@@ -1,0 +1,308 @@
+"""Live registries (ISSUE PR 16): epoch-versioned in-place updates.
+
+The load-bearing contracts:
+
+- **Graph edge folds are bitwise ≡ re-registration.**  A registered
+  ``GraphSystem`` retains its SJLT and folded sketch; absorbing an edge
+  batch through ``fold_graph_edges`` lands the exact bits a from-scratch
+  registration of the merged graph computes (0/1 adjacency × ±2⁻¹ SJLT
+  values make every partial sum exact dyadic — order-invariant).
+- **LS row appends/downdates are exact ``apply_slice`` deltas** into the
+  retained ``S·A`` (allclose to fresh registration; the QR re-runs on
+  the small (s, n) sketch only).  FJLT-backed systems have no columnwise
+  partial rule and refuse live deltas with a structured UnsupportedError.
+- **In-flight work stays bitwise on the version it admitted under**:
+  ``Entry.entity`` pins the version object at validation, updates mint
+  NEW immutable objects, and the superseded bits keep serving whatever
+  already entered the queue.
+- **Epoch pins are honest**: a request carrying ``registry_epoch`` for a
+  retired (or unminted) version gets a code-116 ``RegistryEpochError``
+  envelope with both epochs — never silently-new bits.
+- **``update`` ops apply exactly once, in admission order** — unique
+  coalesce keys mean they never batch and never solo-retry, so the queue
+  order IS the epoch order.
+"""
+
+import numpy as np
+import pytest
+
+from libskylark_tpu import serve, telemetry
+from libskylark_tpu.core.context import SketchContext
+from libskylark_tpu.graph.graph import SimpleGraph
+from libskylark_tpu.serve.registry import Registry
+from libskylark_tpu.utils import exceptions as ex
+
+pytestmark = pytest.mark.serve
+
+# A ring covers every vertex, so the held-out chords fold into an
+# unchanged vertex interning — the precondition for bitwise fold ≡
+# re-registration (with_edges extends edges over the EXISTING id map).
+N_V = 24
+RING = [(i, (i + 1) % N_V) for i in range(N_V)]
+CHORDS = [(i, (i + 5) % N_V) for i in range(0, N_V, 3)]
+
+M, N = 48, 6
+_rng = np.random.default_rng(11)
+A_LS = _rng.standard_normal((M, N))
+ROWS = _rng.standard_normal((4, N))
+B = _rng.standard_normal(M)
+
+
+def _graph_registry(edges, seed=5, k=4):
+    reg = Registry()
+    gsys = reg.register_graph(
+        "g", SimpleGraph(edges), k=k, context=SketchContext(seed=seed)
+    )
+    return reg, gsys
+
+
+def _ls_registry(A, *, sketch_type="SJLT", capacity=M + 8, seed=3):
+    reg = Registry()
+    system = reg.register_system(
+        "sys", A, context=SketchContext(seed=seed),
+        sketch_type=sketch_type, sketch_size=32, capacity=capacity,
+    )
+    return reg, system
+
+
+def _server(seed=1):
+    srv = serve.Server(
+        serve.ServeParams(warm_start=False, prime=False), seed=seed
+    )
+    srv.registry.register_system(
+        "sys", A_LS, context=SketchContext(seed=9),
+        sketch_type="SJLT", sketch_size=32, capacity=M + 8,
+    )
+    return srv
+
+
+# ---------------------------------------------------------------------------
+# graph folds: the bitwise contract
+
+
+def test_graph_fold_bitwise_equals_reregistration():
+    reg, base = _graph_registry(RING)
+    new, rec = reg.fold_graph_edges("g", CHORDS)
+    _, ref = _graph_registry(RING + CHORDS)
+
+    assert rec["kind"] == "graph_fold" and rec["edges"] == len(CHORDS)
+    assert new is reg.graphs["g"] and new.epoch == 2
+    # the retained-sketch fold lands the exact bits a from-scratch
+    # registration of the merged graph computes
+    assert np.array_equal(np.asarray(new._sa), np.asarray(ref._sa))
+    assert np.array_equal(new.X, ref.X)
+    assert np.array_equal(new.lam, ref.lam)
+    # the superseded version object is untouched (in-flight bits);
+    # volume counts directed arcs, two per undirected edge
+    assert base.G.volume == 2 * len(RING) and base.epoch == 1
+    assert new.G.volume == 2 * (len(RING) + len(CHORDS))
+    assert [r["kind"] for r in reg.epoch_log] == ["register", "graph_fold"]
+
+
+def test_graph_refold_of_held_edges_is_a_noop():
+    reg, g0 = _graph_registry(RING)
+    # an already-held edge and its reverse: both collapse to nothing
+    new, rec = reg.fold_graph_edges("g", [RING[0], (1, 0)])
+    assert rec["edges"] == 0
+    assert new._sa is g0._sa  # no refold, arrays carried by reference
+    assert np.array_equal(new.X, g0.X)
+
+
+# ---------------------------------------------------------------------------
+# LS systems: append / downdate deltas
+
+
+def test_ls_append_matches_fresh_registration():
+    reg, old = _ls_registry(A_LS)
+    new, rec = reg.append_system_rows("sys", ROWS)
+    assert rec["kind"] == "row_append" and rec["rows"] == 4
+    assert new.m == M + 4 and old.m == M  # superseded version frozen
+    assert new.epoch == 2 and reg.systems["sys"] is new
+
+    # reference: fresh registration of the merged matrix with the SAME
+    # sketch object (same capacity domain)
+    ref = Registry().register_system(
+        "sys", np.vstack([A_LS, ROWS]), context=SketchContext(seed=0),
+        sketch=old.S, capacity=M + 8,
+    )
+    assert np.allclose(np.asarray(new.SA), np.asarray(ref.SA))
+    assert np.allclose(np.asarray(new.R), np.asarray(ref.R))
+
+    # appends past the reserved capacity refuse with a structured error
+    with pytest.raises(ex.InvalidParameters):
+        reg.append_system_rows("sys", np.ones((20, N)))
+
+
+def test_ls_downdate_retires_rows_exactly_once():
+    reg, old = _ls_registry(A_LS)
+    new, rec = reg.downdate_system_rows("sys", [3, 17])
+    assert rec["kind"] == "row_downdate" and rec["retired"] == 2
+    assert new.retired == frozenset({3, 17}) and old.retired == frozenset()
+
+    A_zeroed = A_LS.copy()
+    A_zeroed[[3, 17]] = 0.0
+    ref = Registry().register_system(
+        "sys", A_zeroed, context=SketchContext(seed=0),
+        sketch=old.S, capacity=M + 8,
+    )
+    assert np.allclose(np.asarray(new.SA), np.asarray(ref.SA))
+    # retiring an already-retired row is a caller error, not a no-op
+    with pytest.raises(ex.InvalidParameters):
+        reg.downdate_system_rows("sys", [3])
+
+
+def test_fjlt_backed_system_refuses_live_append():
+    reg, _ = _ls_registry(A_LS, sketch_type="FJLT")
+    with pytest.raises(ex.UnsupportedError):
+        reg.append_system_rows("sys", ROWS)
+
+
+# ---------------------------------------------------------------------------
+# epoch pinning: in-flight bits and the code-116 fence
+
+
+def test_inflight_request_pinned_to_admitted_epoch_bitwise():
+    live, ref = _server(), _server()
+    # admit BEFORE the worker starts, then move the registry head
+    fut = live.submit(serve.make_request("ls_solve", system="sys", b=B))
+    live.registry.append_system_rows("sys", ROWS)
+    live.start()
+    got = fut.result()
+    live.stop()
+
+    ref.start()
+    want = ref.call(serve.make_request("ls_solve", system="sys", b=B))
+    ref.stop()
+
+    assert got["ok"] and want["ok"]
+    # bitwise: the queued request served the version it admitted under
+    assert np.array_equal(
+        np.asarray(got["result"]), np.asarray(want["result"])
+    )
+    assert got["trace"]["registry_epoch"] == 1
+    assert live.registry.get_system("sys").epoch == 2
+
+
+def test_retired_epoch_pin_gets_code_116_envelope():
+    srv = _server().start()
+    try:
+        ok = srv.call(
+            op="ls_solve", system="sys", b=B, registry_epoch=1
+        )
+        assert ok["ok"]  # pinning the CURRENT epoch is honored
+        srv.registry.append_system_rows("sys", ROWS)
+        resp = srv.call(
+            op="ls_solve", system="sys", b=B, registry_epoch=1
+        )
+    finally:
+        srv.stop()
+    assert not resp["ok"]
+    err = resp["error"]
+    assert err["code"] == 116
+    assert err["requested"] == 1 and err["current"] == 2
+    assert err["entity"] == "sys"
+    with pytest.raises(ex.RegistryEpochError):
+        serve.raise_for_error(resp)
+
+
+# ---------------------------------------------------------------------------
+# the update op: served mutations, exactly once, in admission order
+
+
+def test_update_op_applies_exactly_once_in_admission_order():
+    srv = _server(seed=2)
+    srv.registry.register_graph(
+        "g", SimpleGraph(RING), k=4, context=SketchContext(seed=5)
+    )
+    # three mutations queued BEFORE the worker starts: each must apply
+    # exactly once, in admission order, never coalescing
+    f1 = srv.submit({"op": "update", "graph": "g", "edges": CHORDS})
+    f2 = srv.submit({"op": "update", "system": "sys",
+                     "append": ROWS.tolist()})
+    f3 = srv.submit({"op": "update", "system": "sys", "drop": [0]})
+    srv.start()
+    r1, r2, r3 = f1.result(), f2.result(), f3.result()
+    srv.stop()
+
+    assert r1["ok"] and r2["ok"] and r3["ok"]
+    assert r1["result"]["kind"] == "graph_fold"
+    assert r1["result"]["edges"] == len(CHORDS)
+    assert r2["result"]["kind"] == "row_append"
+    assert r2["result"]["rows"] == 4
+    assert r3["result"]["kind"] == "row_downdate"
+    # 2 registrations then 3 updates: the queue order IS the epoch order
+    assert [r["result"]["epoch"] for r in (r1, r2, r3)] == [3, 4, 5]
+    assert srv.registry.epoch == 5
+    assert srv.registry.get_system("sys").m == M + 4
+    assert srv.registry.get_system("sys").retired == frozenset({0})
+    assert not any(r["trace"]["coalesced"] for r in (r1, r2, r3))
+
+
+def test_update_op_validates_targets_at_the_door():
+    srv = _server(seed=3)
+    srv.start()
+    try:
+        both = srv.call(op="update", system="sys", append=[[0.0] * N],
+                        drop=[1])
+        neither = srv.call(op="update")
+        unknown = srv.call(op="update", graph="nope", edges=[(0, 1)])
+    finally:
+        srv.stop()
+    for resp in (both, neither, unknown):
+        assert not resp["ok"] and resp["error"]["code"] == 102
+
+
+# ---------------------------------------------------------------------------
+# model updates (server-side API) and the telemetry fold
+
+
+def test_update_model_center_deltas_and_swap():
+    from libskylark_tpu.ml.kernels import GaussianKernel
+    from libskylark_tpu.ml.model import KernelModel
+
+    rng = np.random.default_rng(8)
+    km = KernelModel(
+        GaussianKernel(12, sigma=1.1),
+        rng.standard_normal((24, 12)),
+        rng.standard_normal((24, 3)),
+    )
+    reg = Registry()
+    reg.register_model("krr", km)
+    xq = rng.standard_normal((3, 12))
+    base = np.asarray(km.predict(xq))
+
+    X_new = rng.standard_normal((2, 12))
+    A_new = rng.standard_normal((2, 3))
+    m2, rec = reg.update_model("krr", append=(X_new, A_new))
+    assert rec["kind"] == "model_update" and rec["appended"] == 2
+    assert np.asarray(m2.X_train).shape[0] == 26
+    # predict is linear in the center rows: the delta is exact
+    delta = KernelModel(km.kernel, X_new, A_new)
+    assert np.allclose(
+        np.asarray(m2.predict(xq)), base + np.asarray(delta.predict(xq))
+    )
+
+    m3, rec = reg.update_model("krr", drop=[24, 25])
+    assert rec["dropped"] == 2
+    assert np.allclose(np.asarray(m3.predict(xq)), base)
+
+    _, rec = reg.update_model("krr", model=km)
+    assert rec["swapped"] is True
+    assert reg.epoch == 4
+    with pytest.raises(ex.InvalidParameters):
+        reg.update_model("krr", model=km, drop=[0])
+
+
+def test_registry_epoch_counters_fold_into_snapshot(monkeypatch):
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    telemetry.REGISTRY.reset()
+    reg, _ = _graph_registry(RING)
+    reg.fold_graph_edges("g", CHORDS)
+    ls_reg, _ = _ls_registry(A_LS)
+    ls_reg.append_system_rows("sys", ROWS)
+    snap = telemetry.snapshot()
+    telemetry.REGISTRY.reset()
+    assert snap["registry"]["epoch.bumps"] == 4
+    assert snap["registry"]["epoch.register"] == 2
+    assert snap["registry"]["epoch.graph_fold"] == 1
+    assert snap["registry"]["epoch.row_append"] == 1
