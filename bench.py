@@ -900,6 +900,151 @@ def bench_admm(on_tpu, table):
     )
 
 
+def bench_train(on_tpu, table):
+    """Distributed-training rows (docs/distributed_training.md): (a)
+    end-to-end world=1 elastic BlockADMM training throughput (rows/s:
+    stream + factor + iterate) vs the in-process
+    ``BlockADMMSolver.train`` on the SAME data/maps/params —
+    ``vs_baseline`` is distributed/in-process rows/s (the world=1 model
+    is bitwise the in-process one, so the ratio prices the elastic
+    plumbing alone); (b) kill-to-first-consensus resume latency: the
+    training loop is preempted right after a committed ADMM chunk, the
+    world restarts with ``resume=True``, and the value is wall-seconds
+    from the kill to the FIRST post-resume train-chunk commit (a train
+    chunk commits only after its final consensus merge) — the restore +
+    re-stream + re-factor latency a preempted world pays before forward
+    progress resumes; first capture, vs_baseline fixed at 1.0; (c)
+    bf16-vs-f32 train step: marginal s/iter of the fused rank step at
+    ``compute_dtype=bf16`` on identical streamed blocks, with
+    ``vs_baseline`` the f32/bf16 per-iteration speedup."""
+    import tempfile
+
+    from libskylark_tpu.ml import (
+        ADMMParams,
+        BlockADMMSolver,
+        GaussianKernel,
+        prepare_rank_admm,
+        rank_chunked_solver,
+        stream_feature_blocks,
+    )
+    from libskylark_tpu.ml.distributed import DistributedBlockADMMTrainer
+    from libskylark_tpu.resilient import FaultPlan, SimulatedPreemption
+    from libskylark_tpu.streaming import ElasticParams, RowPartition
+
+    if on_tpu:
+        n, d, s, P, iters, br = 131_072, 64, 512, 8, 40, 8192
+    else:
+        n, d, s, P, iters, br = 4096, 16, 64, 4, 8, 512
+    rng = np.random.default_rng(29)
+    X = np.asarray(rng.standard_normal((n, d)), np.float32)
+    y = np.asarray(rng.standard_normal(n), np.float32)
+    ctx = SketchContext(seed=29)
+    kernel = GaussianKernel(d, sigma=2.0)
+    maps = [kernel.create_rft(s, "regular", ctx) for _ in range(2)]
+    params = ADMMParams(rho=1.0, lam=0.01, maxiter=iters, data_partitions=P)
+    part = RowPartition(nrows=n, batch_rows=br, world_size=1)
+
+    def source(start):
+        def gen():
+            for b in range(start, part.num_batches):
+                lo = b * br
+                yield X[lo : lo + br], y[lo : lo + br]
+
+        return gen()
+
+    # (a) rows/s through the elastic trainer vs the in-process solver.
+    # Both time one full train() including its per-call trace+compile —
+    # the same contract either entry point gives a fresh caller.
+    t0 = time.perf_counter()
+    m_ref = BlockADMMSolver("squared", "l2", maps, params).train(
+        jnp.asarray(X), jnp.asarray(y), regression=True
+    )
+    jax.block_until_ready(m_ref.W)
+    t_base = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    m_dist, _ = DistributedBlockADMMTrainer(
+        "squared", "l2", maps, params, ElasticParams(prefetch=0)
+    ).train(source, part, regression=True)
+    jax.block_until_ready(m_dist.W)
+    t_dist = time.perf_counter() - t0
+    _emit(
+        f"distributed ADMM train {n}x{d}->2x{s} P={P} (world=1)",
+        n / t_dist,
+        "rows/s",
+        t_base / t_dist,
+        table,
+        contention=None,  # single end-to-end interval per entry point
+    )
+
+    # (b) kill right after a committed train chunk, resume, stamp the
+    # first post-resume commit (= first completed consensus chunk).
+    class _FirstCommit(FaultPlan):
+        def __init__(self):
+            super().__init__()
+            self.t = None
+
+        def after_commit(self, chunk):
+            if self.t is None:
+                self.t = time.perf_counter()
+
+    with tempfile.TemporaryDirectory() as root:
+        ck = dict(checkpoint_dir=root, checkpoint_every=2, prefetch=0)
+        try:
+            DistributedBlockADMMTrainer(
+                "squared", "l2", maps, params, ElasticParams(**ck)
+            ).train(
+                source, part, regression=True,
+                train_fault_plan=FaultPlan(preempt_after_chunk=0),
+            )
+            raise RuntimeError("train preemption never fired")
+        except SimulatedPreemption:
+            t_kill = time.perf_counter()
+        first = _FirstCommit()
+        DistributedBlockADMMTrainer(
+            "squared", "l2", maps, params, ElasticParams(resume=True, **ck)
+        ).train(source, part, regression=True, train_fault_plan=first)
+    _emit(
+        "train resume kill-to-first-consensus (world=1)",
+        first.t - t_kill,
+        "s",
+        1.0,
+        table,
+        contention=None,  # single wall-clock interval, not pooled
+    )
+
+    # (c) marginal s/iter of the fused rank step, bf16 vs f32, on the
+    # SAME streamed blocks (stream once, factor per dtype; iteration 0
+    # absorbs the compile, the rest are steady-state).
+    Z_rows, Y_rows, _ = stream_feature_blocks(
+        source, maps, part, ElasticParams(prefetch=0), targets=1
+    )
+
+    def per_iter(cd):
+        prep = prepare_rank_admm(
+            "squared", "l2", maps, params, part, 0, Z_rows, Y_rows,
+            regression=True, compute_dtype=cd,
+        )
+        solver = rank_chunked_solver(prep, maps, params)
+        st = solver.step_chunk(solver.init_state(), 1)  # compile + warm
+        jax.block_until_ready(st["inner"][0])
+        k = iters - 1
+        t0 = time.perf_counter()
+        st = solver.step_chunk(st, k)
+        jax.block_until_ready(st["inner"][0])
+        return (time.perf_counter() - t0) / k
+
+    t_f32 = per_iter(None)
+    t_bf16 = per_iter(jnp.bfloat16)
+    _emit(
+        f"distributed train step bf16 P={P} 2x{s} feats",
+        t_bf16,
+        "s/iter",
+        t_f32 / t_bf16,
+        table,
+        contention=None,  # custom timing loop — no burst spread measured
+    )
+
+
 def bench_serve(on_tpu, table):
     """Serving SLO (docs/serving.md): sustained single-row QPS through
     the cross-request coalescing server vs the SAME server pinned serial
@@ -2302,7 +2447,12 @@ def main() -> None:
     # FJLT f32 row also moves up — it is the round-5 fused-kernel
     # measurement).  Rows with round-2/3 captures queue behind them.
     secondaries = [
-        # Round-16 rows lead (never captured): chaos-driven autoscaler +
+        # Round-17 rows lead (never captured): elastic multi-host
+        # BlockADMM training (docs/distributed_training.md) — world=1
+        # rows/s vs the in-process solver, kill-to-first-consensus
+        # resume latency, and the bf16 train-step submetric.
+        ("distributed train", 120, lambda: bench_train(on_tpu, table)),
+        # Round-16 rows next (never captured): chaos-driven autoscaler +
         # epoch-versioned live registries (docs/serving.md, "serve
         # through change") — live fold/append epoch-bump latency,
         # scale-up reaction, and rolling-drain QPS.

@@ -71,6 +71,34 @@ def main(argv=None) -> int:
     p.add_argument("--resume", action="store_true",
                    help="resume training from the newest valid checkpoint "
                         "in --checkpoint-dir")
+    p.add_argument("--distributed", action="store_true",
+                   help="train over the jax.distributed world (every rank "
+                        "runs this same command; each streams its own row "
+                        "partition of --trainfile, one psum per ADMM "
+                        "iteration merges consensus; --checkpoint-dir "
+                        "becomes the shared root of per-host stream + "
+                        "train state; --datapartitions must align with "
+                        "the world so every rank owns whole partitions)")
+    p.add_argument("--batch-rows", type=int, default=256,
+                   help="with --distributed: rows per streamed training "
+                        "batch (partition granularity)")
+    p.add_argument("--resume-policy", default="strict",
+                   choices=["strict", "repartition"],
+                   help="with --distributed --resume: 'strict' demands "
+                        "the same world size as the interrupted run "
+                        "(exit on mismatch, code 109); 'repartition' "
+                        "re-streams each rank's NEW share at a bumped "
+                        "epoch (feature buffers are positional, not "
+                        "mergeable) and trains fresh under it, keeping "
+                        "the recovery itself resumable")
+    p.add_argument("--collective-timeout", type=float, default=None,
+                   metavar="SECONDS",
+                   help="with --distributed: deadline for cross-host "
+                        "collectives (handshake, consensus psum); a hung "
+                        "or straggling peer raises CollectiveTimeoutError "
+                        "(code 110) naming the stragglers instead of "
+                        "hanging forever (default: no deadline, or "
+                        "SKYLARK_COLLECTIVE_TIMEOUT_S)")
     add_perf_args(p)
     add_policy_args(p)
     add_telemetry_args(p)
@@ -136,7 +164,60 @@ def main(argv=None) -> int:
                 args.valfile, args.fileformat, args.sparse, n_features=d
             )
         t0 = time.perf_counter()
-        if args.checkpoint_dir:
+        if args.distributed:
+            # Elastic multi-host path: every rank runs this same command,
+            # streams its row partition, trains in lockstep (one psum per
+            # outer iteration), and holds the identical model at the end.
+            from ..ml.distributed import DistributedBlockADMMTrainer
+            from ..streaming import ElasticParams, RowPartition, world_info
+
+            if args.valfile:
+                print("warning: --valfile is ignored under --distributed "
+                      "(score the saved model instead)", file=sys.stderr)
+            rank, world = world_info()
+            partition = RowPartition(
+                nrows=n, batch_rows=args.batch_rows, world_size=world
+            )
+            b0, b1 = partition.batch_range(rank)
+            print(f"Distributed train: rank {rank}/{world} owns batches "
+                  f"[{b0}, {b1}) of {partition.num_batches} "
+                  f"(resume policy: {args.resume_policy})")
+            Xd = np.asarray(X) if not is_sparse else X
+
+            def source(start):
+                def it():
+                    for bi in range(start, partition.num_batches):
+                        lo = bi * args.batch_rows
+                        hi = min(lo + args.batch_rows, n)
+                        yield Xd[lo:hi], np.asarray(y)[lo:hi]
+                return it()
+
+            trainer = DistributedBlockADMMTrainer(
+                args.lossfunction, args.regularizer, maps, solver.params,
+                ElasticParams(
+                    checkpoint_dir=args.checkpoint_dir,
+                    checkpoint_every=args.checkpoint_every,
+                    resume=args.resume,
+                    resume_policy=args.resume_policy,
+                    collective_timeout_s=args.collective_timeout,
+                ),
+            )
+            classes = (
+                None if args.regression else np.unique(np.asarray(y))
+            )
+            model, dinfo = trainer.train(
+                source, partition, classes=classes,
+                regression=args.regression,
+            )
+            replays = sum(
+                1
+                for a in dinfo["recovery"]["attempts"]
+                if a.get("action") == "replay"
+            )
+            print(f"Train report: iters={dinfo['iters']} "
+                  f"consensus_residual={dinfo['consensus_residual']:.6e} "
+                  f"precision={dinfo['precision']} replays={replays}")
+        elif args.checkpoint_dir:
             # Preemption-safe path: host rounds of --checkpoint-every ADMM
             # iterations, a rotated CRC-guarded checkpoint after each.
             # Per-iteration validation scoring is a train()-only feature.

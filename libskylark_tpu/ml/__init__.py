@@ -4,6 +4,13 @@ persistence."""
 
 from .admm import ADMMParams, BlockADMMSolver
 from .coding import decode_labels, dummy_coding
+from .distributed import (
+    DistributedBlockADMMTrainer,
+    prepare_rank_admm,
+    rank_chunked_solver,
+    stream_feature_blocks,
+    validate_train_partition,
+)
 from .distances import (
     euclidean_distance_matrix,
     expsemigroup_distance_matrix,
@@ -73,6 +80,11 @@ __all__ = [
     "SketchPCR",
     "ADMMParams",
     "BlockADMMSolver",
+    "DistributedBlockADMMTrainer",
+    "prepare_rank_admm",
+    "rank_chunked_solver",
+    "stream_feature_blocks",
+    "validate_train_partition",
     "FeatureMapModel",
     "KernelModel",
     "load_model",

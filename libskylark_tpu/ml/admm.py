@@ -325,6 +325,11 @@ class BlockADMMSolver:
         :meth:`_prepare` on resume (deterministic: counter-based maps,
         pinned-precision factor products), so a run resumed from a chunk
         boundary is bit-identical to the uninterrupted chunked run.
+        That kill/resume bit-identity — and the chunked-vs-``train()``
+        model parity it rides on — is PINNED by
+        ``tests/test_distributed_train.py::TestChunkedContract`` (the
+        distributed trainer's per-rank loop reuses this exact
+        ``init_state/step_chunk/extract_result`` shape).
 
         Validation scoring is a ``train``-only feature; drive this with
         ``resilient.ResilientRunner`` and score the returned model.

@@ -71,7 +71,7 @@ _COMFORT_MARGIN = 16.0
 class ProblemSignature:
     """What the dispatcher is allowed to see of a problem: its tags."""
 
-    kind: str  # "ls" | "ls_stream" | "krr"
+    kind: str  # "ls" | "ls_stream" | "krr" | "train"
     m: int
     n: int
     targets: int = 1
@@ -130,6 +130,11 @@ def _default_decision(sig: ProblemSignature) -> Decision:
         # n is the feature count the caller fixed; the route is the
         # Cholesky normal-equations solve.  Only precision is decidable.
         return Decision("cholesky", "-", sig.n, key=sig.key)
+    if sig.kind == "train":
+        # n is the total random-feature count the trainer's maps fixed;
+        # the route is the BlockADMM consensus trainer.  Only the
+        # precision rung is decidable.
+        return Decision("admm", "-", sig.n, key=sig.key)
     raise ValueError(f"unknown problem kind {sig.kind!r}")
 
 
@@ -269,7 +274,7 @@ def choose_route(
     if (
         sig.dtype == "float32"
         and not sig.sparse
-        and sig.kind in ("ls", "krr")
+        and sig.kind in ("ls", "krr", "train")
         and d.route != "refine"  # refine owns its precision rung
         and healthy
         and int(bf.get("fail", 0)) == 0

@@ -135,6 +135,16 @@ def snapshot(fleet: bool = False, root=None) -> dict:
         # epoch.misses = code-116 refusals) — present only once an
         # entity registered or mutated.
         snap["registry"] = registry_live
+    train = {
+        k.split(".", 1)[1]: v
+        for k, v in counters.items()
+        if k.startswith("train.")
+    }
+    if train:
+        # Distributed-training counters (runs, iterations, consensus
+        # merges, escalations, repartitions, registered hand-offs) —
+        # present only when a trainer ran.
+        snap["train"] = train
     return snap
 
 

@@ -35,6 +35,15 @@ composes scenarios without new scripts:
   so every fold is exact integer arithmetic in float64 and a
   repartitioned resume must match an uninterrupted run at the NEW
   world size bit-for-bit.
+- ``ELASTIC_TRAIN=1``: run the distributed BlockADMM TRAINING scenario
+  instead (``DistributedBlockADMMTrainer`` over the same world): each
+  rank streams its feature blocks, trains in lockstep (one consensus
+  psum per outer iteration), and saves its model ``W`` as
+  ``x-<rank>.npy`` — same artifact names, so the parent's kill/resume
+  bit-identity machinery drives both scenarios.  The
+  ``ELASTIC_KILL_*`` knobs kill mid-STREAM (feature pass);
+  ``ELASTIC_TRAIN_KILL_AFTER_CHUNK`` kills after that ADMM checkpoint
+  chunk commits instead (mid-TRAINING).
 """
 
 from __future__ import annotations
@@ -146,6 +155,77 @@ def main() -> int:
         resume_policy=os.environ.get("ELASTIC_RESUME_POLICY", "strict"),
         collective_timeout_s=float(timeout_env) if timeout_env else None,
     )
+    if os.environ.get("ELASTIC_TRAIN") == "1":
+        from libskylark_tpu.ml import GaussianKernel
+        from libskylark_tpu.ml.admm import ADMMParams
+        from libskylark_tpu.ml.distributed import DistributedBlockADMMTrainer
+
+        # Regression targets: no global class set to thread through.
+        y = rng.standard_normal(NROWS)
+        blocks_t = [
+            (jnp.asarray(A[lo : lo + BATCH_ROWS]),
+             jnp.asarray(y[lo : lo + BATCH_ROWS]))
+            for lo in range(0, NROWS, BATCH_ROWS)
+        ]
+
+        def train_factory(start: int):
+            return iter(blocks_t[start:])
+
+        kern = GaussianKernel(NCOLS, 2.0)
+        ctx = SketchContext(seed=17)
+        maps = [kern.create_rft(16, "regular", ctx) for _ in range(2)]
+        # data_partitions=4 keeps every rank boundary on a partition
+        # boundary for worlds 2 and 4 (96 rows -> ni=24; rank shares of
+        # 48 or 24 rows are whole partitions).
+        trainer = DistributedBlockADMMTrainer(
+            "squared", "l2", maps,
+            ADMMParams(rho=1.0, lam=0.01, maxiter=6, data_partitions=4),
+            params,
+        )
+        train_kill = int(os.environ.get("ELASTIC_TRAIN_KILL_AFTER_CHUNK", "-1"))
+
+        class TrainKillPlan(FaultPlan):
+            def after_commit(self, chunk: int) -> None:
+                if chunk == train_kill:
+                    sys.stdout.flush()
+                    os.kill(os.getpid(), signal.SIGKILL)
+
+        train_plan = (
+            TrainKillPlan()
+            if (proc_id == kill_rank and train_kill >= 0)
+            else None
+        )
+        try:
+            model, info = trainer.train(
+                train_factory, part, regression=True, fault_plan=plan,
+                train_fault_plan=train_plan,
+            )
+        except CollectiveTimeoutError as e:
+            print(
+                f"ELASTIC-TIMEOUT phase={e.phase} "
+                f"stragglers={e.stragglers}",
+                flush=True,
+            )
+            os._exit(110)
+        except StaleEpochError:
+            print("ELASTIC-STALE-EPOCH", flush=True)
+            os._exit(111)
+        np.save(
+            os.path.join(out_dir, f"x-{proc_id}.npy"), np.asarray(model.W)
+        )
+        keys = ("rows", "batches", "local_batches", "world_size", "rank",
+                "iters", "consensus_residual", "precision")
+        dump = {k: info[k] for k in keys}
+        if info.get("replay") is not None:
+            dump["replay"] = info["replay"]
+        with open(
+            os.path.join(out_dir, f"info-{proc_id}.json"), "w",
+            encoding="utf-8",
+        ) as fh:
+            json.dump(dump, fh)
+        print("ELASTIC-OK", flush=True)
+        jax.distributed.shutdown()
+        return 0
     try:
         x, info = distributed_sketch_least_squares(
             factory, S, ncols=NCOLS, partition=part, params=params,

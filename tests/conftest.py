@@ -80,6 +80,12 @@ REFINE_TIMEOUT_S = 120
 # served PPR/embed queries behind the worker thread; a wedged fold or
 # an unresolved future must not stall the tier-1 run.
 GRAPH_TIMEOUT_S = 120
+# Distributed-training tests stream feature blocks through elastic
+# folds, run multi-chunk ADMM under the resilient runner (including
+# kill/resume rounds), and simulate consensus merges across ranks in
+# one process; a wedged stream or a resume that waits on a checkpoint
+# that never lands must not stall the tier-1 run.
+TRAIN_TIMEOUT_S = 180
 
 _TIMEOUT_MARKS = {
     "faults": FAULTS_TIMEOUT_S,
@@ -96,6 +102,7 @@ _TIMEOUT_MARKS = {
     "fleet": FLEET_TIMEOUT_S,
     "refine": REFINE_TIMEOUT_S,
     "graph": GRAPH_TIMEOUT_S,
+    "train": TRAIN_TIMEOUT_S,
 }
 
 
@@ -194,6 +201,13 @@ def pytest_configure(config):
         "graph: graph-analytics tests (streamed edge-list folds, chained "
         "sharded sketches, streaming ASE, served PPR/embed queries); "
         f"tier-1, guarded by a per-test {GRAPH_TIMEOUT_S}s timeout",
+    )
+    config.addinivalue_line(
+        "markers",
+        "train: distributed kernel-machine training tests (world=1 "
+        "bitwise parity, simulated-rank consensus, kill/resume through "
+        "the ADMM loop, guard recovery mid-stream); tier-1, guarded by "
+        f"a per-test {TRAIN_TIMEOUT_S}s timeout",
     )
 
 

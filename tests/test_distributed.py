@@ -544,3 +544,115 @@ def test_elastic_hung_rank_raises_timeout(tmp_path):
     )
     assert "ELASTIC-TIMEOUT" in out0
     assert "stragglers=[1]" in out0
+
+
+# ---------------------------------------------------------------------------
+# distributed TRAINING: SIGKILL one rank mid-stream, resume the world
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.distributed_streaming
+def test_train_kill_one_rank_resume_bitwise(tmp_path):
+    """SIGKILL one rank of a distributed BlockADMM TRAINING run during
+    its feature-streaming pass, restart the world with ``resume=1``: the
+    trained model ``W`` must be bit-identical to an uninterrupted run's
+    on every rank (``ELASTIC_TRAIN=1`` drives ``_elastic_child.py``'s
+    train scenario; same ``x-<rank>.npy`` artifact contract as the
+    streaming kill test)."""
+    import json
+    import time
+
+    import numpy as np
+
+    from libskylark_tpu.streaming import host_dir, read_progress
+    from libskylark_tpu.streaming.elastic import PROGRESS_NAME
+
+    global _ENV_SKIP
+    if _ENV_SKIP is not None:
+        pytest.skip(_ENV_SKIP)
+    nprocs, kill_rank, kill_after = 2, 1, 1
+    train_env = {"ELASTIC_TRAIN": "1"}
+
+    # -- run A: uninterrupted reference world -----------------------------
+    out_a = tmp_path / "out-a"
+    out_a.mkdir()
+    procs = _spawn_elastic(
+        nprocs, _free_port(), tmp_path / "ck-a", out_a, resume=False,
+        extra_env=train_env,
+    )
+    _communicate_or_skip(procs, nprocs, "train reference")
+
+    # -- run B1: SIGKILL rank 1 mid-stream ---------------------------------
+    root_b = tmp_path / "ck-b"
+    out_b1 = tmp_path / "out-b1"
+    out_b1.mkdir()
+    procs = _spawn_elastic(
+        nprocs, _free_port(), root_b, out_b1, resume=False,
+        extra_env={
+            **train_env,
+            "ELASTIC_KILL_RANK": str(kill_rank),
+            "ELASTIC_KILL_AFTER_CHUNK": str(kill_after),
+        },
+    )
+    try:
+        rc = procs[kill_rank].wait(timeout=_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+            p.communicate()
+        pytest.skip(
+            f"train kill run did not start within {_TIMEOUT_S}s"
+        )
+    if rc != -9:
+        _, err = procs[kill_rank].communicate()
+        for p in procs:
+            p.kill()
+            p.communicate()
+        if any(m in err for m in _SKIP_MARKERS):
+            pytest.skip(
+                "jax.distributed unsupported in this environment: "
+                + err.strip().splitlines()[-1][:300]
+            )
+        raise AssertionError(
+            f"killed rank exited rc={rc} before the injected SIGKILL:\n"
+            f"{err[-3000:]}"
+        )
+    # The survivor finishes its local STREAM fold, then blocks in the
+    # first consensus psum waiting on the dead rank — wait for its
+    # ledger's "done", then put it down (whole-world restart protocol).
+    survivor = 1 - kill_rank
+    deadline = time.monotonic() + _TIMEOUT_S
+    while time.monotonic() < deadline:
+        recs = read_progress(
+            os.path.join(host_dir(root_b, survivor), PROGRESS_NAME)
+        )
+        if any(rec["name"] == "done" for rec in recs) \
+                or procs[survivor].poll() is not None:
+            break
+        time.sleep(0.2)
+    procs[survivor].kill()
+    procs[survivor].communicate()
+
+    # -- run B2: restart the whole world with resume ----------------------
+    out_b2 = tmp_path / "out-b2"
+    out_b2.mkdir()
+    procs = _spawn_elastic(
+        nprocs, _free_port(), root_b, out_b2, resume=True,
+        extra_env=train_env,
+    )
+    _communicate_or_skip(procs, nprocs, "train resume")
+
+    # -- bit-identity: every rank's model matches the reference -----------
+    for r in range(nprocs):
+        want = np.load(out_a / f"x-{r}.npy")
+        got = np.load(out_b2 / f"x-{r}.npy")
+        np.testing.assert_array_equal(got, want)
+        with open(out_a / f"info-{r}.json") as fh:
+            winfo = json.load(fh)
+        with open(out_b2 / f"info-{r}.json") as fh:
+            ginfo = json.load(fh)
+        assert ginfo == winfo
+    # ...and W is identical ACROSS ranks (consensus psum, no broadcast)
+    w0 = np.load(out_b2 / "x-0.npy")
+    for r in range(1, nprocs):
+        np.testing.assert_array_equal(np.load(out_b2 / f"x-{r}.npy"), w0)

@@ -37,6 +37,31 @@ _GUARD_NAMES = [
 ]
 
 
+def _metadata_answers() -> bool:
+    """One cheap GET against the GCE metadata server's ``tpu-env`` key.
+
+    True only when it answers fast — the case where libtpu's own tpu-env
+    queries inside backend init are also fast.  A server that 403s (or a
+    host with no metadata route) makes libtpu retry EVERY variable 30
+    times: ~8.5 min of stall inside the guard child, which alone eats
+    the whole tier-1 wall budget on a TPU-less box.
+    """
+    import urllib.request
+
+    try:
+        urllib.request.urlopen(
+            urllib.request.Request(
+                "http://metadata.google.internal/computeMetadata/v1/"
+                "instance/attributes/tpu-env",
+                headers={"Metadata-Flavor": "Google"},
+            ),
+            timeout=3,
+        ).read()
+        return True
+    except Exception:  # noqa: BLE001 — any miss means init would stall
+        return False
+
+
 @pytest.fixture(scope="module")
 def guard_results():
     """Run every guard in one child process on the default backend.
@@ -47,6 +72,12 @@ def guard_results():
     """
     env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if not _metadata_answers():
+        # Pre-probed: the metadata server would stall libtpu's init for
+        # ~8.5 min before the CPU fallback.  Skip the query — a real TPU
+        # VM whose metadata answers never takes this branch, and a box
+        # with topology baked into env vars doesn't need the server.
+        env.setdefault("TPU_SKIP_MDS_QUERY", "1")
     timed_out = False
     try:
         out = subprocess.run(

@@ -397,6 +397,12 @@ def test_no_read_after_donation_lint():
     audited = {
         pkg / "plans" / "plan.py",
         pkg / "streaming" / "drivers.py",
+        # stream_feature_blocks' row-slot buffer write: each donated
+        # buffer enters `write` exactly once per step and the old acc is
+        # discarded; the engine's _entry_acc snapshot (gated on the same
+        # donation_enabled()) covers sentinel replay, and checkpoints
+        # capture post-chunk outputs, never donated inputs.
+        pkg / "ml" / "distributed.py",
     }
     offenders = [
         str(p.relative_to(pkg))
@@ -612,4 +618,40 @@ def test_graph_marker_registered_tier1():
     assert "GRAPH_TIMEOUT_S = 120" in src
     assert '"markers",\n        "graph:' in src, (
         "the graph marker is no longer registered via addinivalue_line"
+    )
+
+
+@pytest.mark.train
+def test_train_marker_registered_tier1():
+    """Marker contract (ISSUE PR 17): the ``train`` marker must stay a
+    registered tier-1 mark with a hard per-test alarm — distributed-
+    training tests stream elastic folds and run multi-chunk kill/resume
+    rounds, either of which could otherwise wedge the tier-1 run."""
+    import pathlib
+
+    src = (pathlib.Path(__file__).parent / "conftest.py").read_text()
+    assert '"train": TRAIN_TIMEOUT_S' in src, (
+        "the train marker lost its _TIMEOUT_MARKS alarm entry"
+    )
+    assert "TRAIN_TIMEOUT_S = 180" in src
+    assert '"markers",\n        "train:' in src, (
+        "the train marker is no longer registered via addinivalue_line"
+    )
+
+
+@pytest.mark.train
+def test_snapshot_folds_train_counter_group():
+    """Static contract check (ISSUE PR 17): ``telemetry.snapshot()``
+    must fold the ``train.*`` counters into a ``"train"`` group — the
+    distributed trainer's runs/iterations/consensus/escalations surface
+    docs/distributed_training.md points operators at.  Conditional like
+    the router/autoscale groups: absent until a trainer ran."""
+    import importlib
+    import inspect
+
+    report = importlib.import_module("libskylark_tpu.telemetry.report")
+    snap_src = inspect.getsource(report.snapshot)
+    assert '"train"' in snap_src and "train." in snap_src, (
+        "telemetry.snapshot() no longer folds the train.* counter "
+        'group into snap["train"] (docs/distributed_training.md contract)'
     )
