@@ -1179,6 +1179,119 @@ def bench_serve(on_tpu, table):
           (finished / minted) if minted else 0.0, table, contention=None)
 
 
+def bench_cache(on_tpu, table):
+    """Front-door QoS + result cache (docs/serving.md, "QoS + caching").
+
+    Two contracts, two row groups:
+
+    - **Hot-set QPS, cache on vs off**: the same 8-vector hot set driven
+      through the same SERIAL server (``max_coalesce=1`` — one dispatch
+      per request, so the row isolates the per-dispatch cost the cache
+      removes rather than letting coalescing amortise it) twice —
+      ``cache=False`` pays a device dispatch per request, ``cache=True``
+      re-serves every repeat bitwise from the dict.  ``vs_baseline`` on
+      the cache-on row is the speedup; the acceptance floor is 5x on
+      CPU.
+    - **Adversarial-tenant fairness**: a polite tenant's p99 alone, then
+      the SAME polite traffic while a noisy tenant floods the door with
+      QoS lanes on (cache off, so the flood is real device work).  The
+      deficit-round-robin lanes must keep the polite tenant's p99 within
+      2x of its solo p99 (``vs_baseline`` = solo/adversarial >= 0.5) —
+      without lanes the polite requests would queue behind the entire
+      flood."""
+    import concurrent.futures as cf
+    import threading
+
+    from libskylark_tpu import serve
+
+    m, n = (8192, 64) if on_tpu else (512, 16)
+    total = 64 if _SMOKE else 256
+    workers = 16
+    rng = np.random.default_rng(17)
+    A = rng.standard_normal((m, n))
+    hot = [rng.standard_normal(m) for _ in range(8)]
+
+    def req(i, tenant=None):
+        r = serve.make_request("ls_solve", system="sys", b=hot[i % len(hot)])
+        if tenant is not None:
+            r["tenant"] = tenant
+        return r
+
+    def make_server(cache_on, max_coalesce=16):
+        srv = serve.Server(
+            serve.ServeParams(
+                max_coalesce=max_coalesce, max_queue=4096, warm_start=False,
+                prime=True, cache=cache_on,
+                tenant_weights={"polite": 1.0, "noisy": 1.0},
+            ),
+            seed=13,
+        )
+        srv.registry.register_system("sys", A, context=SketchContext(seed=29))
+        return srv.start()
+
+    def one(srv, i, tenant=None):
+        t0 = time.perf_counter()
+        r = srv.call(req(i, tenant))
+        dt_ms = (time.perf_counter() - t0) * 1e3
+        if not r["ok"]:
+            raise RuntimeError(r["error"]["message"])
+        return dt_ms
+
+    # -- hot-set QPS, cache off vs on ---------------------------------------
+    qps = {}
+    for cache_on in (False, True):
+        srv = make_server(cache_on, max_coalesce=1)
+        with cf.ThreadPoolExecutor(max_workers=workers) as pool:
+            list(pool.map(lambda i: one(srv, i), range(workers)))  # warm
+            t0 = time.perf_counter()
+            list(pool.map(lambda i: one(srv, i), range(total)))
+            qps[cache_on] = total / (time.perf_counter() - t0)
+        hits = srv.cache.stats()["hits"]
+        srv.stop()
+    _emit("serve cache-off hot-set QPS", qps[False], "req/s", 1.0, table,
+          contention=None)
+    _emit("serve cache-on hot-set QPS", qps[True], "req/s",
+          qps[True] / qps[False], table, contention=None)
+    _emit("serve cache hits", hits, "hits",
+          hits / (total + workers), table, contention=None)
+
+    # -- adversarial-tenant fairness ----------------------------------------
+    def polite_p99(srv):
+        with cf.ThreadPoolExecutor(max_workers=4) as pool:
+            lat = sorted(pool.map(
+                lambda i: one(srv, i, tenant="polite"), range(total // 4)
+            ))
+        return lat[min(len(lat) - 1, int(len(lat) * 0.99))]
+
+    srv = make_server(False)
+    p99_solo = polite_p99(srv)
+    stop = threading.Event()
+
+    def flood(j):
+        i = 0
+        while not stop.is_set():
+            one(srv, j * 7919 + i, tenant="noisy")
+            i += 1
+
+    flooders = [
+        threading.Thread(target=flood, args=(j,), daemon=True)
+        for j in range(workers - 4)
+    ]
+    for t in flooders:
+        t.start()
+    try:
+        p99_mixed = polite_p99(srv)
+    finally:
+        stop.set()
+        for t in flooders:
+            t.join(timeout=30)
+        srv.stop()
+    _emit("serve polite solo p99", p99_solo, "ms", 1.0, table,
+          contention=None)
+    _emit("serve polite adversarial p99", p99_mixed, "ms",
+          p99_solo / p99_mixed, table, contention=None)
+
+
 def bench_refine(on_tpu, table):
     """Certified mixed-precision refinement vs the exact f64 QR solve
     (docs/performance.md): wall-clock to MATCHED accuracy on the same
@@ -2447,7 +2560,12 @@ def main() -> None:
     # FJLT f32 row also moves up — it is the round-5 fused-kernel
     # measurement).  Rows with round-2/3 captures queue behind them.
     secondaries = [
-        # Round-17 rows lead (never captured): elastic multi-host
+        # Round-18 rows lead (never captured): the front-door result
+        # cache + multi-tenant QoS lanes (docs/serving.md, "QoS +
+        # caching") — hot-set QPS cache-on vs off, and the
+        # adversarial-tenant fairness p99 pair.
+        ("serve cache", 60, lambda: bench_cache(on_tpu, table)),
+        # Round-17 rows next (never captured): elastic multi-host
         # BlockADMM training (docs/distributed_training.md) — world=1
         # rows/s vs the in-process solver, kill-to-first-consensus
         # resume latency, and the bf16 train-step submetric.

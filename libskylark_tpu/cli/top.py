@@ -105,8 +105,40 @@ def _serve_lines(stats: dict, health: dict, traces: dict) -> list[str]:
         f"  p99 {_fmt(lat.get('latency_p99_ms'))} ms"
         f"  shed_admission {c.get('shed_admission', 0)}"
         f"  shed_deadline {c.get('shed_deadline', 0)}"
+        f"  shed_quota {c.get('shed_quota', 0)}"
         f"  solo_retries {c.get('solo_retries', 0)}"
     )
+    hits, misses = c.get("cache.hit", 0), c.get("cache.miss", 0)
+    if hits or misses:
+        rate = hits / (hits + misses) if (hits + misses) else None
+        out.append(
+            f"  cache hits {hits}  misses {misses}  hit_rate {_fmt(rate)}"
+            f"  evictions {c.get('cache.evictions', 0)}"
+            f"  invalidations {c.get('cache.invalidations', 0)}"
+        )
+    # Per-tenant QoS table from the serve.tenant.<t>.<metric> counters
+    # (the /stats counters arrive with the "serve." prefix stripped).
+    tenants: dict = {}
+    for k, v in c.items():
+        if k.startswith("tenant."):
+            t, _, metric = k[len("tenant."):].partition(".")
+            if metric:
+                tenants.setdefault(t, {})[metric] = v
+    if tenants:
+        out.append(
+            "  tenant                 reqs      ok    hits  shed q/a/d"
+        )
+        for t in sorted(tenants):
+            m = tenants[t]
+            shed = (
+                f"{m.get('shed_quota', 0)}/{m.get('shed_admission', 0)}"
+                f"/{m.get('shed_deadline', 0)}"
+            )
+            out.append(
+                f"  {t:<20} {m.get('requests', 0):>6}"
+                f"  {m.get('ok', 0):>6}  {m.get('cache_hits', 0):>6}"
+                f"  {shed}"
+            )
     if traces and "_error" not in traces:
         viol = traces.get("violations", [])
         line = (
@@ -187,7 +219,8 @@ def _autoscale_lines(scale: dict) -> list[str]:
 def _fleet_table(rows: list) -> list[str]:
     """Per-replica rows of (name, load report | None, heartbeat age)."""
     out = [
-        "  replica                        queue    qps  primed  heartbeat"
+        "  replica                        queue    qps  primed  cache"
+        "     heartbeat"
     ]
     for name, load, age in rows:
         if not isinstance(load, dict):
@@ -197,10 +230,17 @@ def _fleet_table(rows: list) -> list[str]:
             float(v.get("rows_per_s") or 0.0)
             for v in (load.get("throughput") or {}).values()
         )
+        cache = load.get("cache") or {}
+        cc = (
+            f"{cache.get('hits', 0)}h/{cache.get('entries', 0)}e"
+            if isinstance(cache, dict) and cache
+            else "n/a"
+        )
         beat = "now" if age is None else f"{_fmt(age, 1)}s ago"
         out.append(
             f"  {name:<30} {str(load.get('queue_depth', '?')):>5}"
-            f"  {qps:>5.1f}  {len(load.get('primed', [])):>6}  {beat}"
+            f"  {qps:>5.1f}  {len(load.get('primed', [])):>6}  {cc:>8}"
+            f"  {beat}"
         )
     return out
 

@@ -12,8 +12,14 @@ bit-identical per request to serving them one at a time.
 Layout (see ``docs/serving.md``):
 
 - :mod:`.protocol` — the JSON frames (native-parity interchange);
-- :mod:`.admission` — bounded queue, depth/deadline shedding
-  (error codes 112/113 on the ``utils.exceptions`` ladder);
+- :mod:`.admission` — bounded queue with deficit-weighted round-robin
+  tenant lanes, depth/deadline shedding (error codes 112/113 on the
+  ``utils.exceptions`` ladder);
+- :mod:`.cache` — the versioned, bounded front-door result cache
+  (keyed on placement key + canonical payload CRC + registry epoch;
+  hits cost zero device work, invalidation rides the epoch mint);
+- :mod:`.qos` — tenant keys, weighted-fair lane config, token-bucket
+  quotas (code-117 ``QuotaExceededError`` sheds);
 - :mod:`.registry` — models + LS systems, loaded once, device-resident;
 - :mod:`.batcher` — the coalescing executors + solo-retry fault
   isolation (code-108 structured degradation, batch-mates unaffected);
@@ -38,7 +44,15 @@ Layout (see ``docs/serving.md``):
 
 from .admission import AdmissionQueue, Entry
 from .autoscale import AutoscaleParams, Autoscaler
+from .cache import ResultCache, payload_crc
 from .client import Client
+from .qos import (
+    DEFAULT_TENANT,
+    LaneConfig,
+    TenantQuotas,
+    TokenBucket,
+    tenant_of,
+)
 from .protocol import (
     decode,
     encode,
@@ -66,16 +80,21 @@ __all__ = [
     "AutoscaleParams",
     "Autoscaler",
     "Client",
+    "DEFAULT_TENANT",
     "Entry",
     "GraphSystem",
     "HttpReplica",
     "InProcessReplica",
     "LSSystem",
+    "LaneConfig",
     "Registry",
+    "ResultCache",
     "Router",
     "RouterParams",
     "ServeParams",
     "Server",
+    "TenantQuotas",
+    "TokenBucket",
     "choose_replica",
     "decode",
     "encode",
@@ -85,9 +104,11 @@ __all__ = [
     "latency_percentiles",
     "make_request",
     "ok_response",
+    "payload_crc",
     "placement_key",
     "raise_for_error",
     "record_latency",
     "serve_http",
     "serve_stdio",
+    "tenant_of",
 ]

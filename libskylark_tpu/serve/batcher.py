@@ -230,7 +230,7 @@ def _execute_cond_est(registry, entries, device=None):
     system = entries[0].entity or registry.get_system(
         entries[0].request["system"]
     )
-    rep = system.cond_report()
+    rep = system.cond_report(cache=getattr(registry, "cache", None))
     return [dict(rep) for _ in entries], len(entries)
 
 
@@ -244,7 +244,11 @@ def _execute_ppr(registry, entries, device=None):
     gsys = entries[0].entity or registry.get_graph(
         entries[0].request["graph"]
     )
-    return [dict(gsys.ppr_report(e.payload)) for e in entries], len(entries)
+    cache = getattr(registry, "cache", None)
+    return (
+        [dict(gsys.ppr_report(e.payload, cache=cache)) for e in entries],
+        len(entries),
+    )
 
 
 def _execute_ase_embed(registry, entries, device=None):
@@ -319,7 +323,7 @@ def _decode(entry, out):
     return out
 
 
-def _finish_ok(entry, out, batch_size, bucket, t_exec_ms):
+def _finish_ok(entry, out, batch_size, bucket, t_exec_ms, registry=None):
     entry.trace.update(
         batch_size=batch_size,
         bucket=bucket,
@@ -334,7 +338,20 @@ def _finish_ok(entry, out, batch_size, bucket, t_exec_ms):
         entry.trace["registry_epoch"] = int(
             getattr(entry.entity, "epoch", 0)
         )
+    if registry is not None and entry.cache_key is not None:
+        # Fill the front-door result cache with the DECODED per-request
+        # result (dicts copied so a caller mutating the envelope cannot
+        # poison the cache).  The key pins the epoch this batch served
+        # at, so a fold landing mid-flight never aliases old bits onto
+        # the new version's key.
+        registry.cache.put(
+            entry.cache_key,
+            dict(out) if isinstance(out, dict) else out,
+            entity=entry.cache_entity,
+        )
     telemetry.inc("serve.ok")
+    if telemetry.enabled():
+        telemetry.inc(f"serve.tenant.{entry.tenant}.ok")
     # a request that answered OK but only after a solo-retry / guard
     # rung is still an SLO incident: keep it in the violation ring
     telemetry.finish_trace(
@@ -348,6 +365,8 @@ def _finish_ok(entry, out, batch_size, bucket, t_exec_ms):
 def _finish_error(entry, exc, batch_size):
     entry.trace.update(batch_size=batch_size, coalesced=batch_size > 1)
     code = int(getattr(exc, "code", 100))
+    if telemetry.enabled():
+        telemetry.inc(f"serve.tenant.{entry.tenant}.errors")
     if entry.tctx is not None:
         # error_event appends onto the active trace, whose event list
         # aliases entry.trace["events"] — envelope and recorder in one
@@ -443,4 +462,4 @@ def _dispatch(registry, entries, device=None) -> None:
                 n,
             )
             continue
-        _finish_ok(entry, _decode(entry, out), n, bucket, t_ms)
+        _finish_ok(entry, _decode(entry, out), n, bucket, t_ms, registry)

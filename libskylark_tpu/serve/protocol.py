@@ -55,15 +55,21 @@ Response schema::
      "trace": {"queue_ms", "exec_ms", "batch_size", "bucket",
                "coalesced", "events": [...], ...}}
     {"id": ...,
-     "ok": false, "error": {"code": int,    # the 100-116 ladder
+     "ok": false, "error": {"code": int,    # the 100-117 ladder
                             "type": str, "message": str},
      "trace": {...}}
 
 Error codes ride ``utils.exceptions``: admission shed = 112
 (``AdmissionError``), deadline shed = 113 (``DeadlineExceededError``),
-retired registry version = 116 (``RegistryEpochError``), serve-probe
-numerical failures = 108 (``NumericalHealthError``); foreign
-exceptions degrade to the base code 100.
+retired registry version = 116 (``RegistryEpochError``), per-tenant
+quota shed = 117 (``QuotaExceededError``, carrying
+``{tenant, rate, burst, retry_after_ms}``), serve-probe numerical
+failures = 108 (``NumericalHealthError``); foreign exceptions degrade
+to the base code 100.
+
+Requests may also carry ``"tenant": str`` — the QoS lane key (the HTTP
+transport maps an ``X-Skylark-Tenant`` header onto it).  Absent tenant
+means the default lane, preserved bitwise.
 """
 
 from __future__ import annotations
@@ -168,7 +174,8 @@ def error_payload(e: BaseException) -> dict:
     }
     for attr in (
         "queue_depth", "max_depth", "deadline_ms", "waited_ms", "stage",
-        "requested", "current", "entity",
+        "requested", "current", "entity", "tenant", "rate", "burst",
+        "retry_after_ms",
     ):
         v = getattr(e, attr, None)
         if v is not None:

@@ -55,6 +55,7 @@ from .. import plans, telemetry
 from ..core.context import SketchContext
 from ..sketch import base as sketch_base
 from ..utils.exceptions import InvalidParameters, UnsupportedError
+from .cache import ResultCache, payload_crc
 
 __all__ = ["GraphSystem", "LSSystem", "Registry"]
 
@@ -186,14 +187,26 @@ class LSSystem:
             "epoch": self.epoch,
         }
 
-    def cond_report(self) -> dict:
+    def cond_report(self, cache: "ResultCache | None" = None) -> dict:
         """Condition / effective-rank report of the sketched system,
         probed ONCE and cached: R from QR(S·A) carries S·A's singular
         values (replicated-small n×n), so the probe is a short-budget
         ``cond_est`` on R plus one small SVD for the effective rank —
         the full (m, n) A is never touched.  Coalesced ``cond_est``
         requests for the same placement key all fan out this one dict.
+
+        The memo lives in the shared bounded :class:`ResultCache` when
+        one is passed (epoch-keyed, so a new version recomputes and the
+        old entry LRU-ages out); the per-object ``_cond_report``
+        attribute remains as the cacheless fallback — new versions never
+        copy it, so it can't survive an epoch bump either.
         """
+        ck = ("cond:" + self.name, 0, self.epoch) if cache is not None \
+            else None
+        if cache is not None:
+            rep = cache.get(ck)
+            if rep is not None:
+                return rep
         rep = getattr(self, "_cond_report", None)
         if rep is None:
             import numpy as np
@@ -217,6 +230,8 @@ class LSSystem:
                 "sketch_size": int(self.S.s),
                 "epoch": self.epoch,
             }
+        if cache is not None:
+            cache.put(ck, rep, entity=self.name)
         return rep
 
 
@@ -386,13 +401,28 @@ class GraphSystem:
             s, self.lam, out=np.zeros_like(s), where=safe
         )
 
-    def ppr_report(self, payload: tuple) -> dict:
+    def ppr_report(self, payload: tuple,
+                   cache: "ResultCache | None" = None) -> dict:
         """Seed-set PPR community report, memoized by the canonical
         payload ``(sorted-unique seed ids, alpha, gamma, epsilon)`` the
         server validated — coalesced riders with the same seed set share
         one diffusion, mirroring ``LSSystem.cond_report``.  The solve is
         ``find_local_cluster``'s active-support diffusion: work scales
-        with the cluster found, not with the graph held."""
+        with the cluster found, not with the graph held.
+
+        When the shared bounded :class:`ResultCache` is passed, the memo
+        lives there — keyed on the canonical payload CRC and this
+        version's epoch, so hot seed sets stay O(lookup) across the
+        whole serve path while bounded by LRU + byte budget instead of
+        growing without limit.  The per-object ``_ppr_reports`` dict
+        remains as the cacheless fallback (``folded`` resets it, so it
+        never crosses an epoch)."""
+        ck = ("ppr:" + self.name, payload_crc(payload), self.epoch) \
+            if cache is not None else None
+        if cache is not None:
+            rep = cache.get(ck)
+            if rep is not None:
+                return rep
         rep = self._ppr_reports.get(payload)
         if rep is None:
             from ..graph.community import find_local_cluster
@@ -411,16 +441,23 @@ class GraphSystem:
                 "gamma": float(gamma),
                 "epsilon": float(epsilon),
             }
+        if cache is not None:
+            cache.put(ck, rep, entity=self.name)
         return rep
 
 
 class Registry:
-    def __init__(self):
+    def __init__(self, cache: ResultCache | None = None):
         self.models: dict[str, object] = {}
         self.systems: dict[str, LSSystem] = {}
         self.graphs: dict[str, GraphSystem] = {}
         # per-model jitted predict closures, built lazily by the batcher
         self.model_jits: dict[str, object] = {}
+        # The shared bounded result cache: the front door's response
+        # cache AND the cond/ppr report memo are this one instance, so
+        # every consumer sees the same epoch-keyed entries and the same
+        # LRU/byte bounds.  Invalidation rides _mint below.
+        self.cache = cache if cache is not None else ResultCache()
         # -- live-registry epoch discipline ---------------------------------
         # One monotone counter over ALL mutations (registrations and live
         # updates alike); each current version object carries the epoch
@@ -440,6 +477,11 @@ class Registry:
                     pass
             rec = {"epoch": epoch, "kind": kind, "name": name, **attrs}
             self.epoch_log.append(rec)
+        # Retire the mutated entity's cached results immediately.  The
+        # epoch in every cache key already guarantees the next request
+        # misses (it computes a NEW key); this frees the stale entries'
+        # memory rather than waiting for LRU pressure.
+        self.cache.invalidate(name)
         telemetry.inc("registry.epoch.bumps")
         telemetry.inc(f"registry.epoch.{kind}")
         telemetry.event("registry", "epoch", rec)

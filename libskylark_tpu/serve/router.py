@@ -210,6 +210,18 @@ def _key_throughput(report: dict, key: str) -> float:
     return best
 
 
+def _cached_for_key(report: dict, key: str) -> int:
+    """How many warm cached results this replica's load report claims
+    for ``key`` — the fleet half of the result cache: reports without a
+    cache block (older replicas, hand-built test reports) read as 0, so
+    the preference only ever engages when a replica actually holds the
+    key's results."""
+    cache = report.get("cache")
+    if not isinstance(cache, dict):
+        return 0
+    return int((cache.get("keys") or {}).get(key, 0))
+
+
 def choose_replica(key: str, members: dict, affinity: dict) -> str | None:
     """Pure placement: replica name, or ``None`` when every placeable
     member is saturated (the caller sheds 112).
@@ -217,6 +229,13 @@ def choose_replica(key: str, members: dict, affinity: dict) -> str | None:
     ``members`` maps name → ``{"placeable": bool, "report": {...}}``
     (frozen — this function reads, never mutates); ``affinity`` maps
     placement key → the name that last served it.
+
+    Order of preference after the affinity pin: a replica already
+    holding cached results for this key (so a hot repeated request is a
+    fleet-wide dict lookup — ONE dispatch total, not one per replica),
+    then lowest queue depth, then measured throughput, then name.  The
+    cache preference is binary (holds any vs none): hoarding MORE
+    entries for a key must not outrank an idle replica's queue.
     """
     def open_(m) -> bool:
         return m["placeable"] and not _saturated(m["report"])
@@ -230,6 +249,7 @@ def choose_replica(key: str, members: dict, affinity: dict) -> str | None:
     return min(
         candidates,
         key=lambda nm: (
+            -min(_cached_for_key(nm[1]["report"], key), 1),
             nm[1]["report"].get("queue_depth", 0),
             -_key_throughput(nm[1]["report"], key),
             nm[0],
@@ -579,9 +599,19 @@ class Router:
     def fleet_report(self) -> dict:
         now = time.monotonic()
         with self._lock:
+            cache = {"hits": 0, "misses": 0, "entries": 0, "bytes": 0}
+            for m in self._members.values():
+                c = (m.report or {}).get("cache")
+                if isinstance(c, dict):
+                    for k in cache:
+                        cache[k] += int(c.get(k, 0))
             return {
                 "epoch": self._epoch,
                 "signature": self._signature,
+                # Fleet-wide result-cache rollup over the members' load
+                # reports — the shared hit/miss state of the whole fleet
+                # in one place (per-replica detail stays in each report).
+                "cache": cache,
                 "members": {
                     n: {
                         "placeable": m.placeable,

@@ -38,11 +38,14 @@ from ..core.context import SketchContext
 from ..utils.exceptions import (
     DeadlineExceededError,
     InvalidParameters,
+    QuotaExceededError,
     RegistryEpochError,
     SkylarkError,
 )
 from . import batcher, protocol
 from .admission import AdmissionQueue, Entry
+from .cache import ResultCache, payload_crc
+from .qos import LaneConfig, TenantQuotas, tenant_of
 from .registry import Registry
 
 __all__ = ["ServeParams", "Server", "latency_percentiles", "record_latency"]
@@ -94,6 +97,16 @@ class ServeParams:
       unchanged — ``take_batch`` is already multi-consumer-safe, and
       per-slot purity keeps results bitwise identical to a single
       worker's.
+    - ``cache`` / ``cache_max_entries`` / ``cache_max_bytes``: the
+      front-door :class:`~.cache.ResultCache`.  ``None`` defers to the
+      ``SKYLARK_CACHE`` / ``SKYLARK_CACHE_MAX_ENTRIES`` /
+      ``SKYLARK_CACHE_MAX_BYTES`` knobs.
+    - ``qos_quantum`` / ``tenant_weights``: deficit-round-robin lane
+      scheduling (``SKYLARK_QOS_QUANTUM`` / ``SKYLARK_QOS_WEIGHTS``).
+    - ``tenant_quota_rps`` / ``tenant_quota_burst`` / ``tenant_quotas``:
+      per-tenant token-bucket admission quotas shedding code-117
+      envelopes (``SKYLARK_QOS_QUOTA_RPS`` / ``SKYLARK_QOS_QUOTA_BURST``
+      / ``SKYLARK_QOS_QUOTAS``); the rate default 0 means unlimited.
     """
 
     max_queue: int = 256
@@ -103,6 +116,14 @@ class ServeParams:
     warm_start: bool = True
     prime: bool = True
     workers: int = 1
+    cache: bool | None = None
+    cache_max_entries: int | None = None
+    cache_max_bytes: int | None = None
+    qos_quantum: float | None = None
+    tenant_weights: str | dict | None = None
+    tenant_quota_rps: float | None = None
+    tenant_quota_burst: float | None = None
+    tenant_quotas: str | dict | None = None
 
 
 class Server:
@@ -115,8 +136,27 @@ class Server:
     ):
         self.params = params or ServeParams()
         self.ctx = context if context is not None else SketchContext(seed=seed)
-        self.registry = Registry()
-        self.queue = AdmissionQueue(self.params.max_queue)
+        # ONE cache instance: the front door's response cache, the
+        # cond/ppr report memo, and the load-report census are all this
+        # object, so registry mints invalidate everything at once.
+        self.cache = ResultCache(
+            max_entries=self.params.cache_max_entries,
+            max_bytes=self.params.cache_max_bytes,
+            enabled=self.params.cache,
+        )
+        self.registry = Registry(cache=self.cache)
+        self.quotas = TenantQuotas(
+            default_rps=self.params.tenant_quota_rps,
+            default_burst=self.params.tenant_quota_burst,
+            quotas=self.params.tenant_quotas,
+        )
+        self.queue = AdmissionQueue(
+            self.params.max_queue,
+            lanes=LaneConfig(
+                quantum=self.params.qos_quantum,
+                weights=self.params.tenant_weights,
+            ),
+        )
         self.warm_summary: dict | None = None
         self.primed: list[str] = []
         self._thread: threading.Thread | None = None
@@ -210,7 +250,7 @@ class Server:
                     batcher._execute_ls(self.registry, entries, dev)
             # cond-est answers from this cached report; probing it here
             # keeps the first served cond_est request off the probe cost
-            system.cond_report()
+            system.cond_report(cache=self.cache)
             self.primed.append(f"system:{name}:{widths}")
         from .. import plans
 
@@ -287,6 +327,9 @@ class Server:
             return fut
         if entry is None:  # ping/stats answered inline
             return fut
+        entry.tenant = tenant_of(request)
+        entry.trace["tenant"] = entry.tenant
+        self._tenant_inc(entry.tenant, "requests")
         # Trace minting at admission: None (no allocation) with
         # telemetry off; the context's event list aliases entry.trace's.
         entry.tctx = telemetry.mint(
@@ -300,11 +343,67 @@ class Server:
         )
         if entry.tctx is not None:
             entry.trace["trace_id"] = entry.tctx.trace_id
+        # -- front-door result cache ---------------------------------------
+        # Key = (placement key, canonical payload CRC, pinned entity
+        # epoch): the epoch component makes a registry mint observable by
+        # the VERY NEXT request structurally — it computes a new key and
+        # misses.  A hit costs zero device work AND zero queue/quota
+        # pressure, so it deliberately bypasses the tenant token bucket:
+        # quotas meter dispatches, not dict lookups.
+        t_hit = time.monotonic()
+        self._stamp_cache_key(entry)
+        if entry.cache_key is not None:
+            hit = self.cache.get(entry.cache_key)
+            if hit is not None:
+                entry.trace["events"].append(
+                    {"kind": "cache_hit", "epoch": entry.cache_key[2]}
+                )
+                entry.trace["cache_hit"] = True
+                if entry.entity is not None:
+                    entry.trace["registry_epoch"] = int(
+                        getattr(entry.entity, "epoch", 0)
+                    )
+                telemetry.inc("serve.ok")
+                self._tenant_inc(entry.tenant, "cache_hits")
+                telemetry.finish_trace(entry.tctx, "ok")
+                ms = (time.monotonic() - t_hit) * 1e3
+                telemetry.observe("serve.latency_ms", ms)
+                record_latency(ms)
+                self._tenant_observe(entry.tenant, ms)
+                fut.set_result(
+                    protocol.ok_response(request.get("id"), hit, entry.trace)
+                )
+                return fut
+        # -- per-tenant quota (code 117, BEFORE the global depth gate) ------
+        try:
+            self.quotas.admit(entry.tenant)
+        except QuotaExceededError as e:
+            telemetry.inc("serve.shed_quota")
+            telemetry.inc("serve.errors")
+            self._tenant_inc(entry.tenant, "shed_quota")
+            entry.trace["events"].append(
+                {
+                    "kind": "quota_shed",
+                    "tenant": entry.tenant,
+                    "retry_after_ms": e.retry_after_ms,
+                    **self._queue_state(),
+                }
+            )
+            with telemetry.activate([entry.tctx]):
+                telemetry.error_event(
+                    "serve.quota", e, op=entry.op, tenant=entry.tenant
+                )
+            telemetry.finish_trace(entry.tctx, "shed_quota", code=e.code)
+            fut.set_result(
+                protocol.error_response(request.get("id"), e, entry.trace)
+            )
+            return fut
         try:
             self.queue.offer(entry, on_admit=self._on_admit)
         except SkylarkError as e:  # AdmissionError
             telemetry.inc("serve.shed_admission")
             telemetry.inc("serve.errors")
+            self._tenant_inc(entry.tenant, "shed_admission")
             # The envelope carries the queue state that caused the shed:
             # depth/percentile context a backing-off caller (or a
             # post-mortem) needs, without a second round trip.
@@ -400,6 +499,12 @@ class Server:
             "primed": list(self.primed),
             "census": self.census(),
             "signature": self.signature(),
+            # The fleet-wide hit-sharing plane: which placement keys this
+            # replica already holds warm results for (and how its cache
+            # is doing) — the router's tie-break reads "keys", so a hot
+            # seed set costs the fleet ONE dispatch.
+            "cache": self.cache.stats(),
+            "tenants": self.queue.depth_by_tenant(),
         }
         try:
             from ..policy import profile as _profile
@@ -418,6 +523,55 @@ class Server:
         return report
 
     # -- internals ----------------------------------------------------------
+
+    def _tenant_inc(self, tenant: str, what: str, n: int = 1) -> None:
+        # Per-tenant counter names are f-strings — gate on the telemetry
+        # switch so a disabled run stays allocation-free (the pinned
+        # disabled-telemetry contract).
+        if telemetry.enabled():
+            telemetry.inc(f"serve.tenant.{tenant}.{what}", n)
+
+    def _tenant_observe(self, tenant: str, ms: float) -> None:
+        if telemetry.enabled():
+            telemetry.observe(f"serve.tenant.{tenant}.latency_ms", ms)
+
+    def _stamp_cache_key(self, entry: Entry) -> None:
+        """Compute the result-cache identity of a validated entry, or
+        leave it None (uncacheable).  Cacheable: every idempotent read
+        op.  NOT cacheable: fresh-sketch solves (each draws a unique
+        counter-addressed sketch — the request is *defined* to differ),
+        updates (mutations), ping/stats (answered inline already)."""
+        if not self.cache.enabled:
+            return
+        op = entry.op
+        if op == "ls_solve":
+            if entry.request.get("fresh_sketch"):
+                return
+            src = entry.payload  # b AFTER retired-row zeroing
+        elif op == "cond_est":
+            src = ()
+        elif op == "ppr":
+            src = entry.payload  # canonical (seeds, alpha, gamma, eps)
+        elif op == "ase_embed":
+            src = (entry.payload, entry.squeeze)
+        elif op == "predict":
+            src = (
+                entry.payload,
+                bool(entry.request.get("labels")),
+                entry.squeeze,
+            )
+        else:
+            return
+        entry.cache_key = (
+            protocol.placement_key(entry.request),
+            payload_crc(src),
+            int(getattr(entry.entity, "epoch", 0)),
+        )
+        entry.cache_entity = (
+            entry.request.get("system")
+            or entry.request.get("model")
+            or entry.request.get("graph")
+        )
 
     def _validate(self, request: dict, fut: Future) -> Entry | None:
         op = request.get("op")
@@ -719,6 +873,7 @@ class Server:
                 e.trace["queue_ms"] = round(waited_ms, 4)
                 if e.deadline is not None and now > e.deadline:
                     telemetry.inc("serve.shed_deadline")
+                    self._tenant_inc(e.tenant, "shed_deadline")
                     e.trace["events"].append(
                         {
                             "kind": "deadline_shed",
@@ -763,6 +918,7 @@ class Server:
                 ms = (done - e.t_admit) * 1e3
                 telemetry.observe("serve.latency_ms", ms)
                 record_latency(ms)
+                self._tenant_observe(e.tenant, ms)
 
     def _fold_key_stats(self, live, busy_s: float) -> None:
         """Per-placement-key throughput accounting, fed by every batch

@@ -193,6 +193,18 @@ class _Handler(BaseHTTPRequestHandler):
                         409, protocol.error_response(None, e, {"events": []})
                     )
             return
+        # QoS lane key from the wire: an X-Skylark-Tenant header stamps
+        # every request in the body that doesn't already carry its own
+        # "tenant" field (payload wins — the header is the transport-
+        # level default, e.g. one gateway per tenant).
+        tenant = self.headers.get("X-Skylark-Tenant")
+        if tenant:
+            if isinstance(payload, dict):
+                payload.setdefault("tenant", tenant)
+            elif isinstance(payload, list):
+                for r in payload:
+                    if isinstance(r, dict):
+                        r.setdefault("tenant", tenant)
         if isinstance(payload, list):
             # concurrent submission IS the point: a remote batch rides
             # the same cross-request coalescer in-process callers hit

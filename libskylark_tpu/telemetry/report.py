@@ -82,8 +82,23 @@ def snapshot(fleet: bool = False, root=None) -> dict:
     snap["serve"] = {
         k.split(".", 1)[1]: v
         for k, v in counters.items()
-        if k.startswith("serve.")
+        if k.startswith("serve.") and not k.startswith("serve.tenant.")
     }
+    # Per-tenant QoS counters fold NESTED (serve.tenant.<t>.<metric> →
+    # serve.tenants[t][metric]) instead of flattening into the serve
+    # group — the flat group keeps its pre-QoS key set exactly.
+    tenants: dict = {}
+    for k, v in counters.items():
+        if k.startswith("serve.tenant."):
+            t, _, metric = k[len("serve.tenant."):].partition(".")
+            if metric:
+                tenants.setdefault(t, {})[metric] = v
+    if tenants:
+        snap["serve"]["tenants"] = tenants
+    hits = counters.get("serve.cache.hit", 0)
+    lookups_c = hits + counters.get("serve.cache.miss", 0)
+    if lookups_c:
+        snap["serve"]["cache_hit_rate"] = _ratio(hits, lookups_c)
     if snap["serve"]:
         # Derived serving SLOs: fraction of requests that rode a >1
         # coalesced batch, and the latency percentiles from the serve

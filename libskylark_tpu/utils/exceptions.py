@@ -24,6 +24,7 @@ __all__ = [
     "ReplicaLostError",
     "RefinementError",
     "RegistryEpochError",
+    "QuotaExceededError",
 ]
 
 
@@ -238,3 +239,26 @@ class RegistryEpochError(SkylarkError):
         self.requested = requested
         self.current = current
         self.entity = entity
+
+
+class QuotaExceededError(SkylarkError):
+    """A served request was shed at the door because its TENANT's
+    token-bucket quota is exhausted — distinct from the global
+    depth/deadline sheds (112/113), which protect the *server*: this
+    code protects the *other tenants*.  A noisy tenant burning its
+    bucket keeps shedding 117 while polite tenants' requests admit
+    normally, so one caller's retry storm can no longer starve the
+    shared queue.  ``tenant`` names the lane; ``rate``/``burst`` are
+    the bucket's configured refill rate (requests/s) and capacity;
+    ``retry_after_ms`` is how long until one token accrues — the
+    structured backoff hint."""
+
+    code = 117
+
+    def __init__(self, msg, tenant=None, rate=None, burst=None,
+                 retry_after_ms=None):
+        super().__init__(msg)
+        self.tenant = tenant
+        self.rate = rate
+        self.burst = burst
+        self.retry_after_ms = retry_after_ms

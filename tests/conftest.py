@@ -86,6 +86,15 @@ GRAPH_TIMEOUT_S = 120
 # one process; a wedged stream or a resume that waits on a checkpoint
 # that never lands must not stall the tier-1 run.
 TRAIN_TIMEOUT_S = 180
+# QoS tests drive weighted-fair tenant lanes and token-bucket quotas
+# through live servers under concurrent multi-tenant load; a lane the
+# scheduler never visits or a future that never resolves must not
+# stall the tier-1 run.
+QOS_TIMEOUT_S = 120
+# Result-cache tests drive the front-door cache across live-registry
+# epoch bumps behind the worker thread; a wedged invalidation or an
+# unresolved future must not stall the tier-1 run.
+CACHE_TIMEOUT_S = 120
 
 _TIMEOUT_MARKS = {
     "faults": FAULTS_TIMEOUT_S,
@@ -103,6 +112,8 @@ _TIMEOUT_MARKS = {
     "refine": REFINE_TIMEOUT_S,
     "graph": GRAPH_TIMEOUT_S,
     "train": TRAIN_TIMEOUT_S,
+    "qos": QOS_TIMEOUT_S,
+    "cache": CACHE_TIMEOUT_S,
 }
 
 
@@ -208,6 +219,18 @@ def pytest_configure(config):
         "bitwise parity, simulated-rank consensus, kill/resume through "
         "the ADMM loop, guard recovery mid-stream); tier-1, guarded by "
         f"a per-test {TRAIN_TIMEOUT_S}s timeout",
+    )
+    config.addinivalue_line(
+        "markers",
+        "qos: multi-tenant QoS tests (deficit-weighted tenant lanes, "
+        "token-bucket quota sheds, tenant-stamped envelopes/counters); "
+        f"tier-1, guarded by a per-test {QOS_TIMEOUT_S}s timeout",
+    )
+    config.addinivalue_line(
+        "markers",
+        "cache: front-door result-cache tests (bitwise hit parity, "
+        "epoch-bump invalidation, LRU/byte bounds, fleet hit sharing); "
+        f"tier-1, guarded by a per-test {CACHE_TIMEOUT_S}s timeout",
     )
 
 

@@ -1,0 +1,350 @@
+"""Front-door result cache (ISSUE PR 18): versioned, bounded, bitwise.
+
+The load-bearing contracts:
+
+- **Hits are bitwise and cost zero device work.**  A repeated idempotent
+  request re-serves the stored bits from a dict — ``run_batch`` never
+  runs — and the envelope says so (``cache_hit`` trace event).
+- **Staleness is structurally impossible.**  The key carries the pinned
+  entity's registry epoch, so a live-registry mint (fold/append/downdate)
+  makes the VERY NEXT request compute a different key and miss — explicit
+  invalidation only frees memory early.
+- **In-flight batches are unaffected** either way: epoch-pinned entries
+  never consult the cache after admission (same bits as PR 16).
+- **The cond/PPR report memoizers are the same cache**: bounded, shared,
+  epoch-invalidated — no more unbounded per-system ``_ppr_reports``.
+- **The router reads the hit state off the load-report plane**: a replica
+  already holding a hot key's results wins placement ties (binary
+  preference), so the fleet pays ONE dispatch for a hot key.
+"""
+
+import numpy as np
+import pytest
+
+from libskylark_tpu import serve
+from libskylark_tpu.core.context import SketchContext
+from libskylark_tpu.graph.graph import SimpleGraph
+from libskylark_tpu.serve import batcher
+from libskylark_tpu.serve.cache import ResultCache, payload_crc
+from libskylark_tpu.serve.registry import Registry
+from libskylark_tpu.serve.router import choose_replica
+from libskylark_tpu.utils import exceptions as ex
+
+pytestmark = pytest.mark.cache
+
+M, N = 48, 6
+_rng = np.random.default_rng(21)
+A_LS = _rng.standard_normal((M, N))
+ROWS = _rng.standard_normal((4, N))
+B = _rng.standard_normal(M)
+
+N_V = 24
+RING = [(i, (i + 1) % N_V) for i in range(N_V)]
+CHORDS = [(i, (i + 5) % N_V) for i in range(0, N_V, 3)]
+
+
+def _server(seed=1, **params):
+    params.setdefault("warm_start", False)
+    params.setdefault("prime", False)
+    params.setdefault("cache", True)
+    srv = serve.Server(serve.ServeParams(**params), seed=seed)
+    srv.registry.register_system(
+        "sys", A_LS, context=SketchContext(seed=9),
+        sketch_type="SJLT", sketch_size=32, capacity=M + 8,
+    )
+    return srv
+
+
+# ---------------------------------------------------------------------------
+# the cache object: keys, bounds, invalidation
+
+
+def test_payload_crc_is_stable_and_discriminating():
+    b = np.arange(8, dtype=np.float64)
+    assert payload_crc(b) == payload_crc(b.copy())  # bitwise identity
+    assert payload_crc(b) != payload_crc(b.astype(np.float32))
+    assert payload_crc(b) != payload_crc(b.reshape(2, 4))
+    # framing: nesting and container kind both matter
+    assert payload_crc((1, (2, 3))) != payload_crc((1, 2, 3))
+    assert payload_crc([1, 2]) != payload_crc((1, 2))
+    # dicts hash order-independently
+    assert payload_crc({"a": 1, "b": 2}) == payload_crc({"b": 2, "a": 1})
+    assert payload_crc(B) < 2**64  # packed doubled crc32
+
+
+def test_lru_entry_bound_and_byte_budget():
+    c = ResultCache(max_entries=2, max_bytes=10**6, enabled=True)
+    c.put(("k1", 0, 1), {"v": 1})
+    c.put(("k2", 0, 1), {"v": 2})
+    assert c.get(("k1", 0, 1)) == {"v": 1}  # refreshes k1's recency
+    c.put(("k3", 0, 1), {"v": 3})  # evicts k2 (LRU), not k1
+    assert c.get(("k2", 0, 1)) is None and c.get(("k1", 0, 1)) == {"v": 1}
+    assert c.evictions == 1
+
+    tiny = ResultCache(max_entries=64, max_bytes=2048, enabled=True)
+    big = np.zeros(100)  # ~864 bytes each with overhead
+    tiny.put(("a", 0, 1), big)
+    tiny.put(("b", 0, 1), big)
+    tiny.put(("c", 0, 1), big)  # byte budget forces an eviction
+    assert len(tiny) < 3 and tiny.stats()["bytes"] <= 2048
+    # an oversized value is refused outright, not admitted by eviction
+    tiny.put(("huge", 0, 1), np.zeros(4096))
+    assert tiny.get(("huge", 0, 1)) is None
+
+
+def test_invalidate_drops_only_the_entity_and_copies_out():
+    c = ResultCache(max_entries=16, max_bytes=10**6, enabled=True)
+    c.put(("k1", 0, 1), {"v": 1}, entity="sys")
+    c.put(("k2", 0, 1), {"v": 2}, entity="sys")
+    c.put(("k3", 0, 1), {"v": 3}, entity="other")
+    assert c.invalidate("sys") == 2
+    assert c.get(("k1", 0, 1)) is None and c.get(("k3", 0, 1)) == {"v": 3}
+    assert c.invalidate("gone") == 0
+    # a caller mutating the returned dict cannot poison the cache
+    got = c.get(("k3", 0, 1))
+    got["v"] = 999
+    assert c.get(("k3", 0, 1)) == {"v": 3}
+
+
+def test_cache_env_knobs(monkeypatch):
+    monkeypatch.setenv("SKYLARK_CACHE", "0")
+    off = ResultCache()
+    assert not off.enabled
+    off.put(("k", 0, 1), {"v": 1})
+    assert off.get(("k", 0, 1)) is None and len(off) == 0
+    monkeypatch.setenv("SKYLARK_CACHE", "1")
+    monkeypatch.setenv("SKYLARK_CACHE_MAX_ENTRIES", "7")
+    monkeypatch.setenv("SKYLARK_CACHE_MAX_BYTES", "1234")
+    on = ResultCache()
+    assert on.enabled and on.max_entries == 7 and on.max_bytes == 1234
+
+
+# ---------------------------------------------------------------------------
+# the served hot path: bitwise hits, zero device work
+
+
+def test_cache_hit_is_bitwise_and_skips_dispatch(monkeypatch):
+    dispatches = []
+    real = batcher.run_batch
+    monkeypatch.setattr(
+        batcher, "run_batch",
+        lambda reg, entries, device=None: dispatches.append(len(entries))
+        or real(reg, entries, device),
+    )
+    srv = _server().start()
+    try:
+        r1 = srv.call(op="ls_solve", system="sys", b=B)
+        n_after_first = len(dispatches)
+        r2 = srv.call(op="ls_solve", system="sys", b=B)
+    finally:
+        srv.stop()
+    assert r1["ok"] and r2["ok"]
+    # bitwise: the hit re-serves the exact stored bits
+    assert np.array_equal(np.asarray(r1["result"]), np.asarray(r2["result"]))
+    assert len(dispatches) == n_after_first  # zero device work on the hit
+    assert r2["trace"].get("cache_hit") is True
+    assert any(e["kind"] == "cache_hit" for e in r2["trace"]["events"])
+    assert not r1["trace"].get("cache_hit")
+    st = srv.cache.stats()
+    assert st["hits"] == 1 and st["misses"] == 1
+    assert st["keys"] == {"ls:sys": 1}
+
+
+def test_fresh_sketch_requests_never_cache():
+    srv = _server().start()
+    try:
+        srv.call(op="ls_solve", system="sys", b=B, fresh_sketch=True)
+        r2 = srv.call(op="ls_solve", system="sys", b=B, fresh_sketch=True)
+    finally:
+        srv.stop()
+    # each fresh-sketch solve draws a unique counter-addressed sketch:
+    # the request is DEFINED to differ, so neither fills nor hits
+    assert r2["ok"] and not r2["trace"].get("cache_hit")
+    assert srv.cache.hits == 0 and len(srv.cache) == 0
+
+
+def test_cache_disabled_param_means_no_hits():
+    srv = _server(cache=False).start()
+    try:
+        r1 = srv.call(op="ls_solve", system="sys", b=B)
+        r2 = srv.call(op="ls_solve", system="sys", b=B)
+    finally:
+        srv.stop()
+    assert r1["ok"] and r2["ok"] and not r2["trace"].get("cache_hit")
+    assert len(srv.cache) == 0
+
+
+# ---------------------------------------------------------------------------
+# the live-registry seam: epoch keys, invalidation, pinned in-flight
+
+
+def test_registry_mint_observed_by_the_very_next_request():
+    srv = _server().start()
+    try:
+        r1 = srv.call(op="cond_est", system="sys")
+        hit = srv.call(op="cond_est", system="sys")
+        assert hit["trace"].get("cache_hit") is True
+        srv.registry.append_system_rows("sys", ROWS)
+        # the IDENTICAL request now keys on epoch 2: a structural miss
+        # (payload and placement key are unchanged — only the epoch
+        # component of the cache key moved), freshly served
+        r3 = srv.call(op="cond_est", system="sys")
+    finally:
+        srv.stop()
+    assert r1["trace"]["registry_epoch"] == 1
+    assert r3["ok"] and not r3["trace"].get("cache_hit")
+    assert r3["trace"]["registry_epoch"] == 2
+    # the mint also freed the retired epoch's entries immediately
+    assert srv.cache.stats()["invalidations"] >= 1
+
+
+def test_ppr_cache_invalidates_across_graph_fold():
+    srv = _server(seed=2)
+    srv.registry.register_graph(
+        "g", SimpleGraph(RING), k=4, context=SketchContext(seed=5)
+    )
+    srv.start()
+    try:
+        r1 = srv.call(op="ppr", graph="g", seeds=[1, 2])
+        hit = srv.call(op="ppr", graph="g", seeds=[2, 1])  # canonical order
+        assert hit["trace"].get("cache_hit") is True
+        up = srv.call(op="update", graph="g", edges=CHORDS)
+        assert up["ok"]
+        r3 = srv.call(op="ppr", graph="g", seeds=[1, 2])
+    finally:
+        srv.stop()
+    assert r1["ok"] and r3["ok"]
+    assert not r3["trace"].get("cache_hit")  # epoch moved → structural miss
+    assert r3["trace"]["registry_epoch"] == r1["trace"]["registry_epoch"] + 1
+
+
+def test_inflight_epoch_pin_stays_bitwise_with_cache_on():
+    live, ref = _server(), _server()
+    # admit BEFORE the worker starts, then move the registry head: the
+    # queued entry stamped its cache key (and its version pin) at epoch 1
+    fut = live.submit(serve.make_request("ls_solve", system="sys", b=B))
+    live.registry.append_system_rows("sys", ROWS)
+    live.start()
+    got = fut.result()
+    live.stop()
+
+    ref.start()
+    want = ref.call(serve.make_request("ls_solve", system="sys", b=B))
+    ref.stop()
+
+    assert got["ok"] and want["ok"]
+    assert np.array_equal(
+        np.asarray(got["result"]), np.asarray(want["result"])
+    )
+    assert got["trace"]["registry_epoch"] == 1
+    assert not got["trace"].get("cache_hit")
+
+
+def test_repeat_retire_still_refuses_with_102():
+    srv = _server().start()
+    try:
+        first = srv.call(op="update", system="sys", drop=[3])
+        assert first["ok"] and first["result"]["kind"] == "row_downdate"
+        again = srv.call(op="update", system="sys", drop=[3])
+    finally:
+        srv.stop()
+    assert not again["ok"] and again["error"]["code"] == 102
+    with pytest.raises(ex.InvalidParameters):
+        serve.raise_for_error(again)
+
+
+# ---------------------------------------------------------------------------
+# the report memoizers ride the same bounded cache
+
+
+def test_cond_and_ppr_reports_memoize_on_shared_cache():
+    reg = Registry()
+    system = reg.register_system(
+        "sys", A_LS, context=SketchContext(seed=3),
+        sketch_type="SJLT", sketch_size=32, capacity=M + 8,
+    )
+    rep1 = system.cond_report(cache=reg.cache)
+    h0 = reg.cache.hits
+    rep2 = system.cond_report(cache=reg.cache)
+    assert reg.cache.hits == h0 + 1 and rep1 == rep2
+
+    gsys = reg.register_graph(
+        "g", SimpleGraph(RING), k=4, context=SketchContext(seed=5)
+    )
+    payload = ((1, 2), 0.85, 5.0, 0.001)
+    p1 = gsys.ppr_report(payload, cache=reg.cache)
+    h1 = reg.cache.hits
+    p2 = gsys.ppr_report(payload, cache=reg.cache)
+    assert reg.cache.hits == h1 + 1
+    assert p1["cluster"] == p2["cluster"]
+    assert p1["conductance"] == p2["conductance"]
+
+    # a fold mints a new epoch: the memo key moves with it
+    new, _ = reg.fold_graph_edges("g", CHORDS)
+    m0 = reg.cache.misses
+    new.ppr_report(payload, cache=reg.cache)
+    assert reg.cache.misses == m0 + 1
+
+
+# ---------------------------------------------------------------------------
+# the fleet half: load-report census and placement tie-break
+
+
+def test_load_report_carries_cache_block_and_tenants():
+    srv = _server().start()
+    try:
+        srv.call(op="cond_est", system="sys")
+        report = srv.load_report()
+    finally:
+        srv.stop()
+    cache = report["cache"]
+    assert cache["enabled"] and cache["entries"] >= 1
+    # two entries share the placement key: the cond-report memo and the
+    # front-door response — the census the router tie-breaks on
+    assert cache["keys"].get("cond:sys", 0) >= 1
+    assert report["tenants"] == {}  # nothing queued at snapshot time
+
+
+def test_router_prefers_replica_holding_cached_key():
+    def member(depth, cache_keys=None):
+        report = {"queue_depth": depth, "max_queue": 64}
+        if cache_keys is not None:
+            report["cache"] = {"keys": cache_keys}
+        return {"placeable": True, "report": report}
+
+    members = {
+        "idle": member(0),
+        "warm": member(3, {"ls:sys": 2}),
+    }
+    # a replica already holding the key's results wins placement ties
+    # even against an emptier queue: ONE fleet dispatch for a hot key
+    assert choose_replica("ls:sys", members, {}) == "warm"
+    # the preference is binary and per-key: other keys fall back to
+    # queue depth, and reports without a cache block read as zero
+    assert choose_replica("ppr:g", members, {}) == "idle"
+    assert choose_replica("ls:sys", {"a": member(1), "b": member(0)}, {}) == "b"
+    # the affinity pin still wins over the cache preference
+    assert choose_replica("ls:sys", members, {"ls:sys": "idle"}) == "idle"
+
+
+# ---------------------------------------------------------------------------
+# marker contract
+
+
+@pytest.mark.cache
+def test_cache_marker_registered_tier1():
+    """Marker contract (ISSUE PR 18): the ``cache`` marker must stay a
+    registered tier-1 mark with a hard per-test alarm — cache tests run
+    live servers (worker thread + blocking queue), which could otherwise
+    wedge the tier-1 run.  Static over conftest so dropping the mark
+    (or demoting it to slow) fails here."""
+    import pathlib
+
+    src = (pathlib.Path(__file__).parent / "conftest.py").read_text()
+    assert '"cache": CACHE_TIMEOUT_S' in src, (
+        "the cache marker lost its _TIMEOUT_MARKS alarm entry"
+    )
+    assert "CACHE_TIMEOUT_S = 120" in src
+    assert '"markers",\n        "cache:' in src, (
+        "the cache marker is no longer registered via addinivalue_line"
+    )
