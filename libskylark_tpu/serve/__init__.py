@@ -16,7 +16,7 @@ Layout (see ``docs/serving.md``):
   tenant lanes, depth/deadline shedding (error codes 112/113 on the
   ``utils.exceptions`` ladder);
 - :mod:`.cache` — the versioned, bounded front-door result cache
-  (keyed on placement key + canonical payload CRC + registry epoch;
+  (keyed on placement key + canonical payload digest + registry epoch;
   hits cost zero device work, invalidation rides the epoch mint);
 - :mod:`.qos` — tenant keys, weighted-fair lane config, token-bucket
   quotas (code-117 ``QuotaExceededError`` sheds);
@@ -44,7 +44,7 @@ Layout (see ``docs/serving.md``):
 
 from .admission import AdmissionQueue, Entry
 from .autoscale import AutoscaleParams, Autoscaler
-from .cache import ResultCache, payload_crc
+from .cache import ResultCache, payload_crc, payload_digest
 from .client import Client
 from .qos import (
     DEFAULT_TENANT,
@@ -105,6 +105,7 @@ __all__ = [
     "make_request",
     "ok_response",
     "payload_crc",
+    "payload_digest",
     "placement_key",
     "raise_for_error",
     "record_latency",

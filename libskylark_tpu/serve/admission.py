@@ -54,7 +54,8 @@ class Entry:
     __slots__ = (
         "request", "future", "key", "op", "payload", "squeeze",
         "t_admit", "deadline", "sketch", "counter_base", "entity",
-        "trace", "tctx", "tenant", "cache_key", "cache_entity",
+        "trace", "tctx", "tenant", "tenant_label", "cache_key",
+        "cache_entity",
     )
 
     def __init__(self, request, future, key, op, payload=None):
@@ -78,8 +79,12 @@ class Entry:
         # event list ALIASES trace["events"] so everything attached
         # mid-flight lands in the response envelope too.
         self.tctx = None
-        # QoS lane key (qos.tenant_of at validation).
+        # QoS lane key (qos.tenant_of at validation), and the BOUNDED
+        # telemetry label for it (the server folds tenants beyond its
+        # metric cap into "other" so an untrusted client cannot mint
+        # unbounded counter names; lanes/quotas always use the raw key).
         self.tenant = DEFAULT_TENANT
+        self.tenant_label = DEFAULT_TENANT
         # ResultCache key (placement_key, payload crc, pinned epoch) and
         # the entity name it invalidates under — None means uncacheable.
         self.cache_key = None
@@ -202,6 +207,11 @@ class AdmissionQueue:
             e = lane.popleft()
             if e.key == key:
                 batch.append(e)
+                # Freed at pop, not at take_batch return: entries in the
+                # in-flight batch no longer hold queue depth, so a
+                # coalesce-window linger near capacity cannot shed 112
+                # for requests the drained queue has room for.
+                self._depth -= 1
             else:
                 keep.append(e)
         keep.extend(lane)
@@ -213,7 +223,9 @@ class AdmissionQueue:
         entries from that tenant's lane (up to ``max_coalesce``), or
         ``None`` once closed and drained.  ``window_s`` > 0 lingers
         briefly for same-key same-tenant arrivals when the batch is not
-        yet full — latency traded for fuller batches."""
+        yet full — latency traded for fuller batches.  Depth is released
+        entry-by-entry as the batch forms, so lingering never holds
+        admission capacity against ``offer``."""
         with self._cond:
             while True:
                 tenant = self._pick_lane_locked()
@@ -224,6 +236,7 @@ class AdmissionQueue:
                 self._cond.wait(timeout=0.1)
             lane = self._lanes[tenant]
             batch = [lane.popleft()]
+            self._depth -= 1
             self._take_same_key_locked(lane, batch, max_coalesce)
             if window_s > 0:
                 end = time.monotonic() + window_s
@@ -236,7 +249,6 @@ class AdmissionQueue:
                     if lane is None:
                         break
                     self._take_same_key_locked(lane, batch, max_coalesce)
-            self._depth -= len(batch)
             self._settle_lane_locked(tenant)
             return batch
 

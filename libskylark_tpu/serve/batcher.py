@@ -340,18 +340,16 @@ def _finish_ok(entry, out, batch_size, bucket, t_exec_ms, registry=None):
         )
     if registry is not None and entry.cache_key is not None:
         # Fill the front-door result cache with the DECODED per-request
-        # result (dicts copied so a caller mutating the envelope cannot
-        # poison the cache).  The key pins the epoch this batch served
-        # at, so a fold landing mid-flight never aliases old bits onto
-        # the new version's key.
+        # result (the cache deep-copies and freezes on put, so a caller
+        # mutating the response envelope cannot poison it).  The key
+        # pins the epoch this batch served at, so a fold landing
+        # mid-flight never aliases old bits onto the new version's key.
         registry.cache.put(
-            entry.cache_key,
-            dict(out) if isinstance(out, dict) else out,
-            entity=entry.cache_entity,
+            entry.cache_key, out, entity=entry.cache_entity
         )
     telemetry.inc("serve.ok")
     if telemetry.enabled():
-        telemetry.inc(f"serve.tenant.{entry.tenant}.ok")
+        telemetry.inc(f"serve.tenant.{entry.tenant_label}.ok")
     # a request that answered OK but only after a solo-retry / guard
     # rung is still an SLO incident: keep it in the violation ring
     telemetry.finish_trace(
@@ -366,7 +364,7 @@ def _finish_error(entry, exc, batch_size):
     entry.trace.update(batch_size=batch_size, coalesced=batch_size > 1)
     code = int(getattr(exc, "code", 100))
     if telemetry.enabled():
-        telemetry.inc(f"serve.tenant.{entry.tenant}.errors")
+        telemetry.inc(f"serve.tenant.{entry.tenant_label}.errors")
     if entry.tctx is not None:
         # error_event appends onto the active trace, whose event list
         # aliases entry.trace["events"] — envelope and recorder in one

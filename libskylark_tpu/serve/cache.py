@@ -7,7 +7,7 @@ the same rows give the same bits — so a repeated idempotent request
 vertices) can be re-served *bitwise* from a dict instead of burning a
 device dispatch.  The cache is keyed on
 
-    ``(placement_key, canonical payload CRC, registry epoch)``
+    ``(placement_key, canonical payload digest, registry epoch)``
 
 The epoch component is what makes staleness structurally impossible: a
 live-registry mint (edge fold, row append/downdate, model swap) bumps
@@ -29,16 +29,16 @@ Knobs: ``SKYLARK_CACHE`` (``0`` disables), ``SKYLARK_CACHE_MAX_ENTRIES``
 
 from __future__ import annotations
 
+import hashlib
 import os
 import threading
-import zlib
 from collections import OrderedDict
 
 import numpy as np
 
 from .. import telemetry
 
-__all__ = ["ResultCache", "payload_crc"]
+__all__ = ["ResultCache", "payload_digest", "payload_crc"]
 
 
 def _canonical_bytes(obj):
@@ -67,18 +67,25 @@ def _canonical_bytes(obj):
     return ("R|" + repr(obj)).encode("utf-8", "backslashreplace")
 
 
-def payload_crc(payload):
-    """64-bit canonical CRC of a request payload.
+def payload_digest(payload):
+    """128-bit BLAKE2b digest of the canonical request payload bytes.
 
-    A doubled crc32 — one pass over the canonical bytes, one over the
-    same bytes with a domain-separating prefix — packed into 64 bits so
-    two distinct hot-set payloads colliding is a ~2^-64 event rather
-    than crc32's birthday-prone 2^-32.
+    A real cryptographic hash, not a CRC: crc32 is linear over GF(2),
+    so ANY equal-length crc32 collision of the canonical bytes also
+    collides under every domain-prefixed crc32 of those bytes — doubling
+    the CRC widens the word, not the collision resistance, and a
+    high-QPS hot set would eventually serve another request's bits.
+    BLAKE2b at 16 bytes keeps birthday collisions at ~2^-64 across any
+    realistic resident set; the ``person`` tag domain-separates these
+    digests from any other BLAKE2b use in the process.
     """
     data = _canonical_bytes(payload)
-    lo = zlib.crc32(data) & 0xFFFFFFFF
-    hi = zlib.crc32(b"skylark-cache\x00" + data) & 0xFFFFFFFF
-    return (hi << 32) | lo
+    h = hashlib.blake2b(data, digest_size=16, person=b"skylark-cache")
+    return int.from_bytes(h.digest(), "big")
+
+
+#: Legacy name (pre-review the digest was a doubled crc32).
+payload_crc = payload_digest
 
 
 def _value_nbytes(value):
@@ -92,17 +99,49 @@ def _value_nbytes(value):
     return len(repr(value)) + 48
 
 
-def _copy_out(value):
-    """Return a caller-safe view of a cached value.
+def _copy_in(value):
+    """Deep, frozen snapshot of a value entering the cache.
 
-    Dicts are shallow-copied so a caller mutating the returned mapping
-    (the cond/PPR report pattern) cannot poison the cache; ndarrays are
-    returned as-is — the serve layer already treats results as
-    immutable, and copying row blocks would erase the zero-device-work
-    win.
+    Containers are rebuilt recursively and ndarrays copied with
+    ``writeable=False``, so the producer keeping (and later mutating)
+    its own reference — the batcher hands the same decoded result to
+    the response envelope — can never alter the stored bits.  The copy
+    runs once per *miss*, where a device dispatch just happened; it is
+    noise next to the work it memoizes.
     """
+    if isinstance(value, np.ndarray):
+        arr = value.copy()
+        arr.flags.writeable = False
+        return arr
     if isinstance(value, dict):
-        return dict(value)
+        return {k: _copy_in(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_copy_in(v) for v in value]
+    if isinstance(value, tuple):
+        return tuple(_copy_in(v) for v in value)
+    return value
+
+
+def _copy_out(value):
+    """Caller-safe projection of a cached value.
+
+    Containers (dicts, lists, tuples — including nested PPR cluster /
+    member lists) are rebuilt fresh so mutating the returned structure
+    cannot poison the cache; ndarrays come back as read-only *views* of
+    the frozen stored copy — zero data movement on the hit path, and a
+    caller writing into one raises instead of corrupting every future
+    hit.
+    """
+    if isinstance(value, np.ndarray):
+        view = value.view()
+        view.flags.writeable = False
+        return view
+    if isinstance(value, dict):
+        return {k: _copy_out(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_copy_out(v) for v in value]
+    if isinstance(value, tuple):
+        return tuple(_copy_out(v) for v in value)
     return value
 
 
@@ -163,6 +202,7 @@ class ResultCache:
         nb = _value_nbytes(value)
         if nb > self.max_bytes:
             return
+        value = _copy_in(value)
         with self._lock:
             old = self._d.pop(key, None)
             if old is not None:

@@ -55,7 +55,7 @@ from .. import plans, telemetry
 from ..core.context import SketchContext
 from ..sketch import base as sketch_base
 from ..utils.exceptions import InvalidParameters, UnsupportedError
-from .cache import ResultCache, payload_crc
+from .cache import ResultCache, payload_digest
 
 __all__ = ["GraphSystem", "LSSystem", "Registry"]
 
@@ -411,13 +411,13 @@ class GraphSystem:
         with the cluster found, not with the graph held.
 
         When the shared bounded :class:`ResultCache` is passed, the memo
-        lives there — keyed on the canonical payload CRC and this
+        lives there — keyed on the canonical payload digest and this
         version's epoch, so hot seed sets stay O(lookup) across the
         whole serve path while bounded by LRU + byte budget instead of
         growing without limit.  The per-object ``_ppr_reports`` dict
         remains as the cacheless fallback (``folded`` resets it, so it
         never crosses an epoch)."""
-        ck = ("ppr:" + self.name, payload_crc(payload), self.epoch) \
+        ck = ("ppr:" + self.name, payload_digest(payload), self.epoch) \
             if cache is not None else None
         if cache is not None:
             rep = cache.get(ck)

@@ -25,6 +25,7 @@ and allocation-free on the telemetry side (pinned in
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -44,8 +45,8 @@ from ..utils.exceptions import (
 )
 from . import batcher, protocol
 from .admission import AdmissionQueue, Entry
-from .cache import ResultCache, payload_crc
-from .qos import LaneConfig, TenantQuotas, tenant_of
+from .cache import ResultCache, payload_digest
+from .qos import DEFAULT_TENANT, LaneConfig, TenantQuotas, tenant_of
 from .registry import Registry
 
 __all__ = ["ServeParams", "Server", "latency_percentiles", "record_latency"]
@@ -156,6 +157,20 @@ class Server:
                 quantum=self.params.qos_quantum,
                 weights=self.params.tenant_weights,
             ),
+        )
+        # Bounded per-tenant metric labels: the tenant key is client-
+        # controlled (header/payload), so minting counter names from it
+        # raw is a cardinality DoS on the telemetry registry and the
+        # Prometheus exposition.  Configured tenants (weights/quotas)
+        # are always labelled; unconfigured ones claim a label first-
+        # come up to the cap, and everything past it folds into the
+        # "other" bucket.  Lanes/quotas/trace envelopes keep raw keys.
+        self._metric_tenants = {DEFAULT_TENANT}
+        self._metric_tenants.update(self.queue.lanes.weights)
+        self._metric_tenants.update(self.quotas.quotas)
+        self._metric_tenant_cap = max(
+            len(self._metric_tenants),
+            int(os.environ.get("SKYLARK_QOS_TENANT_METRICS_MAX", "32")),
         )
         self.warm_summary: dict | None = None
         self.primed: list[str] = []
@@ -328,8 +343,9 @@ class Server:
         if entry is None:  # ping/stats answered inline
             return fut
         entry.tenant = tenant_of(request)
+        entry.tenant_label = self._tenant_label(entry.tenant)
         entry.trace["tenant"] = entry.tenant
-        self._tenant_inc(entry.tenant, "requests")
+        self._tenant_inc(entry.tenant_label, "requests")
         # Trace minting at admission: None (no allocation) with
         # telemetry off; the context's event list aliases entry.trace's.
         entry.tctx = telemetry.mint(
@@ -344,7 +360,7 @@ class Server:
         if entry.tctx is not None:
             entry.trace["trace_id"] = entry.tctx.trace_id
         # -- front-door result cache ---------------------------------------
-        # Key = (placement key, canonical payload CRC, pinned entity
+        # Key = (placement key, canonical payload digest, pinned entity
         # epoch): the epoch component makes a registry mint observable by
         # the VERY NEXT request structurally — it computes a new key and
         # misses.  A hit costs zero device work AND zero queue/quota
@@ -364,12 +380,12 @@ class Server:
                         getattr(entry.entity, "epoch", 0)
                     )
                 telemetry.inc("serve.ok")
-                self._tenant_inc(entry.tenant, "cache_hits")
+                self._tenant_inc(entry.tenant_label, "cache_hits")
                 telemetry.finish_trace(entry.tctx, "ok")
                 ms = (time.monotonic() - t_hit) * 1e3
                 telemetry.observe("serve.latency_ms", ms)
                 record_latency(ms)
-                self._tenant_observe(entry.tenant, ms)
+                self._tenant_observe(entry.tenant_label, ms)
                 fut.set_result(
                     protocol.ok_response(request.get("id"), hit, entry.trace)
                 )
@@ -380,7 +396,7 @@ class Server:
         except QuotaExceededError as e:
             telemetry.inc("serve.shed_quota")
             telemetry.inc("serve.errors")
-            self._tenant_inc(entry.tenant, "shed_quota")
+            self._tenant_inc(entry.tenant_label, "shed_quota")
             entry.trace["events"].append(
                 {
                     "kind": "quota_shed",
@@ -403,7 +419,7 @@ class Server:
         except SkylarkError as e:  # AdmissionError
             telemetry.inc("serve.shed_admission")
             telemetry.inc("serve.errors")
-            self._tenant_inc(entry.tenant, "shed_admission")
+            self._tenant_inc(entry.tenant_label, "shed_admission")
             # The envelope carries the queue state that caused the shed:
             # depth/percentile context a backing-off caller (or a
             # post-mortem) needs, without a second round trip.
@@ -524,10 +540,24 @@ class Server:
 
     # -- internals ----------------------------------------------------------
 
+    def _tenant_label(self, tenant: str) -> str:
+        """Bounded metric label for a client-controlled tenant key:
+        the raw name while the label budget lasts, ``"other"`` after —
+        counter-name cardinality stays capped no matter what an
+        untrusted client sends."""
+        with self._stats_lock:
+            if tenant in self._metric_tenants:
+                return tenant
+            if len(self._metric_tenants) < self._metric_tenant_cap:
+                self._metric_tenants.add(tenant)
+                return tenant
+        return "other"
+
     def _tenant_inc(self, tenant: str, what: str, n: int = 1) -> None:
         # Per-tenant counter names are f-strings — gate on the telemetry
         # switch so a disabled run stays allocation-free (the pinned
-        # disabled-telemetry contract).
+        # disabled-telemetry contract).  ``tenant`` here is always the
+        # entry's bounded ``tenant_label``, never the raw client key.
         if telemetry.enabled():
             telemetry.inc(f"serve.tenant.{tenant}.{what}", n)
 
@@ -564,7 +594,7 @@ class Server:
             return
         entry.cache_key = (
             protocol.placement_key(entry.request),
-            payload_crc(src),
+            payload_digest(src),
             int(getattr(entry.entity, "epoch", 0)),
         )
         entry.cache_entity = (
@@ -873,7 +903,7 @@ class Server:
                 e.trace["queue_ms"] = round(waited_ms, 4)
                 if e.deadline is not None and now > e.deadline:
                     telemetry.inc("serve.shed_deadline")
-                    self._tenant_inc(e.tenant, "shed_deadline")
+                    self._tenant_inc(e.tenant_label, "shed_deadline")
                     e.trace["events"].append(
                         {
                             "kind": "deadline_shed",
@@ -918,7 +948,7 @@ class Server:
                 ms = (done - e.t_admit) * 1e3
                 telemetry.observe("serve.latency_ms", ms)
                 record_latency(ms)
-                self._tenant_observe(e.tenant, ms)
+                self._tenant_observe(e.tenant_label, ms)
 
     def _fold_key_stats(self, live, busy_s: float) -> None:
         """Per-placement-key throughput accounting, fed by every batch

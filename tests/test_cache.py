@@ -25,7 +25,11 @@ from libskylark_tpu import serve
 from libskylark_tpu.core.context import SketchContext
 from libskylark_tpu.graph.graph import SimpleGraph
 from libskylark_tpu.serve import batcher
-from libskylark_tpu.serve.cache import ResultCache, payload_crc
+from libskylark_tpu.serve.cache import (
+    ResultCache,
+    payload_crc,
+    payload_digest,
+)
 from libskylark_tpu.serve.registry import Registry
 from libskylark_tpu.serve.router import choose_replica
 from libskylark_tpu.utils import exceptions as ex
@@ -59,17 +63,23 @@ def _server(seed=1, **params):
 # the cache object: keys, bounds, invalidation
 
 
-def test_payload_crc_is_stable_and_discriminating():
+def test_payload_digest_is_stable_and_discriminating():
     b = np.arange(8, dtype=np.float64)
-    assert payload_crc(b) == payload_crc(b.copy())  # bitwise identity
-    assert payload_crc(b) != payload_crc(b.astype(np.float32))
-    assert payload_crc(b) != payload_crc(b.reshape(2, 4))
+    assert payload_digest(b) == payload_digest(b.copy())  # bitwise identity
+    assert payload_digest(b) != payload_digest(b.astype(np.float32))
+    assert payload_digest(b) != payload_digest(b.reshape(2, 4))
     # framing: nesting and container kind both matter
-    assert payload_crc((1, (2, 3))) != payload_crc((1, 2, 3))
-    assert payload_crc([1, 2]) != payload_crc((1, 2))
+    assert payload_digest((1, (2, 3))) != payload_digest((1, 2, 3))
+    assert payload_digest([1, 2]) != payload_digest((1, 2))
     # dicts hash order-independently
-    assert payload_crc({"a": 1, "b": 2}) == payload_crc({"b": 2, "a": 1})
-    assert payload_crc(B) < 2**64  # packed doubled crc32
+    assert payload_digest({"a": 1, "b": 2}) == payload_digest(
+        {"b": 2, "a": 1}
+    )
+    # a real 128-bit hash (BLAKE2b), NOT a CRC: crc32 is linear over
+    # GF(2), so equal-length crc collisions survived any number of
+    # domain-prefixed crc passes — a silent wrong-bits hazard at QPS
+    assert payload_digest(B) < 2**128
+    assert payload_crc is payload_digest  # legacy name kept
 
 
 def test_lru_entry_bound_and_byte_budget():
@@ -104,6 +114,45 @@ def test_invalidate_drops_only_the_entity_and_copies_out():
     got = c.get(("k3", 0, 1))
     got["v"] = 999
     assert c.get(("k3", 0, 1)) == {"v": 3}
+
+
+def test_cached_values_are_isolated_from_callers():
+    """Neither side of the cache can reach the stored bits (REVIEW):
+    put() deep-copies-and-freezes, so the producer keeping its live
+    reference (the batcher's response envelope) cannot alter the entry;
+    get() rebuilds containers and hands ndarrays back as read-only
+    views, so writing into a hit raises instead of poisoning every
+    subsequent hit."""
+    c = ResultCache(max_entries=16, max_bytes=10**6, enabled=True)
+
+    # producer-side: mutating the object AFTER put() changes nothing
+    arr = np.arange(4, dtype=np.float64)
+    rep = {"result": arr, "cluster": [1, 2], "nested": {"m": [3]}}
+    c.put(("k", 0, 1), rep)
+    arr[:] = -1.0
+    rep["cluster"].append(99)
+    rep["nested"]["m"].append(99)
+    got = c.get(("k", 0, 1))
+    assert np.array_equal(got["result"], np.arange(4, dtype=np.float64))
+    assert got["cluster"] == [1, 2] and got["nested"]["m"] == [3]
+
+    # consumer-side: nested containers are fresh per hit...
+    got["cluster"].append(7)
+    got["nested"]["m"].append(7)
+    again = c.get(("k", 0, 1))
+    assert again["cluster"] == [1, 2] and again["nested"]["m"] == [3]
+    # ...and arrays are read-only views — mutation raises, never aliases
+    with pytest.raises(ValueError):
+        again["result"][0] = 123.0
+    assert np.array_equal(
+        c.get(("k", 0, 1))["result"], np.arange(4, dtype=np.float64)
+    )
+
+    # bare-ndarray values get the same treatment
+    c.put(("k2", 0, 1), np.ones(3))
+    hit = c.get(("k2", 0, 1))
+    with pytest.raises(ValueError):
+        hit[0] = 5.0
 
 
 def test_cache_env_knobs(monkeypatch):

@@ -19,6 +19,8 @@ The load-bearing contracts:
   Prometheus exposition and the skylark-top tenant table.
 """
 
+import threading
+import time
 from concurrent.futures import Future
 
 import numpy as np
@@ -133,6 +135,35 @@ def test_admission_depth_cap_stays_global():
     q.close()
 
 
+def test_depth_freed_as_batch_forms_during_coalesce_window():
+    """Queue depth is released entry-by-entry as ``take_batch`` pops
+    (REVIEW): an in-flight batch lingering in the coalesce window no
+    longer counts against ``max_depth``, so a same-key arrival near
+    capacity is admitted (and coalesced) instead of shed 112."""
+    q = AdmissionQueue(1, lanes=LaneConfig(quantum=1))
+    q.offer(_entry(0, key=("k",)))
+    out = {}
+    t = threading.Thread(
+        target=lambda: out.update(batch=q.take_batch(4, window_s=0.5))
+    )
+    t.start()
+    deadline = time.monotonic() + 2.0
+    while True:
+        try:
+            q.offer(_entry(1, key=("k",)))
+            break
+        except ex.AdmissionError:
+            # the taker has not popped the head yet — depth still held
+            if time.monotonic() > deadline:
+                t.join(timeout=5)
+                pytest.fail("offer shed 112 for the whole linger window")
+            time.sleep(0.01)
+    t.join(timeout=5)
+    q.close()
+    # the admitted arrival coalesced into the lingering batch
+    assert [e.request["op"] for e in out["batch"]] == ["ls_solve"] * 2
+
+
 # ---------------------------------------------------------------------------
 # token-bucket quotas: deterministic, per-tenant, code 117
 
@@ -233,6 +264,43 @@ def test_tenant_stamped_and_folded_into_telemetry(monkeypatch):
         text = telemetry.prometheus_text()
         assert "skylark_serve_tenant_acme_requests_total 2" in text
         assert "skylark_serve_cache_hit_total 2" in text
+    finally:
+        telemetry.REGISTRY.reset()
+
+
+def test_tenant_metric_label_cardinality_is_bounded(monkeypatch):
+    """Counter-name cardinality cap (REVIEW): the tenant key is client-
+    controlled (header/payload), so an attacker cycling tenant names
+    must NOT mint unbounded ``serve.tenant.*`` counters.  Configured
+    tenants (weights/quotas) always keep their label; past the
+    ``SKYLARK_QOS_TENANT_METRICS_MAX`` budget the rest fold into the
+    ``other`` bucket — while lanes, quotas, and trace envelopes keep
+    the raw key."""
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    monkeypatch.setenv("SKYLARK_QOS_TENANT_METRICS_MAX", "2")
+    telemetry.REGISTRY.reset()
+    try:
+        srv = _server(tenant_quotas="vip:100:200")
+        # no worker started: requests queue, door-side counters mint
+        futs = [
+            srv.submit(serve.make_request(
+                "ls_solve", system="sys", b=B, tenant=f"mallory-{i}"
+            ))
+            for i in range(6)
+        ]
+        futs.append(srv.submit(serve.make_request(
+            "ls_solve", system="sys", b=B, tenant="vip"
+        )))
+        tenants = telemetry.snapshot()["serve"]["tenants"]
+        assert tenants["vip"]["requests"] == 1  # configured: labelled
+        assert tenants["other"]["requests"] == 6  # the flood folds
+        assert not any(t.startswith("mallory") for t in tenants)
+        # the QoS planes still see every raw tenant — only metric
+        # labels are bounded
+        depth = srv.queue.depth_by_tenant()
+        assert sum(1 for t in depth if t.startswith("mallory")) == 6
+        srv.stop()
+        assert all(f.done() for f in futs)
     finally:
         telemetry.REGISTRY.reset()
 
