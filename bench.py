@@ -1292,6 +1292,102 @@ def bench_cache(on_tpu, table):
           p99_solo / p99_mixed, table, contention=None)
 
 
+def bench_durability(on_tpu, table):
+    """Durable serve state (docs/serving.md, "Durable serving"):
+
+    - **Update-op QPS, journal-on vs journal-off**: the same serial
+      server driving idempotency-keyed row appends through the wire
+      ``update`` op, once process-state only and once with a
+      ``state_dir`` — so every mint pays a CRC frame + fsync before it
+      publishes.  ``vs_baseline`` on the journal-on row is on/off; the
+      acceptance floor is 0.8x (durability may cost at most 20% of
+      update throughput at bench scale).
+    - **Kill-to-placeable recovery latency**: ``Registry.recover`` wall
+      seconds on a state dir holding 1k journaled updates (smoke: 100),
+      compaction OFF (pure tail replay) vs compaction ON (snapshot +
+      short tail).  ``vs_baseline`` on the compacted row is
+      replay/compacted — the snapshot path must not lose to replaying
+      every record through the real mutators.
+    """
+    import shutil
+    import tempfile
+
+    from libskylark_tpu import serve
+    from libskylark_tpu.serve.journal import Journal
+    from libskylark_tpu.serve.registry import Registry
+
+    n_updates = 32 if _SMOKE else 192
+    n_recover = 100 if _SMOKE else 1000
+    m, n = (2048, 32) if on_tpu else (256, 8)
+    rng = np.random.default_rng(17)
+    A = rng.standard_normal((m, n))
+    rows = [rng.standard_normal((1, n)) for _ in range(8)]
+
+    def drive(state_dir):
+        srv = serve.Server(
+            serve.ServeParams(warm_start=False, prime=False,
+                              state_dir=state_dir),
+            seed=13,
+        )
+        # CWT: the hash-family transform with a columnwise partial
+        # rule — FJLT has none and refuses live appends.
+        srv.register_system(
+            "sys", A, context=SketchContext(seed=29), sketch_type="CWT",
+            sketch_size=4 * n, capacity=m + n_updates + 8,
+        )
+        srv.start()
+        # Warm the append path before timing (first call pays traces).
+        srv.call(op="update", system="sys", append=rows[0],
+                 idem_key="warm")
+        t0 = time.perf_counter()
+        for i in range(n_updates):
+            r = srv.call(op="update", system="sys", append=rows[i % 8],
+                         idem_key=f"bench-{i}")
+            if not r["ok"]:
+                raise RuntimeError(r["error"]["message"])
+        wall = time.perf_counter() - t0
+        srv.stop()
+        return n_updates / wall
+
+    def build_state(directory, compact_every):
+        reg = Registry(
+            journal=Journal(directory, compact_every=compact_every)
+        )
+        reg.register_system(
+            "sys", A, context=SketchContext(seed=29), sketch_type="CWT",
+            sketch_size=4 * n, capacity=m + n_recover + 8,
+        )
+        for i in range(n_recover):
+            reg.append_system_rows("sys", rows[i % 8],
+                                   idem=("bench", str(i)))
+
+    with tempfile.TemporaryDirectory() as td:
+        qps_off = drive(None)
+        qps_on = drive(os.path.join(td, "qps"))
+        _emit("serve update QPS journal-off", qps_off, "req/s", 1.0,
+              table, contention=None)
+        _emit("serve update QPS journal-on", qps_on, "req/s",
+              qps_on / qps_off, table, contention=None)
+        shutil.rmtree(os.path.join(td, "qps"))
+
+        replay_dir = os.path.join(td, "replay")
+        snap_dir = os.path.join(td, "snap")
+        build_state(replay_dir, 0)            # journal only: full replay
+        build_state(snap_dir, 256)            # snapshot + short tail
+        t0 = time.perf_counter()
+        reg = Registry.recover(replay_dir)
+        t_replay = time.perf_counter() - t0
+        assert reg.epoch == n_recover + 1
+        t0 = time.perf_counter()
+        reg = Registry.recover(snap_dir)
+        t_snap = time.perf_counter() - t0
+        assert reg.epoch == n_recover + 1
+    _emit("serve recovery replay-only", t_replay, "s", 1.0, table,
+          contention=None)
+    _emit("serve recovery compacted", t_snap, "s", t_replay / t_snap,
+          table, contention=None)
+
+
 def bench_refine(on_tpu, table):
     """Certified mixed-precision refinement vs the exact f64 QR solve
     (docs/performance.md): wall-clock to MATCHED accuracy on the same
@@ -2564,6 +2660,11 @@ def main() -> None:
         # cache + multi-tenant QoS lanes (docs/serving.md, "QoS +
         # caching") — hot-set QPS cache-on vs off, and the
         # adversarial-tenant fairness p99 pair.
+        # Round-19 rows lead (never captured): durable serve state
+        # (docs/serving.md, "Durable serving") — update-op QPS with the
+        # write-ahead journal on vs off (floor 0.8x) and
+        # kill-to-placeable recovery latency, compacted vs replay-only.
+        ("serve durability", 60, lambda: bench_durability(on_tpu, table)),
         ("serve cache", 60, lambda: bench_cache(on_tpu, table)),
         # Round-17 rows next (never captured): elastic multi-host
         # BlockADMM training (docs/distributed_training.md) — world=1

@@ -132,6 +132,23 @@ def main(argv=None) -> int:
                         "autoscaler spawns even with shallow queues")
     p.add_argument("--autoscale-interval", type=float, default=2.0,
                    help="autoscale decision period in seconds")
+    p.add_argument("--state-dir", default=None, metavar="DIR",
+                   help="durable serve state: every registry mutation is "
+                        "journaled (write-ahead, fsync'd) to DIR before "
+                        "it publishes, with periodic snapshot compaction; "
+                        "with --replicas K each replica journals into "
+                        "DIR/replica-i")
+    p.add_argument("--recover", action="store_true",
+                   help="restore the registry from --state-dir (snapshot "
+                        "+ journal tail) before serving — the restarted "
+                        "replica rejoins at the exact epoch it died at; "
+                        "--model/--system names already recovered are "
+                        "skipped")
+    p.add_argument("--journal-compact-every", type=int, default=None,
+                   metavar="N",
+                   help="journal records between snapshot compactions "
+                        "(default SKYLARK_JOURNAL_COMPACT_EVERY or 256; "
+                        "0 disables compaction)")
     p.add_argument("--x64", action="store_true")
     add_perf_args(p)
     add_policy_args(p)
@@ -167,16 +184,46 @@ def main(argv=None) -> int:
         warm_start=False,  # setup_policy above already replayed
         prime=args.prime,
         workers=args.workers,
+        state_dir=args.state_dir,
+        recover=args.recover,
+        journal_compact_every=args.journal_compact_every,
     )
 
+    fleet_mode = args.replicas > 1 or args.autoscale
+    made = [0]  # per-replica state subdirectories in fleet mode
+
     def make_server() -> "serve.Server":
-        server = serve.Server(params, seed=args.seed)
+        import os as _os
+        from dataclasses import replace as _replace
+
+        p_i = params
+        if args.state_dir is not None and fleet_mode:
+            # One journal per replica: the WAL is single-writer (one
+            # append handle, one epoch counter), so fleet members must
+            # not share a journal file.
+            p_i = _replace(
+                params,
+                state_dir=_os.path.join(
+                    args.state_dir, f"replica-{made[0]}"
+                ),
+            )
+        made[0] += 1
+        server = serve.Server(p_i, seed=args.seed)
+        recovered = server.registry.describe() if args.recover else None
         for spec in args.model:
             name, path = _name_path(spec, "--model")
+            if recovered is not None and name in recovered["models"]:
+                print(f"model {name!r} recovered from journal",
+                      file=sys.stderr)
+                continue
             server.registry.load_model(name, path)
             print(f"model {name!r} <- {path}", file=sys.stderr)
         for spec in args.system:
             name, path = _name_path(spec, "--system")
+            if recovered is not None and name in recovered["systems"]:
+                print(f"system {name!r} recovered from journal",
+                      file=sys.stderr)
+                continue
             A = np.load(path)
             server.registry.register_system(
                 name, A,

@@ -38,12 +38,28 @@ import urllib.request
 __all__ = ["main", "render_frame"]
 
 
-def _fetch_json(url: str, timeout: float = 2.0):
+def _fetch_json(url: str, timeout: float = 2.0) -> dict:
+    """GET + parse, ALWAYS returning a dict: transport failures, JSON
+    that does not parse (a dying replica truncates mid-body), and JSON
+    that parses to a non-object all come back as ``{"_error": ...}`` —
+    a displayed fact, never a dashboard traceback."""
     try:
         with urllib.request.urlopen(url, timeout=timeout) as fh:
-            return json.loads(fh.read().decode())
+            obj = json.loads(fh.read().decode())
     except Exception as e:  # noqa: BLE001 — a dead server is a displayed fact
         return {"_error": f"{type(e).__name__}: {e}"}
+    if not isinstance(obj, dict):
+        return {"_error": f"malformed response ({type(obj).__name__})"}
+    return obj
+
+
+def _dict(v) -> dict:
+    """A truncated/hostile payload's nested field, dict-or-nothing."""
+    return v if isinstance(v, dict) else {}
+
+
+def _list(v) -> list:
+    return list(v) if isinstance(v, (list, tuple)) else []
 
 
 def _tail_ledgers(telemetry_dir: str, limit: int = 2048) -> dict:
@@ -81,16 +97,16 @@ def _fmt(v, nd=2):
 def _serve_lines(stats: dict, health: dict, traces: dict) -> list[str]:
     if "_error" in stats:
         return [f"  server: UNREACHABLE ({stats['_error']})"]
-    c = stats.get("counters", {})
-    lat = stats.get("latency", {})
+    c = _dict(stats.get("counters"))
+    lat = _dict(stats.get("latency"))
     reqs = c.get("requests", 0)
     out = []
     backend = health.get("backend", "?")
-    reg = health.get("registry", {})
+    reg = _dict(health.get("registry"))
     out.append(
         f"  backend {backend}  models {reg.get('models', '?')}"
         f"  systems {reg.get('systems', '?')}"
-        f"  primed {len(health.get('primed', []))}"
+        f"  primed {len(_list(health.get('primed')))}"
         f"  worker {'up' if health.get('worker_alive') else 'DOWN'}"
     )
     coalesce = (c.get("coalesced", 0) / reqs) if reqs else None
@@ -140,9 +156,9 @@ def _serve_lines(stats: dict, health: dict, traces: dict) -> list[str]:
                 f"  {shed}"
             )
     if traces and "_error" not in traces:
-        viol = traces.get("violations", [])
+        viol = _list(traces.get("violations"))
         line = (
-            f"  traces: {len(traces.get('recent', []))} recent, "
+            f"  traces: {len(_list(traces.get('recent')))} recent, "
             f"{len(viol)} violating"
         )
         if viol:
@@ -189,7 +205,7 @@ def _rank_lines(hosts: dict) -> list[str]:
 def _autoscale_lines(scale: dict) -> list[str]:
     """The membership control-loop panel: current shape vs targets and
     the tail of the decision ledger."""
-    params = scale.get("params", {})
+    params = _dict(scale.get("params"))
     out = [
         f"  tick {scale.get('tick')}  bounds"
         f" [{params.get('min_replicas')}, {params.get('max_replicas')}]"
@@ -197,14 +213,17 @@ def _autoscale_lines(scale: dict) -> list[str]:
         f"  p99_high {_fmt(params.get('p99_high_ms'))} ms"
         f"  cooldown {scale.get('cooldown')}"
     ]
-    owned = scale.get("owned") or []
-    draining = scale.get("draining") or []
+    owned = [str(n) for n in _list(scale.get("owned"))]
+    draining = [str(n) for n in _list(scale.get("draining"))]
     out.append(
         f"  owned {', '.join(owned) or '(none)'}"
         f"  draining {', '.join(draining) or '(none)'}"
     )
-    for rec in (scale.get("ledger") or [])[-4:]:
-        bits = [f"  tick {rec.get('tick'):>4}: {rec.get('action', '?')}"]
+    for rec in _list(scale.get("ledger"))[-4:]:
+        if not isinstance(rec, dict):
+            continue
+        bits = [f"  tick {str(rec.get('tick', '?')):>4}:"
+                f" {rec.get('action', '?')}"]
         if rec.get("replica"):
             bits.append(str(rec["replica"]))
         bits.append(
@@ -227,8 +246,8 @@ def _fleet_table(rows: list) -> list[str]:
             out.append(f"  {name:<30} UNREACHABLE")
             continue
         qps = sum(
-            float(v.get("rows_per_s") or 0.0)
-            for v in (load.get("throughput") or {}).values()
+            float(_dict(v).get("rows_per_s") or 0.0)
+            for v in _dict(load.get("throughput")).values()
         )
         cache = load.get("cache") or {}
         cc = (
@@ -239,48 +258,65 @@ def _fleet_table(rows: list) -> list[str]:
         beat = "now" if age is None else f"{_fmt(age, 1)}s ago"
         out.append(
             f"  {name:<30} {str(load.get('queue_depth', '?')):>5}"
-            f"  {qps:>5.1f}  {len(load.get('primed', [])):>6}  {cc:>8}"
+            f"  {qps:>5.1f}  {len(_list(load.get('primed'))):>6}  {cc:>8}"
             f"  {beat}"
         )
     return out
 
 
-def render_frame(args) -> str:
-    """One full frame as a string (``--once`` prints exactly this)."""
+def render_frame(args, status: dict | None = None) -> str:
+    """One full frame as a string (``--once`` prints exactly this).
+
+    ``status`` (optional) is filled with ``{"urls": N, "answered": M}``
+    so ``--once`` can report whether ANY replica responded.  A replica
+    emitting malformed or truncated JSON renders as an UNREACHABLE-
+    style row — one dying member never tracebacks the dashboard."""
     lines = [f"skylark-top  {time.strftime('%H:%M:%S')}"]
     urls = args.url or []
     if isinstance(urls, str):  # programmatic callers with a bare string
         urls = [urls]
+    answered = 0
     fleet_rows: list = []
     for base in urls:
         base = base.rstrip("/")
-        health = _fetch_json(base + "/healthz")
-        if len(urls) == 1:
-            stats = _fetch_json(base + "/stats")
-            traces = _fetch_json(base + "/traces")
+        try:
+            health = _fetch_json(base + "/healthz")
+            if "_error" not in health:
+                answered += 1
+            if len(urls) == 1:
+                stats = _fetch_json(base + "/stats")
+                traces = _fetch_json(base + "/traces")
+                lines.append(f"serve {base}")
+                lines += _serve_lines(stats, health, traces)
+            ok = "_error" not in health
+            load = health.get("load") if ok else None
+            if not isinstance(load, dict):
+                load = None
+            fleet = _dict(health.get("fleet")) if ok else None
+            # A router front door has no load report of its own — it is
+            # represented by its expanded members, not an UNREACHABLE
+            # row.
+            if load is not None or (len(urls) > 1 and not fleet):
+                fleet_rows.append((base, load, None))
+            if fleet:  # a router front door: expand its membership table
+                for name, m in sorted(_dict(fleet.get("members")).items()):
+                    m = _dict(m)
+                    if m.get("draining"):
+                        tag = f"{name} (draining)"
+                    elif not m.get("placeable"):
+                        tag = f"{name} (unplaceable)"
+                    else:
+                        tag = name
+                    fleet_rows.append(
+                        (tag, m.get("report"), m.get("heartbeat_age_s"))
+                    )
+            scale = health.get("autoscale") if ok else None
+            if isinstance(scale, dict) and scale:
+                lines.append(f"autoscale {base}")
+                lines += _autoscale_lines(scale)
+        except Exception as e:  # noqa: BLE001 — last-resort row, never a crash
             lines.append(f"serve {base}")
-            lines += _serve_lines(stats, health, traces)
-        load = health.get("load") if "_error" not in health else None
-        fleet = health.get("fleet") if "_error" not in health else None
-        # A router front door has no load report of its own — it is
-        # represented by its expanded members, not an UNREACHABLE row.
-        if load is not None or (len(urls) > 1 and not fleet):
-            fleet_rows.append((base, load, None))
-        if fleet:  # a router front door: expand its membership table
-            for name, m in sorted(fleet.get("members", {}).items()):
-                if m.get("draining"):
-                    tag = f"{name} (draining)"
-                elif not m.get("placeable"):
-                    tag = f"{name} (unplaceable)"
-                else:
-                    tag = name
-                fleet_rows.append(
-                    (tag, m.get("report"), m.get("heartbeat_age_s"))
-                )
-        scale = health.get("autoscale") if "_error" not in health else None
-        if scale:
-            lines.append(f"autoscale {base}")
-            lines += _autoscale_lines(scale)
+            lines.append(f"  server: UNREADABLE ({type(e).__name__}: {e})")
     if len(fleet_rows) > 1:
         lines.append(f"fleet ({len(fleet_rows)} replicas)")
         lines += _fleet_table(fleet_rows)
@@ -292,6 +328,9 @@ def render_frame(args) -> str:
 
         lines.append(f"fleet {args.root}")
         lines += _rank_lines(fold_ledgers(args.root))
+    if status is not None:
+        status["urls"] = len(urls)
+        status["answered"] = answered
     return "\n".join(lines)
 
 
@@ -327,7 +366,13 @@ def main(argv=None) -> int:
     if not (args.url or args.telemetry_dir or args.root):
         p.error("nothing to watch: give --url, --telemetry-dir or --root")
     if args.once:
-        print(render_frame(args))
+        status: dict = {}
+        print(render_frame(args, status))
+        # Exit 0 while ANY polled replica answered (a partially-dead
+        # fleet is still a rendered fact); 1 only when every --url was
+        # unreachable.  Ledger/root-only invocations always exit 0.
+        if status.get("urls") and not status.get("answered"):
+            return 1
         return 0
     try:
         while True:

@@ -28,6 +28,7 @@ __all__ = [
     "FaultPlan",
     "HostFaultPlan",
     "FleetFaultPlan",
+    "JournalFaultPlan",
     "corrupt_checkpoint",
     "corrupt_manifest",
     "tear_ledger_tail",
@@ -314,6 +315,61 @@ class HostFaultPlan(FaultPlan):
                     pass
             self._suicide()
         super().after_commit(chunk)
+
+
+@dataclass
+class JournalFaultPlan(FaultPlan):
+    """Write-ahead-journal chaos schedule for the serve registry's
+    durability layer — the failure modes of a *disk write*, keyed by
+    journal append index (0-based, counted from the ``Journal``'s
+    construction in this process).  ``serve.journal.Journal`` consults
+    the plan at its two crash-window edges, so both halves of the
+    write-ahead contract are driveable from a SIGKILL'd child process
+    (``tests/_journal_child.py``):
+
+    - ``torn_journal_at``: **kill mid-append** — write only the first
+      half of append k's CRC frame (fsync'd, so the torn bytes are
+      really on disk) and SIGKILL.  Recovery must truncate the torn
+      tail, count it, and land at append k-1's epoch.
+    - ``die_after_journal_before_publish``: **kill inside the commit
+      window** — append k reaches the disk durably, then SIGKILL
+      *before* the mutation publishes to the in-memory registry.
+      Recovery must REPLAY that journaled record: the recovered
+      registry lands at append k's epoch — ahead of what the dying
+      process ever served, never behind it.
+
+    Both are real ``SIGKILL``s (no atexit, no flush — the crash model
+    the journal's fsync discipline is built for), one-shot via the
+    inherited ``_fire`` ledger.  Inherits every :class:`FaultPlan`
+    knob, so journal chaos composes with the existing injection
+    points.
+    """
+
+    torn_journal_at: int | None = None
+    die_after_journal_before_publish: int | None = None
+
+    def _suicide(self) -> None:
+        import signal
+
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def kill(self) -> None:
+        """Die NOW — a real SIGKILL, nothing runs after this."""
+        self._suicide()
+
+    def torn_fires(self, index: int) -> bool:
+        """True exactly once, at append ``index``: the journal writes a
+        half frame and then calls :meth:`kill`."""
+        return self._fire("torn_journal", self.torn_journal_at, index)
+
+    def die_after_fires(self, index: int) -> bool:
+        """True exactly once, at append ``index``: the journal has
+        fsync'd the full frame and calls :meth:`kill` before the
+        in-memory publish."""
+        return self._fire(
+            "die_after_journal", self.die_after_journal_before_publish,
+            index,
+        )
 
 
 @dataclass

@@ -21,6 +21,12 @@ Layout (see ``docs/serving.md``):
 - :mod:`.qos` — tenant keys, weighted-fair lane config, token-bucket
   quotas (code-117 ``QuotaExceededError`` sheds);
 - :mod:`.registry` — models + LS systems, loaded once, device-resident;
+- :mod:`.journal` — the durability layer: a CRC-framed write-ahead
+  journal every registry mint appends to (fsync'd) BEFORE it
+  publishes, snapshot compaction into a ``CheckpointStore`` slot, and
+  ``Registry.recover`` — bitwise-identical crash recovery plus the
+  journal-backed idempotency window that makes ``op:"update"``
+  exactly-once across router failover (code-118 ``JournalError``);
 - :mod:`.batcher` — the coalescing executors + solo-retry fault
   isolation (code-108 structured degradation, batch-mates unaffected);
 - :mod:`.server` — the worker loop (``workers=K`` pins K batcher
@@ -64,6 +70,7 @@ from .protocol import (
     placement_key,
     raise_for_error,
 )
+from .journal import Journal
 from .registry import GraphSystem, LSSystem, Registry
 from .router import (
     HttpReplica,
@@ -85,6 +92,7 @@ __all__ = [
     "GraphSystem",
     "HttpReplica",
     "InProcessReplica",
+    "Journal",
     "LSSystem",
     "LaneConfig",
     "Registry",

@@ -55,7 +55,7 @@ class Entry:
         "request", "future", "key", "op", "payload", "squeeze",
         "t_admit", "deadline", "sketch", "counter_base", "entity",
         "trace", "tctx", "tenant", "tenant_label", "cache_key",
-        "cache_entity",
+        "cache_entity", "idem_key",
     )
 
     def __init__(self, request, future, key, op, payload=None):
@@ -89,6 +89,9 @@ class Entry:
         # the entity name it invalidates under — None means uncacheable.
         self.cache_key = None
         self.cache_entity = None
+        # Idempotency key for op:"update" requests — the dedup window
+        # identity is (tenant, idem_key); None for every other op.
+        self.idem_key = None
 
 
 class AdmissionQueue:

@@ -275,12 +275,19 @@ def _execute_update(registry, entries, device=None):
     outs = []
     for e in entries:
         p = e.payload
+        idem = p.get("idem")
         if p["kind"] == "graph_fold":
-            _, rec = registry.fold_graph_edges(p["name"], p["edges"])
+            _, rec = registry.fold_graph_edges(
+                p["name"], p["edges"], idem=idem
+            )
         elif p["kind"] == "row_append":
-            _, rec = registry.append_system_rows(p["name"], p["rows"])
+            _, rec = registry.append_system_rows(
+                p["name"], p["rows"], idem=idem
+            )
         else:
-            _, rec = registry.downdate_system_rows(p["name"], p["drop"])
+            _, rec = registry.downdate_system_rows(
+                p["name"], p["drop"], idem=idem
+            )
         outs.append(dict(rec))
     return outs, len(entries)
 

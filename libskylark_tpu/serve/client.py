@@ -22,23 +22,34 @@ from __future__ import annotations
 
 import http.client
 import json
+import os
 import threading
+import uuid
 from urllib.parse import urlsplit
 
 from .. import telemetry
 from . import protocol
 
-__all__ = ["Client"]
+__all__ = ["Client", "default_timeout_s"]
+
+
+def default_timeout_s() -> float:
+    """The bounded socket read timeout HTTP clients use when none is
+    passed: ``SKYLARK_HTTP_TIMEOUT_S`` (seconds, default 60).  Bounded
+    by default on purpose — a hung replica must surface as a timeout
+    the router can eject on (the 114 path), never block a caller
+    thread forever on ``recv``."""
+    return float(os.environ.get("SKYLARK_HTTP_TIMEOUT_S", "60"))
 
 
 class Client:
     def __init__(self, server=None, *, url: str | None = None,
-                 timeout: float = 60.0):
+                 timeout: float | None = None):
         if (server is None) == (url is None):
             raise ValueError("pass exactly one of server= or url=")
         self._server = server
         self._url = url.rstrip("/") if url else None
-        self._timeout = timeout
+        self._timeout = default_timeout_s() if timeout is None else timeout
         self._local = threading.local()
         if self._url:
             parts = urlsplit(self._url)
@@ -155,6 +166,19 @@ class Client:
         ``neighbors=`` for an out-of-sample projection (exactly one)."""
         return self._unwrap(
             self.call(op="ase_embed", graph=graph, **fields), check
+        )
+
+    def update(self, *, check: bool = False, idem_key: str | None = None,
+               **fields):
+        """Live-registry mutation with exactly-once semantics: mints a
+        fresh idempotency key when the caller supplies none, so a retry
+        of THIS call (client timeout whose first send actually landed,
+        router failover re-placement) can never double-apply — the
+        server's dedup window returns the original epoch receipt."""
+        if idem_key is None:
+            idem_key = uuid.uuid4().hex
+        return self._unwrap(
+            self.call(op="update", idem_key=idem_key, **fields), check
         )
 
     def ping(self) -> bool:

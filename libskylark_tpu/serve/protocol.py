@@ -41,6 +41,13 @@ Request schema::
      # result is the minted epoch-ledger record {name, kind, epoch,
      # ...delta counts}; updates never coalesce and apply exactly once,
      # in admission order
+     "idem_key": str,          # optional update idempotency key (≤256
+                               # chars): the server's journal-backed
+                               # dedup window keyed (tenant, idem_key)
+                               # makes retried/failover-replayed updates
+                               # apply EXACTLY once — a replayed key
+                               # returns the originally minted epoch
+                               # receipt instead of re-executing
      # either:
      "registry_epoch": int,    # pin to an exact registry version: served
                                # bitwise at that epoch, or refused with a
@@ -55,7 +62,7 @@ Response schema::
      "trace": {"queue_ms", "exec_ms", "batch_size", "bucket",
                "coalesced", "events": [...], ...}}
     {"id": ...,
-     "ok": false, "error": {"code": int,    # the 100-117 ladder
+     "ok": false, "error": {"code": int,    # the 100-118 ladder
                             "type": str, "message": str},
      "trace": {...}}
 
@@ -64,8 +71,9 @@ Error codes ride ``utils.exceptions``: admission shed = 112
 retired registry version = 116 (``RegistryEpochError``), per-tenant
 quota shed = 117 (``QuotaExceededError``, carrying
 ``{tenant, rate, burst, retry_after_ms}``), serve-probe numerical
-failures = 108 (``NumericalHealthError``); foreign exceptions degrade
-to the base code 100.
+failures = 108 (``NumericalHealthError``), write-ahead-journal damage
+= 118 (``JournalError``, carrying ``{path, record, reason}``); foreign
+exceptions degrade to the base code 100.
 
 Requests may also carry ``"tenant": str`` — the QoS lane key (the HTTP
 transport maps an ``X-Skylark-Tenant`` header onto it).  Absent tenant
@@ -175,7 +183,7 @@ def error_payload(e: BaseException) -> dict:
     for attr in (
         "queue_depth", "max_depth", "deadline_ms", "waited_ms", "stage",
         "requested", "current", "entity", "tenant", "rate", "burst",
-        "retry_after_ms",
+        "retry_after_ms", "path", "record", "reason",
     ):
         v = getattr(e, attr, None)
         if v is not None:

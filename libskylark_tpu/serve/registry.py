@@ -47,14 +47,20 @@ silently-new bits.
 
 from __future__ import annotations
 
+import os
 import threading
+from collections import OrderedDict
 
 import jax.numpy as jnp
 
 from .. import plans, telemetry
 from ..core.context import SketchContext
 from ..sketch import base as sketch_base
-from ..utils.exceptions import InvalidParameters, UnsupportedError
+from ..utils.exceptions import (
+    InvalidParameters,
+    JournalError,
+    UnsupportedError,
+)
 from .cache import ResultCache, payload_digest
 
 __all__ = ["GraphSystem", "LSSystem", "Registry"]
@@ -447,7 +453,7 @@ class GraphSystem:
 
 
 class Registry:
-    def __init__(self, cache: ResultCache | None = None):
+    def __init__(self, cache: ResultCache | None = None, journal=None):
         self.models: dict[str, object] = {}
         self.systems: dict[str, LSSystem] = {}
         self.graphs: dict[str, GraphSystem] = {}
@@ -465,6 +471,20 @@ class Registry:
         self.epoch = 0
         self.epoch_log: list[dict] = []
         self._lock = threading.RLock()
+        # -- durability (write-ahead journal) -------------------------------
+        # Optional serve/journal.py Journal: every mutation appends its
+        # CRC-framed record (and fsyncs) BEFORE publishing, so a crashed
+        # replica recovers to the exact epoch it died at (recover()).
+        # _replaying suspends journaling while recovery re-executes the
+        # journaled mutations through these same methods.
+        self.journal = journal
+        self._replaying = False
+        # Bounded idempotency-receipt window for exactly-once updates
+        # across router failover: (tenant, idem_key) -> the minted epoch
+        # receipt.  Journal-backed — receipts ride the update records
+        # and the compaction snapshot, so they survive a crash too.
+        self._idem: OrderedDict[tuple[str, str], dict] = OrderedDict()
+        self._idem_window = int(os.environ.get("SKYLARK_IDEM_WINDOW", "1024"))
 
     def _mint(self, kind: str, name: str, obj=None, **attrs) -> dict:
         with self._lock:
@@ -487,6 +507,129 @@ class Registry:
         telemetry.event("registry", "epoch", rec)
         return rec
 
+    # -- durability ---------------------------------------------------------
+
+    def _journal_active(self) -> bool:
+        return self.journal is not None and not self._replaying
+
+    def _journal_append(self, kind, name, payload, attrs, idem=None):
+        """Durably append the mutation's record BEFORE it publishes.
+        Callers hold ``self._lock``, so ``epoch + 1`` is exactly the
+        epoch ``_mint`` will stamp right after the publish."""
+        rec = {
+            "epoch": self.epoch + 1,
+            "kind": kind,
+            "name": name,
+            "attrs": attrs,
+            "payload": payload,
+        }
+        if idem is not None:
+            rec["idem"] = [str(idem[0]), str(idem[1])]
+        self.journal.append(rec)
+
+    def _maybe_compact(self) -> None:
+        j = self.journal
+        if j is None or self._replaying or not j.due():
+            return
+        from .journal import snapshot_registry
+
+        leaves, meta = snapshot_registry(self)
+        j.compact(leaves, meta, step=self.epoch)
+
+    def _record_idem(self, idem, rec) -> None:
+        if idem is None:
+            return
+        key = (str(idem[0]), str(idem[1]))
+        with self._lock:
+            self._idem[key] = dict(rec)
+            self._idem.move_to_end(key)
+            while len(self._idem) > self._idem_window:
+                self._idem.popitem(last=False)
+
+    def idem_receipt(self, tenant, key):
+        """The recorded epoch receipt for ``(tenant, key)``, or ``None``
+        — the dedup lookup the server makes before admitting an
+        ``op:"update"`` that carries an idempotency key."""
+        with self._lock:
+            rec = self._idem.get((str(tenant), str(key)))
+            return dict(rec) if rec is not None else None
+
+    @classmethod
+    def recover(cls, directory, *, cache: ResultCache | None = None,
+                compact_every=None, keep_snapshots: int = 2) -> "Registry":
+        """Rebuild a registry from its durable state directory: restore
+        the newest valid compaction snapshot (field-copy, no recompute),
+        then replay the journal tail through the SAME mutator code paths
+        that minted it — the result is bitwise-identical to the
+        never-crashed registry (entity bits, epoch counter, epoch_log,
+        idempotency window).  A torn final journal line is truncated and
+        counted (``journal.torn_tail``); mid-journal corruption, epoch
+        gaps, or a replay that mints a different record than the journal
+        holds raise :class:`~..utils.exceptions.JournalError` (118).
+        The returned registry keeps the journal attached, so it keeps
+        journaling from the exact epoch it died at."""
+        from .journal import REPLAY_HANDLERS, Journal, read_journal, \
+            restore_registry
+
+        journal = Journal(directory, compact_every=compact_every,
+                          keep_snapshots=keep_snapshots)
+        reg = cls(cache=cache, journal=journal)
+        reg._replaying = True
+        try:
+            snap_epoch = 0
+            snap = journal.load_snapshot()
+            if snap is not None:
+                leaves, meta = snap
+                restore_registry(reg, leaves, meta)
+                snap_epoch = reg.epoch
+            # Journal.__init__ already truncated any torn tail, so this
+            # read sees a clean prefix.
+            records, _ = read_journal(journal.path)
+            replayed = 0
+            for rec in records:
+                if rec["epoch"] <= snap_epoch:
+                    # Folded into the snapshot already (a crash between
+                    # snapshot commit and journal truncation leaves
+                    # these behind — harmless).
+                    continue
+                if rec["epoch"] != reg.epoch + 1:
+                    raise JournalError(
+                        f"journal epoch gap: record minted at epoch "
+                        f"{rec['epoch']} follows registry epoch "
+                        f"{reg.epoch}",
+                        path=journal.path, reason="epoch-gap",
+                    )
+                handler = REPLAY_HANDLERS.get(rec["kind"])
+                if handler is None:
+                    raise JournalError(
+                        f"journal record kind {rec['kind']!r} has no "
+                        "replay handler",
+                        path=journal.path, reason="unknown-kind",
+                    )
+                handler(reg, rec)
+                minted = reg.epoch_log[-1]
+                expect = {"epoch": rec["epoch"], "kind": rec["kind"],
+                          "name": rec["name"], **rec["attrs"]}
+                if minted != expect:
+                    raise JournalError(
+                        f"replay divergence at epoch {rec['epoch']}: "
+                        f"replay minted {minted!r} but the journal "
+                        f"recorded {expect!r}",
+                        path=journal.path, reason="replay-divergence",
+                    )
+                replayed += 1
+                telemetry.inc("journal.replays")
+            telemetry.event("journal", "recover", {
+                "dir": str(directory),
+                "epoch": reg.epoch,
+                "snapshot_epoch": snap_epoch,
+                "replayed": replayed,
+                "torn_truncated": journal.torn_truncated,
+            })
+        finally:
+            reg._replaying = False
+        return reg
+
     # -- models -------------------------------------------------------------
 
     def register_model(self, name: str, model) -> None:
@@ -494,9 +637,18 @@ class Registry:
             raise InvalidParameters(
                 f"model {name!r} has no predict(); got {type(model).__name__}"
             )
-        self.models[name] = model
-        self._drop_jits(name)
-        self._mint("register", name, model, entity="model")
+        with self._lock:
+            if self._journal_active():
+                from .journal import _enc_array, encode_model
+
+                self._journal_append(
+                    "register", name, encode_model(model, _enc_array),
+                    {"entity": "model"},
+                )
+            self.models[name] = model
+            self._drop_jits(name)
+            self._mint("register", name, model, entity="model")
+            self._maybe_compact()
 
     def load_model(self, name: str, path: str):
         """Load a saved ``ml/model.py`` JSON model once; serve forever."""
@@ -507,7 +659,7 @@ class Registry:
         return model
 
     def update_model(self, name: str, model=None, *, append=None,
-                     drop=None):
+                     drop=None, idem=None):
         """Live model update: swap wholesale (``model=``), or for a
         :class:`~..ml.model.KernelModel` append/drop training centers —
         predict is linear in the center rows, so the delta is exact
@@ -540,6 +692,12 @@ class Registry:
                 X_tr = np.concatenate([X_tr, X_new])
                 A = np.concatenate([A, A_new])
                 delta = {"appended": int(X_new.shape[0])}
+                # The NORMALIZED delta (post dtype-cast/reshape) is the
+                # canonical journal payload: replay re-runs this exact
+                # concatenation on identical bits.
+                journal_payload = lambda enc: {  # noqa: E731
+                    "append_X": enc(X_new), "append_A": enc(A_new),
+                }
             else:
                 keep = np.setdiff1d(
                     np.arange(X_tr.shape[0]), np.asarray(drop, np.int64)
@@ -547,6 +705,8 @@ class Registry:
                 dropped = int(X_tr.shape[0]) - int(keep.shape[0])
                 X_tr, A = X_tr[keep], A[keep]
                 delta = {"dropped": dropped}
+                drop_ids = [int(i) for i in np.asarray(drop, np.int64)]
+                journal_payload = lambda enc: {"drop": drop_ids}  # noqa: E731
             model = KernelModel(old.kernel, X_tr, A, classes=old.classes)
         elif not hasattr(model, "predict"):
             raise InvalidParameters(
@@ -555,10 +715,25 @@ class Registry:
             )
         else:
             delta = {"swapped": True}
+            swapped = model
+
+            def journal_payload(enc):
+                from .journal import encode_model
+
+                return {"model": encode_model(swapped, enc)}
         with self._lock:
+            if self._journal_active():
+                from .journal import _enc_array
+
+                self._journal_append(
+                    "model_update", name, journal_payload(_enc_array),
+                    dict(delta), idem=idem,
+                )
             self.models[name] = model
             self._drop_jits(name)
             rec = self._mint("model_update", name, model, **delta)
+            self._record_idem(idem, rec)
+            self._maybe_compact()
         return model, rec
 
     def _drop_jits(self, name: str) -> None:
@@ -612,11 +787,21 @@ class Registry:
             s = int(sketch_size or min(m, max(4 * n, n + 16)))
             sketch = sketch_base.create_sketch(sketch_type, dom, s, context)
         system = LSSystem(name, A, sketch, capacity=capacity)
-        self.systems[name] = system
-        self._mint("register", name, system, entity="system")
+        with self._lock:
+            if self._journal_active():
+                from .journal import _enc_array, encode_system
+
+                self._journal_append(
+                    "register", name, encode_system(system, _enc_array),
+                    {"entity": "system"},
+                )
+            self.systems[name] = system
+            self._mint("register", name, system, entity="system")
+            self._maybe_compact()
         return system
 
-    def append_system_rows(self, name: str, rows) -> tuple[LSSystem, int]:
+    def append_system_rows(self, name: str, rows,
+                           idem=None) -> tuple[LSSystem, int]:
         """Live row append: publish a NEW version with ``rows`` folded
         into the retained ``S·A`` (exact ``apply_slice`` delta), mint an
         epoch, and leave the superseded version's bits untouched for
@@ -624,25 +809,47 @@ class Registry:
         with self._lock:
             old = self.get_system(name)
             new = old.appended(rows)
+            if self._journal_active():
+                from .journal import _enc_array
+
+                # Journal the rows as the new version holds them (post
+                # dtype-cast/reshape): the exact bits replay will append.
+                self._journal_append(
+                    "row_append", name,
+                    {"rows": _enc_array(new.A[old.m:new.m])},
+                    {"rows": int(new.m - old.m), "m": new.m}, idem=idem,
+                )
             self.systems[name] = new
             rec = self._mint(
                 "row_append", name, new,
                 rows=int(new.m - old.m), m=new.m,
             )
+            self._record_idem(idem, rec)
+            self._maybe_compact()
         return new, rec
 
-    def downdate_system_rows(self, name: str, indices) -> tuple[LSSystem, int]:
+    def downdate_system_rows(self, name: str, indices,
+                             idem=None) -> tuple[LSSystem, int]:
         """Live row downdate (retirement): the mirror of append —
         subtract the rows' sketch contribution, re-QR, mint an epoch."""
         with self._lock:
             old = self.get_system(name)
             new = old.downdated(indices)
+            if self._journal_active():
+                self._journal_append(
+                    "row_downdate", name,
+                    {"drop": sorted({int(i) for i in indices})},
+                    {"rows": len(new.retired) - len(old.retired),
+                     "retired": len(new.retired)}, idem=idem,
+                )
             self.systems[name] = new
             rec = self._mint(
                 "row_downdate", name, new,
                 rows=len(new.retired) - len(old.retired),
                 retired=len(new.retired),
             )
+            self._record_idem(idem, rec)
+            self._maybe_compact()
         return new, rec
 
     def get_system(self, name: str) -> LSSystem:
@@ -670,24 +877,47 @@ class Registry:
         ``ppr`` / ``ase_embed`` requests afterwards serve from the
         resident embedding and the memoized diffusion."""
         gsys = GraphSystem(name, G, k=k, context=context, params=params)
-        self.graphs[name] = gsys
-        self._mint("register", name, gsys, entity="graph")
+        with self._lock:
+            if self._journal_active():
+                from .journal import _enc_array, encode_graph
+
+                self._journal_append(
+                    "register", name, encode_graph(gsys, _enc_array),
+                    {"entity": "graph"},
+                )
+            self.graphs[name] = gsys
+            self._mint("register", name, gsys, entity="graph")
+            self._maybe_compact()
         return gsys
 
-    def fold_graph_edges(self, name: str, pairs) -> tuple[GraphSystem, int]:
+    def fold_graph_edges(self, name: str, pairs,
+                         idem=None) -> tuple[GraphSystem, int]:
         """Live edge fold: publish a NEW version whose retained ``Ω·A``
         absorbed the batch (one delta fold + the small-math embedding
         refresh — bitwise ≡ re-registration of the merged graph), mint
         an epoch.  In-flight batches pinned to the old version keep its
         exact bits; the old object is simply no longer the head."""
+        pairs = [(u, v) for u, v in pairs]
         with self._lock:
             old = self.get_graph(name)
             new, folded = old.folded(pairs)
+            if self._journal_active():
+                from .journal import _json_vertex
+
+                self._journal_append(
+                    "graph_fold", name,
+                    {"edges": [[_json_vertex(u), _json_vertex(v)]
+                               for u, v in pairs]},
+                    {"edges": folded, "volume": int(new.G.volume)},
+                    idem=idem,
+                )
             self.graphs[name] = new
             rec = self._mint(
                 "graph_fold", name, new,
                 edges=folded, volume=int(new.G.volume),
             )
+            self._record_idem(idem, rec)
+            self._maybe_compact()
         return new, rec
 
     def get_graph(self, name: str) -> GraphSystem:

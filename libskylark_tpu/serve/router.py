@@ -90,7 +90,7 @@ class HttpReplica:
     failure surfaces as the future's exception, which the router's
     failover path converts into ejection + re-placement."""
 
-    def __init__(self, name: str, url: str, *, timeout: float = 60.0,
+    def __init__(self, name: str, url: str, *, timeout: float | None = None,
                  pool: ThreadPoolExecutor | None = None,
                  retries: int = 3, backoff: float = 0.05):
         import random
@@ -99,6 +99,9 @@ class HttpReplica:
 
         self.name = name
         self.url = url.rstrip("/")
+        # timeout=None defers to SKYLARK_HTTP_TIMEOUT_S (default 60s,
+        # bounded): a hung replica's recv must raise so the failover /
+        # ejection ladder (114) can run instead of wedging this thread.
         self._client = Client(url=url, timeout=timeout)
         self._pool = pool
         self.retries = int(retries)
@@ -129,6 +132,12 @@ class HttpReplica:
                 health = self._client.healthz()
                 break
             except Exception as e:  # noqa: BLE001 — transport loss
+                if isinstance(e, TimeoutError):
+                    # A hung (not dead) replica: recv hit the bounded
+                    # socket timeout.  Counted separately from generic
+                    # retries — a fleet where these climb has replicas
+                    # wedged in compute, not a flaky network.
+                    telemetry.inc("router.report_timeouts")
                 if attempt >= self.retries:
                     raise
                 # Full jitter on the exponential step: a fleet's router
@@ -274,7 +283,7 @@ class Router:
     # -- membership ---------------------------------------------------------
 
     def join(self, name: str, server=None, *, url: str | None = None,
-             timeout: float = 60.0) -> dict:
+             timeout: float | None = None) -> dict:
         """Admit a replica (in-process ``server=`` or remote ``url=``).
 
         Fetches its load report, fences its registry signature against
@@ -328,10 +337,11 @@ class Router:
 
     def handle_join(self, payload: dict) -> dict:
         """The ``POST /join`` body: ``{"name": ..., "url": ...}``."""
+        t = payload.get("timeout")
         return self.join(
             str(payload.get("name") or payload.get("url")),
             url=payload["url"],
-            timeout=float(payload.get("timeout", 60.0)),
+            timeout=None if t is None else float(t),
         )
 
     def drain(self, name: str) -> bool:

@@ -108,6 +108,14 @@ class ServeParams:
       per-tenant token-bucket admission quotas shedding code-117
       envelopes (``SKYLARK_QOS_QUOTA_RPS`` / ``SKYLARK_QOS_QUOTA_BURST``
       / ``SKYLARK_QOS_QUOTAS``); the rate default 0 means unlimited.
+    - ``state_dir`` / ``recover`` / ``journal_compact_every``: the
+      durability layer.  A ``state_dir`` attaches a write-ahead
+      :class:`~.journal.Journal` to the registry (every mint journals
+      durably BEFORE it publishes); ``recover=True`` additionally
+      restores the registry from that directory's snapshot + journal
+      tail at construction, bitwise-identical to the process that died.
+      ``journal_compact_every`` overrides ``SKYLARK_JOURNAL_COMPACT_EVERY``
+      (records between snapshot compactions; ``0`` disables compaction).
     """
 
     max_queue: int = 256
@@ -125,6 +133,9 @@ class ServeParams:
     tenant_quota_rps: float | None = None
     tenant_quota_burst: float | None = None
     tenant_quotas: str | dict | None = None
+    state_dir: str | None = None
+    recover: bool = False
+    journal_compact_every: int | None = None
 
 
 class Server:
@@ -145,7 +156,28 @@ class Server:
             max_bytes=self.params.cache_max_bytes,
             enabled=self.params.cache,
         )
-        self.registry = Registry(cache=self.cache)
+        if self.params.state_dir is not None and self.params.recover:
+            # Restart path: snapshot + journal tail replay, pinned
+            # bitwise-identical to the registry that died (same entity
+            # bits, same epoch counter, same epoch_log) — the replica
+            # rejoins the fleet at the exact epoch callers observed.
+            self.registry = Registry.recover(
+                self.params.state_dir,
+                cache=self.cache,
+                compact_every=self.params.journal_compact_every,
+            )
+        elif self.params.state_dir is not None:
+            from .journal import Journal
+
+            self.registry = Registry(
+                cache=self.cache,
+                journal=Journal(
+                    self.params.state_dir,
+                    compact_every=self.params.journal_compact_every,
+                ),
+            )
+        else:
+            self.registry = Registry(cache=self.cache)
         self.quotas = TenantQuotas(
             default_rps=self.params.tenant_quota_rps,
             default_burst=self.params.tenant_quota_burst,
@@ -359,6 +391,39 @@ class Server:
         )
         if entry.tctx is not None:
             entry.trace["trace_id"] = entry.tctx.trace_id
+        # -- exactly-once updates (idempotency-key dedup window) ------------
+        # A replayed op:"update" — the router's 112/114 failover resends
+        # the same request dict, or a client retried on a timeout whose
+        # first send actually landed — must NOT re-execute the mutation.
+        # The registry's journal-backed dedup window keyed (tenant,
+        # idem_key) holds the epoch-ledger receipt the first execution
+        # minted; a hit resolves with that recorded receipt and costs
+        # zero queue/quota pressure, exactly like a cache hit.
+        if entry.idem_key is not None:
+            # The dedup identity is (tenant, key) — tenant is only known
+            # HERE, after lane assignment, so the executor-bound payload
+            # picks it up now.
+            entry.payload["idem"] = (entry.tenant, entry.idem_key)
+            receipt = self.registry.idem_receipt(
+                entry.tenant, entry.idem_key
+            )
+            if receipt is not None:
+                entry.trace["events"].append(
+                    {
+                        "kind": "idem_replay",
+                        "idem_key": entry.idem_key,
+                        "epoch": receipt.get("epoch"),
+                    }
+                )
+                telemetry.inc("serve.ok")
+                telemetry.inc("serve.idem_hits")
+                telemetry.finish_trace(entry.tctx, "ok")
+                fut.set_result(
+                    protocol.ok_response(
+                        request.get("id"), receipt, entry.trace
+                    )
+                )
+                return fut
         # -- front-door result cache ---------------------------------------
         # Key = (placement key, canonical payload digest, pinned entity
         # epoch): the epoch component makes a registry mint observable by
@@ -802,6 +867,14 @@ class Server:
             request, fut, ("update", name, self._fresh_seq), "update",
             payload=payload,
         )
+        idem = request.get("idem_key")
+        if idem is not None:
+            if not isinstance(idem, str) or not idem or len(idem) > 256:
+                raise InvalidParameters(
+                    "idem_key must be a non-empty string of at most 256 "
+                    f"characters, got {idem!r}"
+                )
+            entry.idem_key = idem
         return entry
 
     def _check_epoch(self, request: dict, entity, kind: str) -> None:

@@ -25,6 +25,7 @@ __all__ = [
     "RefinementError",
     "RegistryEpochError",
     "QuotaExceededError",
+    "JournalError",
 ]
 
 
@@ -262,3 +263,27 @@ class QuotaExceededError(SkylarkError):
         self.rate = rate
         self.burst = burst
         self.retry_after_ms = retry_after_ms
+
+
+class JournalError(IOError_):
+    """The serve registry's write-ahead journal failed integrity
+    validation or cannot express a mutation durably.  A torn FINAL line
+    is *not* this error — a crash mid-append legitimately leaves one,
+    so recovery truncates and counts it; this code fires on damage the
+    crash model cannot explain: a CRC-bad or unparseable record with
+    valid records AFTER it, an epoch gap between consecutive records,
+    or a registered object (an exotic model class) that has no journal
+    codec and therefore cannot survive a restart.  Subclasses
+    ``IOError_`` like :class:`CheckpointError` so pre-existing IO error
+    handling keeps working.  ``path`` names the journal file,
+    ``record`` is the 1-based line number of the offending record, and
+    ``reason`` is a short machine-readable tag (``"crc"``,
+    ``"epoch-gap"``, ``"opaque-model"``, ...)."""
+
+    code = 118
+
+    def __init__(self, msg, path=None, record=None, reason=None):
+        super().__init__(msg)
+        self.path = path
+        self.record = record
+        self.reason = reason

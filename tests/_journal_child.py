@@ -1,0 +1,178 @@
+"""Child process for the serve-durability SIGKILL chaos drills.
+
+Usage::
+
+    python tests/_journal_child.py <state_dir> <mode> <updates>
+
+Runs ONE serving replica with a journaled registry (``ServeParams.
+state_dir``) and drives a deterministic update stream through the real
+request path (``op:"update"`` with idempotency keys, admission queue,
+batcher worker).  ``mode``:
+
+- ``control``: apply ``<updates>`` updates, stop cleanly, write the
+  registry digest to ``<state_dir>/digest.json`` and print
+  ``JOURNAL-OK``.  The never-crashed reference.
+- ``die-after``: apply the FULL stream, but a
+  :class:`JournalFaultPlan` SIGKILLs the process inside the commit
+  window of update ``<updates> - 1`` — journal append durable, publish
+  never happens.  A real uncatchable death (returncode -9); recovery
+  must REPLAY that journaled record, landing at the same epoch as a
+  ``control`` run of ``<updates>`` updates.
+- ``torn``: same, but the fault tears the frame mid-write (half the
+  bytes, fsync'd) before killing.  The record was never durable;
+  recovery must truncate it and land at ``<updates> - 1`` updates.
+
+The update stream and registered entities are seeded, so the parent
+compares the RECOVERED registry's digest (computed with :func:`digest`
+imported from this module) bitwise against the control child's.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import zlib
+
+
+# Registrations journal too: system = append 0, graph = append 1, so
+# update k is journal append index REG_APPENDS + k.
+REG_APPENDS = 2
+
+
+def digest(registry) -> dict:
+    """Bitwise identity of a registry: epoch counter, the full epoch
+    ledger, a CRC over every entity's exact bytes, and the idempotency
+    window.  Two registries with equal digests serve the same bits."""
+    import numpy as np
+
+    crc = 0
+
+    def fold(*arrays):
+        nonlocal crc
+        for a in arrays:
+            crc = zlib.crc32(np.ascontiguousarray(a).tobytes(), crc)
+
+    for name in sorted(registry.systems):
+        s = registry.get_system(name)
+        fold(s.A[: s.m], s.SA, s.Qt, s.R)
+        crc = zlib.crc32(repr(sorted(s.retired)).encode(), crc)
+    for name in sorted(registry.graphs):
+        g = registry.get_graph(name)
+        fold(g.X, g.G.indptr, g.G.indices)
+        crc = zlib.crc32(repr(list(g.G.vertices)).encode(), crc)
+    for name in sorted(registry.models):
+        m = registry.get_model(name)
+        for attr in ("X_train", "A", "W"):
+            a = getattr(m, attr, None)
+            if a is not None:
+                fold(np.asarray(a))
+    # Lists, not tuples: the control digest round-trips through JSON.
+    idem = sorted(
+        [t, k, rec["epoch"]]
+        for (t, k), rec in registry._idem.items()
+    )
+    return {
+        "epoch": registry.epoch,
+        "epoch_log": registry.epoch_log,
+        "crc": crc,
+        "idem": idem,
+    }
+
+
+N_V = 16  # graph vertex universe (live folds stay over registered ids)
+
+
+def build_stream(n: int):
+    """The deterministic update-request stream: cycles row appends,
+    graph folds (chords over the registered ring vertices — live folds
+    reject vertex growth), and row downdates of distinct indices, every
+    request carrying a derived idempotency key."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    reqs = []
+    for i in range(n):
+        if i % 3 == 0:
+            reqs.append({
+                "op": "update", "system": "sys", "idem_key": f"upd-{i}",
+                "append": rng.normal(size=(2, 5)).tolist(),
+            })
+        elif i % 3 == 1:
+            u = i % N_V
+            reqs.append({
+                "op": "update", "graph": "g", "idem_key": f"upd-{i}",
+                "edges": [[u, (u + 5 + i % 7) % N_V]],
+            })
+        else:
+            reqs.append({
+                "op": "update", "system": "sys", "idem_key": f"upd-{i}",
+                "drop": [i],
+            })
+    return reqs
+
+
+def make_server(state_dir: str, plan=None):
+    import numpy as np
+
+    from libskylark_tpu import serve
+    from libskylark_tpu.serve.journal import Journal
+    from libskylark_tpu.serve.registry import Registry
+
+    params = serve.ServeParams(warm_start=False, prime=False)
+    srv = serve.Server(params, seed=11)
+    # Journal with the fault plan threaded in (ServeParams has no fault
+    # seam on purpose — chaos is a test-only concern).
+    srv.registry = Registry(
+        cache=srv.cache,
+        journal=Journal(state_dir, compact_every=0, faults=plan),
+    )
+    rng = np.random.default_rng(3)
+    srv.register_system(
+        "sys", rng.normal(size=(24, 5)), sketch_type="CWT", capacity=96
+    )
+    from libskylark_tpu.graph.graph import SimpleGraph
+
+    ring = [(v, (v + 1) % N_V) for v in range(N_V)]
+    srv.register_graph("g", SimpleGraph(ring), k=2)
+    return srv
+
+
+def main() -> int:
+    state_dir, mode, updates = sys.argv[1], sys.argv[2], int(sys.argv[3])
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from libskylark_tpu.resilient.faults import JournalFaultPlan
+
+    plan = None
+    if mode == "die-after":
+        plan = JournalFaultPlan(
+            die_after_journal_before_publish=REG_APPENDS + updates - 1
+        )
+    elif mode == "torn":
+        plan = JournalFaultPlan(torn_journal_at=REG_APPENDS + updates - 1)
+
+    srv = make_server(state_dir, plan).start()
+    # Crash modes run the whole stream — the fault kills mid-stream.
+    n = updates if mode == "control" else updates + 2
+    for req in build_stream(n):
+        resp = srv.call(req)
+        if not resp.get("ok"):
+            print(f"JOURNAL-ERR {resp['error']}", flush=True)
+            return 2
+    srv.stop()
+    if mode != "control":  # the fault should have killed us above
+        print("JOURNAL-SURVIVED", flush=True)
+        return 3
+    with open(os.path.join(state_dir, "digest.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump(digest(srv.registry), fh)
+    print("JOURNAL-OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -95,6 +95,11 @@ QOS_TIMEOUT_S = 120
 # epoch bumps behind the worker thread; a wedged invalidation or an
 # unresolved future must not stall the tier-1 run.
 CACHE_TIMEOUT_S = 120
+# Durability tests journal real registries through fsync'd appends,
+# SIGKILL child replicas mid-update-stream, and replay recovery; a
+# child that never dies or a recover that waits on a journal handle
+# must not stall the tier-1 run.
+DURABILITY_TIMEOUT_S = 120
 
 _TIMEOUT_MARKS = {
     "faults": FAULTS_TIMEOUT_S,
@@ -114,6 +119,7 @@ _TIMEOUT_MARKS = {
     "train": TRAIN_TIMEOUT_S,
     "qos": QOS_TIMEOUT_S,
     "cache": CACHE_TIMEOUT_S,
+    "durability": DURABILITY_TIMEOUT_S,
 }
 
 
@@ -231,6 +237,13 @@ def pytest_configure(config):
         "cache: front-door result-cache tests (bitwise hit parity, "
         "epoch-bump invalidation, LRU/byte bounds, fleet hit sharing); "
         f"tier-1, guarded by a per-test {CACHE_TIMEOUT_S}s timeout",
+    )
+    config.addinivalue_line(
+        "markers",
+        "durability: serve durability tests (write-ahead journal, "
+        "bitwise crash recovery, SIGKILL chaos drills, exactly-once "
+        "idempotency across failover); tier-1, guarded by a per-test "
+        f"{DURABILITY_TIMEOUT_S}s timeout",
     )
 
 

@@ -1,0 +1,566 @@
+"""Durable serve state (ISSUE PR 19): WAL, crash recovery, exactly-once.
+
+The load-bearing contracts:
+
+- **Every mint journals BEFORE it publishes.**  All five
+  ``Registry._mint`` kinds append a CRC-framed, epoch-stamped record to
+  ``registry-journal.jsonl`` (fsync'd) before the mutation is visible;
+  ``Registry.recover`` replays snapshot + journal tail to a registry
+  **bitwise-identical** to one that never crashed — same entity bits,
+  same epoch counter, same ``epoch_log``.
+- **Torn tails are the crash model; mid-file damage is not.**  A
+  SIGKILL mid-append leaves at most one torn/CRC-bad FINAL line: the
+  journal reader truncates and counts it.  A bad record *followed by
+  valid ones* cannot come from that crash — it raises code-118
+  ``JournalError`` (reason ``"crc"``) instead of guessing.  The same
+  torn-frame discipline holds across the repo's JSONL readers, each
+  with its own documented failure mode (parametrized below).
+- **Exactly-once across failover.**  ``op:"update"`` requests carry an
+  ``idem_key``; the dedup window is keyed ``(tenant, idem_key)``,
+  rides the journal/snapshot, and a replayed key — same process or a
+  recovered one — returns the ORIGINAL epoch receipt without minting.
+- **SIGKILL chaos drill** (subprocess, uncatchable): a live replica
+  killed between journal append and publish recovers to the same bits
+  as a never-crashed control; a tear mid-frame recovers to the bits
+  *before* that update.
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from libskylark_tpu import serve, telemetry
+from libskylark_tpu.core.context import SketchContext
+from libskylark_tpu.graph.graph import SimpleGraph
+from libskylark_tpu.serve import journal as journal_mod
+from libskylark_tpu.serve.journal import Journal, read_journal
+from libskylark_tpu.serve.registry import Registry
+from libskylark_tpu.utils import exceptions as ex
+from libskylark_tpu.utils.checkpoint import CheckpointStore
+
+pytestmark = pytest.mark.durability
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+
+# The chaos child doubles as the digest library (tests/ is not a
+# package — load it by path, same trick as its own subprocess entry).
+_spec = importlib.util.spec_from_file_location(
+    "_journal_child", os.path.join(_HERE, "_journal_child.py")
+)
+_JC = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_JC)
+
+N_V = 16
+RING = [(v, (v + 1) % N_V) for v in range(N_V)]
+
+
+def _km(rng):
+    from libskylark_tpu.ml.kernels import GaussianKernel
+    from libskylark_tpu.ml.model import KernelModel
+
+    return KernelModel(
+        GaussianKernel(12, sigma=1.1),
+        rng.standard_normal((10, 12)),
+        rng.standard_normal((10, 3)),
+    )
+
+
+def _journaled_registry(directory, **jkw):
+    """A registry journaling into ``directory`` with one entity of each
+    flavor registered (CWT system — FJLT has no columnwise partial rule
+    and refuses live appends)."""
+    reg = Registry(journal=Journal(str(directory), **jkw))
+    rng = np.random.default_rng(3)
+    reg.register_system(
+        "sys", rng.standard_normal((24, 5)), context=SketchContext(seed=9),
+        sketch_type="CWT", sketch_size=32, capacity=96,
+    )
+    reg.register_graph(
+        "g", SimpleGraph(RING), k=2, context=SketchContext(seed=5)
+    )
+    reg.register_model("krr", _km(rng))
+    return reg, rng
+
+
+def _mutate_all_kinds(reg, rng):
+    """One of every replayable mutation, idempotency keys included."""
+    reg.append_system_rows("sys", rng.standard_normal((3, 5)),
+                           idem=("t0", "a"))
+    reg.fold_graph_edges("g", [(0, 5), (3, 9)], idem=("t0", "b"))
+    reg.downdate_system_rows("sys", [1, 4], idem=("t0", "c"))
+    reg.update_model("krr", append=(rng.standard_normal((2, 12)),
+                                    rng.standard_normal((2, 3))))
+    reg.update_model("krr", drop=[10])
+    reg.update_model("krr", model=_km(rng))
+
+
+# ---------------------------------------------------------------------------
+# journal replay: bitwise recovery
+
+
+def test_recover_bitwise_all_kinds(tmp_path):
+    reg, rng = _journaled_registry(tmp_path)
+    _mutate_all_kinds(reg, rng)
+    assert reg.epoch == 9  # 3 registrations + 6 mutations
+
+    rec = Registry.recover(str(tmp_path))
+    assert _JC.digest(rec) == _JC.digest(reg)
+    # The recovered registry is LIVE: it journals onward and stays in
+    # lockstep with the original applying the same next mutation.
+    rows = rng.standard_normal((2, 5))
+    reg.append_system_rows("sys", rows)
+    rec.append_system_rows("sys", rows)
+    assert _JC.digest(rec) == _JC.digest(reg)
+
+
+def test_compaction_snapshot_then_tail_replay(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    telemetry.REGISTRY.reset()
+    reg, rng = _journaled_registry(tmp_path, compact_every=4)
+    _mutate_all_kinds(reg, rng)
+    # 9 appends at compact_every=4 → at least one snapshot committed,
+    # journal holding only the post-snapshot tail.
+    store = CheckpointStore(str(tmp_path), prefix=journal_mod.SNAP_PREFIX)
+    assert store.steps(), "compaction never committed a snapshot slot"
+    records, torn = read_journal(
+        os.path.join(str(tmp_path), journal_mod.JOURNAL_NAME)
+    )
+    assert torn == 0 and len(records) < reg.epoch
+    counters = telemetry.REGISTRY.snapshot()["counters"]
+    assert counters.get("journal.compactions", 0) >= 1
+    assert counters.get("journal.appends", 0) == 9
+
+    rec = Registry.recover(str(tmp_path))
+    assert _JC.digest(rec) == _JC.digest(reg)
+    # Replays counted; idempotency receipts survive the snapshot ride.
+    counters = telemetry.REGISTRY.snapshot()["counters"]
+    assert counters.get("journal.replays", 0) >= len(records)
+    assert rec.idem_receipt("t0", "a")["epoch"] == 4
+
+
+def test_torn_tail_truncated_and_counted(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    reg, rng = _journaled_registry(tmp_path)
+    reg.append_system_rows("sys", rng.standard_normal((2, 5)))
+    before = _JC.digest(reg)
+    path = os.path.join(str(tmp_path), journal_mod.JOURNAL_NAME)
+    with open(path, "ab") as f:  # SIGKILL mid-append: half a frame
+        f.write(b'{"crc": 12345, "rec": {"epoch": 5, "kind": "row_ap')
+
+    telemetry.REGISTRY.reset()
+    rec = Registry.recover(str(tmp_path))
+    assert rec.journal.torn_truncated == 1
+    assert _JC.digest(rec) == before  # the torn record never happened
+    counters = telemetry.REGISTRY.snapshot()["counters"]
+    assert counters.get("journal.torn_tail", 0) == 1
+    # The truncation is durable: a second recovery sees a clean file.
+    assert Registry.recover(str(tmp_path)).journal.torn_truncated == 0
+
+
+def test_midfile_corruption_raises_118(tmp_path):
+    reg, rng = _journaled_registry(tmp_path)
+    reg.append_system_rows("sys", rng.standard_normal((2, 5)))
+    path = os.path.join(str(tmp_path), journal_mod.JOURNAL_NAME)
+    lines = open(path, "rb").read().splitlines(keepends=True)
+    assert len(lines) >= 3
+    lines[1] = lines[1][:20] + b"XX" + lines[1][22:]  # not the tail
+    with open(path, "wb") as f:
+        f.writelines(lines)
+
+    with pytest.raises(ex.JournalError) as ei:
+        Registry.recover(str(tmp_path))
+    assert ei.value.code == 118
+    assert ei.value.reason == "crc"
+    assert ei.value.record == 2  # 1-based line number of the damage
+
+
+# ---------------------------------------------------------------------------
+# torn-frame semantics across the repo's JSONL readers (satellite):
+# every reader tolerates a torn FINAL line; what each does with damage
+# beyond the crash model is its own documented contract.
+
+
+def _torn(line: bytes) -> bytes:
+    return line[: max(1, len(line) // 2)].rstrip(b"\n")
+
+
+@pytest.mark.parametrize(
+    "reader,damage",
+    [
+        ("journal", "torn-tail"),
+        ("journal", "mid-file"),
+        ("progress", "torn-tail"),
+        ("progress", "mid-file"),
+        ("ledger-fold", "torn-tail"),
+        ("snapshot", "stale-epoch"),
+    ],
+)
+def test_torn_frame_semantics(tmp_path, reader, damage):
+    if reader == "journal":
+        reg, rng = _journaled_registry(tmp_path)
+        reg.append_system_rows("sys", rng.standard_normal((2, 5)))
+        path = os.path.join(str(tmp_path), journal_mod.JOURNAL_NAME)
+        lines = open(path, "rb").read().splitlines(keepends=True)
+        if damage == "torn-tail":
+            with open(path, "wb") as f:
+                f.writelines(lines[:-1])
+                f.write(_torn(lines[-1]))
+            records, torn = read_journal(path)
+            assert torn == 1 and len(records) == len(lines) - 1
+        else:  # a torn line with valid records AFTER it: code 118
+            with open(path, "wb") as f:
+                f.writelines(lines[:1])
+                f.write(_torn(lines[1]) + b"\n")
+                f.writelines(lines[2:])
+            with pytest.raises(ex.JournalError) as ei:
+                read_journal(path)
+            assert ei.value.code == 118
+    elif reader == "progress":
+        from libskylark_tpu.streaming.elastic import read_progress
+
+        path = tmp_path / "progress.jsonl"
+        recs = [{"seq": i, "attrs": {"epoch": 1}, "i": i} for i in range(4)]
+        lines = [json.dumps(r).encode() + b"\n" for r in recs]
+        if damage == "torn-tail":
+            path.write_bytes(b"".join(lines[:-1]) + _torn(lines[-1]))
+            got = read_progress(path)
+            assert [r["i"] for r in got] == [0, 1, 2]
+        else:
+            # Mid-file garbage is LEGITIMATE here: a host that resumed
+            # after its own torn tail appends valid records after the
+            # tear.  read_progress keeps intact prefix AND suffix —
+            # this tolerance is load-bearing for elastic resume (the
+            # registry journal, whose replay must be gapless, is the
+            # reader that hard-fails instead).
+            path.write_bytes(
+                lines[0] + _torn(lines[1]) + b"\n" + b"".join(lines[2:])
+            )
+            got = read_progress(path)
+            assert [r["i"] for r in got] == [0, 2, 3]
+    elif reader == "ledger-fold":
+        # The fleet fold rides read_progress per host: a host with a
+        # torn tail still folds (its intact records count), and
+        # records from a superseded epoch are fenced out as stale —
+        # the 111-flavored tolerance at the aggregation layer.
+        from libskylark_tpu.telemetry.fleet import fold_ledgers
+
+        hdir = tmp_path / "host-00000"
+        hdir.mkdir()
+        # Pin the root epoch so the intact-but-stale record below is
+        # fenced against the MARKER, not voted in by its own epoch.
+        (tmp_path / "epoch.json").write_text(json.dumps(
+            {"skylark_object_type": "elastic_epoch", "epoch": 0}
+        ))
+        good = [
+            {"seq": i, "attrs": {"epoch": 0, "rank": 0, "rows": 2}}
+            for i in range(3)
+        ]
+        stale_rec = {"seq": 9, "attrs": {"epoch": 5, "rank": 0}}
+        lines = [json.dumps(r).encode() + b"\n"
+                 for r in good + [stale_rec]]
+        (hdir / "progress.jsonl").write_bytes(
+            b"".join(lines[:-1]) + _torn(lines[-1])
+        )
+        view = fold_ledgers(str(tmp_path))
+        assert view["lost_hosts"] == []
+        assert view["ranks"][0]["records"] == 3
+        # ...and a wrong-epoch record that DID survive intact is
+        # fenced, not folded.
+        (hdir / "progress.jsonl").write_bytes(b"".join(lines))
+        view = fold_ledgers(str(tmp_path))
+        assert view["stale_records"] == 1
+        assert view["ranks"][0]["records"] == 3
+    else:
+        # The compaction snapshot rides CheckpointStore: an epoch-
+        # pinned load of a slot from another life is the 111 hard-fail
+        # (StaleEpochError), not a silent stale restore.
+        store = CheckpointStore(str(tmp_path), prefix="registry-snap")
+        store.save({"x": np.arange(3.0)}, step=7, metadata={"epoch": 7})
+        state, meta, step = store.load_latest(expect_epoch=7)
+        assert step == 7 and store.slot_epoch(meta) == 7
+        with pytest.raises(ex.StaleEpochError) as ei:
+            store.load_latest(expect_epoch=9)
+        assert ei.value.code == 111
+
+
+# ---------------------------------------------------------------------------
+# exactly-once updates through the server, including across recovery
+
+
+def _durable_server(state_dir, recover=False):
+    srv = serve.Server(
+        serve.ServeParams(
+            warm_start=False, prime=False,
+            state_dir=str(state_dir), recover=recover,
+        ),
+        seed=11,
+    )
+    if not recover:
+        rng = np.random.default_rng(3)
+        srv.register_system(
+            "sys", rng.standard_normal((24, 5)),
+            sketch_type="CWT", capacity=96,
+        )
+    return srv.start()
+
+
+def test_server_update_exactly_once_across_recovery(tmp_path, monkeypatch):
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    telemetry.REGISTRY.reset()
+    srv = _durable_server(tmp_path)
+    rows = np.arange(10.0).reshape(2, 5).tolist()
+    r1 = srv.call(op="update", system="sys", append=rows, idem_key="k1")
+    assert r1["ok"]
+    epoch1 = r1["result"]["epoch"]
+    m1 = srv.registry.get_system("sys").m
+
+    # Same key replays: original receipt, NO new epoch, no new rows.
+    r2 = srv.call(op="update", system="sys", append=rows, idem_key="k1")
+    assert r2["ok"] and r2["result"]["epoch"] == epoch1
+    assert srv.registry.get_system("sys").m == m1
+    assert any(e["kind"] == "idem_replay" for e in r2["trace"]["events"])
+    counters = telemetry.REGISTRY.snapshot()["counters"]
+    assert counters.get("serve.idem_hits", 0) == 1
+
+    # A fresh key applies.
+    r3 = srv.call(op="update", system="sys", append=rows, idem_key="k2")
+    assert r3["ok"] and r3["result"]["epoch"] == epoch1 + 1
+
+    # Bad keys shed at the door (102), before queue/quota pressure.
+    bad = srv.call(op="update", system="sys", append=rows, idem_key="")
+    assert not bad["ok"] and bad["error"]["code"] == 102
+    srv.stop()
+
+    # Failover: a NEW process recovers the journal and the replayed
+    # key still answers with the ORIGINAL receipt — exactly once.
+    srv2 = _durable_server(tmp_path, recover=True)
+    assert srv2.registry.epoch == epoch1 + 1
+    r4 = srv2.call(op="update", system="sys", append=rows, idem_key="k1")
+    assert r4["ok"] and r4["result"]["epoch"] == epoch1
+    assert srv2.registry.get_system("sys").m == m1 + 2  # only k2's rows
+    srv2.stop()
+
+
+def test_client_update_mints_idem_key(tmp_path):
+    srv = _durable_server(tmp_path)
+    try:
+        sent = []
+        orig = srv.call
+
+        class _Loopback(serve.Client):
+            def __init__(self):
+                pass
+
+            def call(self, request=None, /, **fields):
+                req = dict(request or {}, **fields)
+                sent.append(req)
+                return orig(req)
+
+        c = _Loopback()
+        rows = np.arange(10.0).reshape(2, 5).tolist()
+        r = c.update(system="sys", append=rows)
+        assert r["ok"] and len(sent[0]["idem_key"]) == 32  # uuid4 hex
+        # The SAME minted key retries as a replay, not a re-apply.
+        r2 = c.call(dict(sent[0]))
+        assert r2["result"]["epoch"] == r["result"]["epoch"]
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# SIGKILL chaos drill (subprocess — the death is uncatchable)
+
+
+def test_sigkill_chaos_recovers_control_bits(tmp_path):
+    """Kill a live replica inside the update commit window, both edges:
+
+    - AFTER the journal append is durable, BEFORE publish → recovery
+      replays the record: bits == a control that ran all 4 updates.
+    - MID-frame (torn tail) on update 4 → recovery truncates: bits ==
+      the same 4-update control (the 5th never happened).
+    """
+    env = dict(os.environ)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    script = os.path.join(_HERE, "_journal_child.py")
+
+    def spawn(d, mode, updates):
+        os.makedirs(d, exist_ok=True)
+        return subprocess.Popen(
+            [sys.executable, script, str(d), mode, str(updates)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, env=env, cwd=_REPO,
+        )
+
+    procs = {
+        "control": spawn(tmp_path / "ctl", "control", 4),
+        "die-after": spawn(tmp_path / "die", "die-after", 4),
+        # torn on update index 4 → updates 0..3 durable: same control.
+        "torn": spawn(tmp_path / "torn", "torn", 5),
+    }
+    outs = {m: p.communicate(timeout=300) for m, p in procs.items()}
+    out, err = outs["control"]
+    assert procs["control"].returncode == 0 and "JOURNAL-OK" in out, (
+        out, err[-2000:]
+    )
+    for mode in ("die-after", "torn"):
+        assert procs[mode].returncode == -9, (mode, outs[mode])
+
+    control = json.load(open(tmp_path / "ctl" / "digest.json"))
+    for mode, d in (("die-after", "die"), ("torn", "torn")):
+        got = _JC.digest(Registry.recover(str(tmp_path / d)))
+        assert got == control, (mode, got["epoch"], control["epoch"])
+
+
+# ---------------------------------------------------------------------------
+# static contracts: codecs, CLI flags, marker registration
+
+
+def test_every_mint_kind_has_codec_and_replay_handler():
+    """The journal is only exactly-once if EVERY mint kind round-trips:
+    a new ``Registry._mint`` call site must ship a journal record kind
+    and a replay handler in the same PR."""
+    import re
+
+    src = open(
+        os.path.join(_REPO, "libskylark_tpu", "serve", "registry.py"),
+        encoding="utf-8",
+    ).read()
+    minted = set(re.findall(r'_mint\(\s*\n?\s*"(\w+)"', src))
+    journaled = set(re.findall(r'_journal_append\(\s*\n?\s*"(\w+)"', src))
+    assert minted == {
+        "register", "graph_fold", "row_append", "row_downdate",
+        "model_update",
+    }
+    assert journaled == minted, (
+        "mint kinds without a journal append (or vice versa): "
+        f"{minted ^ journaled}"
+    )
+    assert set(journal_mod.RECORD_KINDS) == minted
+    assert set(journal_mod.REPLAY_HANDLERS) == minted
+
+
+def test_durability_marker_and_cli_flags_registered():
+    conftest = open(os.path.join(_HERE, "conftest.py"),
+                    encoding="utf-8").read()
+    assert '"durability": DURABILITY_TIMEOUT_S' in conftest
+    assert "durability:" in conftest  # the marker description line
+    cli = open(
+        os.path.join(_REPO, "libskylark_tpu", "cli", "serve.py"),
+        encoding="utf-8",
+    ).read()
+    for flag in ("--state-dir", "--recover", "--journal-compact-every"):
+        assert flag in cli, f"skylark-serve lost {flag}"
+
+
+# ---------------------------------------------------------------------------
+# HTTP socket timeouts (satellite): hung ≠ dead, but hung must RAISE
+
+
+def test_client_default_timeout_env(monkeypatch):
+    monkeypatch.setenv("SKYLARK_HTTP_TIMEOUT_S", "7.5")
+    assert serve.client.default_timeout_s() == 7.5
+    c = serve.Client(url="http://127.0.0.1:1")
+    assert c._timeout == 7.5
+    assert serve.Client(url="http://127.0.0.1:1", timeout=2.0)._timeout == 2.0
+    monkeypatch.delenv("SKYLARK_HTTP_TIMEOUT_S")
+    assert serve.client.default_timeout_s() == 60.0
+
+
+def test_router_counts_report_timeouts(monkeypatch):
+    import socket as socket_mod
+
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    telemetry.REGISTRY.reset()
+    rep = serve.HttpReplica("r0", "http://127.0.0.1:1", retries=1)
+    rep._sleep = lambda s: None
+
+    def hung():
+        raise socket_mod.timeout("recv timed out")
+
+    rep._client.healthz = hung
+    with pytest.raises(TimeoutError):
+        rep.load_report()
+    counters = telemetry.REGISTRY.snapshot()["counters"]
+    # attempt 0 + the final attempt both counted
+    assert counters.get("router.report_timeouts", 0) == 2
+
+    # A non-timeout transport error does NOT count as a hang.
+    def refused():
+        raise ConnectionRefusedError("nope")
+
+    rep._client.healthz = refused
+    with pytest.raises(ConnectionRefusedError):
+        rep.load_report()
+    counters = telemetry.REGISTRY.snapshot()["counters"]
+    assert counters.get("router.report_timeouts", 0) == 2
+
+
+# ---------------------------------------------------------------------------
+# skylark-top hardening (satellite): dying replicas never traceback it
+
+
+def test_top_renders_malformed_json_as_unreachable(monkeypatch):
+    from libskylark_tpu.cli import top
+
+    shapes = {
+        "http://a/healthz": {"_error": "JSONDecodeError: truncated"},
+        "http://b/healthz": {"registry": "nope", "primed": 3,
+                             "load": "garbage", "fleet": [1, 2]},
+        "http://b/stats": {"counters": None, "latency": [0.1]},
+        "http://b/traces": {"recent": {"not": "a list"}, "violations": 7},
+    }
+    monkeypatch.setattr(
+        top, "_fetch_json",
+        lambda url, timeout=2.0: shapes.get(url, {"_error": "boom"}),
+    )
+
+    def _args(*urls):
+        return type(
+            "A", (), {"url": list(urls), "root": None,
+                      "telemetry_dir": None},
+        )()
+
+    status = {}
+    frame = top.render_frame(_args("http://a"), status)
+    assert "UNREACHABLE" in frame
+    assert status == {"urls": 1, "answered": 0}
+
+    # Replica b answers /healthz with junk-shaped (but dict) JSON:
+    # every section renders defensively, nothing raises.
+    status = {}
+    frame = top.render_frame(_args("http://b"), status)
+    assert status["answered"] == 1
+    assert "serve http://b" in frame
+
+
+def test_top_once_exit_codes(monkeypatch, tmp_path, capsys):
+    from libskylark_tpu.cli import top
+
+    monkeypatch.setattr(
+        top, "_fetch_json",
+        lambda url, timeout=2.0: {"_error": "ConnectionRefusedError"},
+    )
+    assert top.main(["--url", "http://dead:1", "--once"]) == 1
+    capsys.readouterr()
+
+    answers = {"http://live/healthz": {"registry": {}, "primed": []}}
+    monkeypatch.setattr(
+        top, "_fetch_json",
+        lambda url, timeout=2.0: answers.get(url, {"_error": "dead"}),
+    )
+    # One live member among dead ones: a partially-dead fleet is still
+    # an answer, not a monitoring failure.
+    rc = top.main(["--url", "http://live", "--url", "http://dead:1",
+                   "--once"])
+    assert rc == 0
+    capsys.readouterr()
+
+    # No URLs at all (ledger/root mode) never fails on reachability.
+    assert top.main(["--root", str(tmp_path), "--once"]) == 0
