@@ -1179,6 +1179,117 @@ def bench_serve(on_tpu, table):
           (finished / minted) if minted else 0.0, table, contention=None)
 
 
+def bench_attribution(on_tpu, table):
+    """Phase-clock attribution (docs/observability.md, "Latency
+    attribution"): the SAME coalesced serve drive, telemetry AND tracing
+    held ON in both modes, the per-request phase clock isolated by its
+    SKYLARK_PHASES sub-gate — so the ratio charges only what attribution
+    added (monotonic stamps, phase histograms) on top of the already-on
+    trace plane.  Contract: vs_baseline >= 0.95.  The decomposition row
+    then proves the phases mean something: a traced request's recorded
+    phases must sum to its own end-to-end latency within 10%
+    (``vs_baseline`` there IS the sum/e2e ratio — 1.0 means the phase
+    chain tiles the request wall exactly)."""
+    import concurrent.futures as cf
+
+    from libskylark_tpu import serve
+    from libskylark_tpu import telemetry as _tel
+
+    m, n = (8192, 64) if on_tpu else (512, 16)
+    total = 64 if _SMOKE else 256
+    workers = 16
+    rng = np.random.default_rng(23)
+    A = rng.standard_normal((m, n))
+    rhs = [rng.standard_normal(m) for _ in range(8)]
+
+    def drive(n_requests):
+        params = serve.ServeParams(
+            max_coalesce=32, max_queue=4 * n_requests,
+            warm_start=False, prime=True,
+        )
+        srv = serve.Server(params, seed=13)
+        srv.registry.register_system(
+            "sys", A, context=SketchContext(seed=29)
+        )
+        srv.start()
+
+        def one(i):
+            r = srv.call(serve.make_request(
+                "ls_solve", system="sys", b=rhs[i % len(rhs)]
+            ))
+            if not r["ok"]:
+                raise RuntimeError(r["error"]["message"])
+
+        with cf.ThreadPoolExecutor(max_workers=workers) as pool:
+            list(pool.map(one, range(workers)))  # warm every rung first
+            t0 = time.perf_counter()
+            list(pool.map(one, range(n_requests)))
+        wall = time.perf_counter() - t0
+        srv.stop()
+        return n_requests / wall
+
+    prev = {
+        k: os.environ.get(k)
+        for k in ("SKYLARK_TELEMETRY", "SKYLARK_TRACE", "SKYLARK_PHASES")
+    }
+    ratio = 0.0
+    try:
+        # Interleaved A/B, median of 3 per mode, 4x-length drives —
+        # the same discipline as the trace-overhead row above:
+        # alternating modes puts box-level drift into both samples
+        # instead of whichever mode ran last.
+        os.environ["SKYLARK_TELEMETRY"] = "1"
+        os.environ["SKYLARK_TRACE"] = "1"
+        qps = {"0": [], "1": []}
+        n_req = (4 * total) if not _SMOKE else total
+        for _ in range(3):
+            for mode in ("0", "1"):
+                os.environ["SKYLARK_PHASES"] = mode
+                _tel.reset()
+                qps[mode].append(drive(n_req))
+        qps_off = sorted(qps["0"])[1]
+        qps_on = sorted(qps["1"])[1]
+
+        # Decomposition: one traced request; its phase clock must
+        # account for its own end-to-end wall.  Fresh rhs so the
+        # front-door cache cannot answer (cache hits carry no phases).
+        os.environ["SKYLARK_PHASES"] = "1"
+        _tel.reset()
+        params = serve.ServeParams(
+            max_coalesce=4, warm_start=False, prime=True
+        )
+        srv = serve.Server(params, seed=13)
+        srv.registry.register_system(
+            "sys", A, context=SketchContext(seed=29)
+        )
+        srv.start()
+        try:
+            srv.call(serve.make_request(
+                "ls_solve", system="sys", b=rng.standard_normal(m)
+            ))  # warm the rung: the measured request must not compile
+            r = srv.call(serve.make_request(
+                "ls_solve", system="sys", b=rng.standard_normal(m)
+            ))
+            envelope = r.get("trace") or {}
+            phases = envelope.get("phases") or {}
+            e2e = envelope.get("e2e_ms") or 0.0
+            if phases and e2e:
+                ratio = sum(phases.values()) / e2e
+        finally:
+            srv.stop()
+    finally:
+        _tel.reset()
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    _emit("serve phase-clock QPS", qps_on, "req/s", qps_on / qps_off,
+          table, contention=None)
+    _emit("serve phase sum/e2e", ratio, "ratio", ratio, table,
+          contention=None)
+
+
 def bench_cache(on_tpu, table):
     """Front-door QoS + result cache (docs/serving.md, "QoS + caching").
 
@@ -2660,6 +2771,12 @@ def main() -> None:
         # cache + multi-tenant QoS lanes (docs/serving.md, "QoS +
         # caching") — hot-set QPS cache-on vs off, and the
         # adversarial-tenant fairness p99 pair.
+        # Round-20 rows lead (never captured): latency attribution
+        # (docs/observability.md, "Latency attribution") — phase-clock
+        # on/off QPS (floor 0.95x) and the phase-decomposition
+        # sum/e2e ratio.
+        ("serve attribution", 60,
+         lambda: bench_attribution(on_tpu, table)),
         # Round-19 rows lead (never captured): durable serve state
         # (docs/serving.md, "Durable serving") — update-op QPS with the
         # write-ahead journal on vs off (floor 0.8x) and

@@ -167,6 +167,77 @@ def _serve_lines(stats: dict, health: dict, traces: dict) -> list[str]:
     return out
 
 
+def _slo_lines(slo: dict) -> list[str]:
+    """The SLO error-budget panel.  An older replica without the
+    ``/slo`` endpoint (404) — or a dying one — renders ``n/a``; a
+    healthy replica with no declared objectives renders nothing."""
+    if "_error" in slo:
+        return ["  slo: n/a"]
+    objectives = _dict(slo.get("objectives"))
+    if not objectives:
+        return []
+    out = [
+        "  objective              thr(ms)  target%  window   bad"
+        "   budget  state"
+    ]
+    for key in sorted(objectives):
+        o = _dict(objectives[key])
+        state = "BURNING" if o.get("burning") else "ok"
+        out.append(
+            f"  {str(key):<22} {_fmt(o.get('threshold_ms'), 1):>7}"
+            f"  {_fmt(o.get('target_pct'), 2):>7}"
+            f"  {str(o.get('window', '?')):>6}"
+            f"  {str(o.get('bad', '?')):>4}"
+            f"  {_fmt(o.get('budget_remaining'), 3):>7}  {state}"
+        )
+    return out
+
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _spark(values, width: int = 32) -> str:
+    """Unicode sparkline over the last ``width`` numeric values."""
+    vals = [float(v) for v in values if isinstance(v, (int, float))]
+    vals = vals[-width:]
+    if not vals:
+        return "n/a"
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_CHARS[0] * len(vals)
+    return "".join(
+        _SPARK_CHARS[min(7, int((v - lo) / span * 8))] for v in vals
+    )
+
+
+def _timeline_lines(timeline: dict) -> list[str]:
+    """Sparkline panel over the replica's ``/timeline`` ring.  Missing
+    endpoint (older replica) or hostile payloads render ``n/a`` rows,
+    never a crash."""
+    if "_error" in timeline:
+        return ["  timeline: n/a"]
+    windows = [_dict(w) for w in _list(timeline.get("windows"))]
+    if not windows:
+        return ["  timeline: (no windows yet)"]
+    derived = [_dict(w.get("derived")) for w in windows]
+    out = [
+        f"  timeline ({len(windows)} windows @"
+        f" {_fmt(timeline.get('interval_s'), 1)}s)"
+    ]
+    for key, label in (
+        ("qps", "qps"),
+        ("p99_ms", "p99 ms"),
+        ("queue_depth", "queue"),
+        ("cache_hit_rate", "cache hit"),
+    ):
+        vals = [d.get(key) for d in derived]
+        nums = [v for v in vals if isinstance(v, (int, float))]
+        last = nums[-1] if nums else None
+        out.append(f"  {label:<10} {_spark(vals)}  last {_fmt(last)}")
+    return out
+
+
 def _ledger_lines(fold: dict) -> list[str]:
     out = [
         "  events: "
@@ -288,6 +359,8 @@ def render_frame(args, status: dict | None = None) -> str:
                 traces = _fetch_json(base + "/traces")
                 lines.append(f"serve {base}")
                 lines += _serve_lines(stats, health, traces)
+                lines += _slo_lines(_fetch_json(base + "/slo"))
+                lines += _timeline_lines(_fetch_json(base + "/timeline"))
             ok = "_error" not in health
             load = health.get("load") if ok else None
             if not isinstance(load, dict):

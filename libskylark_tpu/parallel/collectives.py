@@ -314,6 +314,16 @@ def cross_host_psum(
             phase, lambda: cross_host_psum(tree, mesh, watchdog=None)
         )
 
+    import time as _time
+
+    from .. import telemetry as _telemetry
+
+    # Straggler attribution: each rank times its own merge wall (arrive
+    # + wait for peers + sum).  The rank that arrived LAST shows the
+    # SHORTEST wait — the fleet's per-rank gauges name it.  Timed on
+    # the innermost path only, so a watchdog-guarded call counts once.
+    t_wait = _time.monotonic() if _telemetry.enabled() else None
+
     from jax.sharding import NamedSharding
 
     if mesh is None:
@@ -358,7 +368,13 @@ def cross_host_psum(
             )
         )(g)
         out.append(np.asarray(summed.addressable_data(0))[0])
-    return jax.tree.unflatten(treedef, out)
+    result = jax.tree.unflatten(treedef, out)
+    if t_wait is not None:
+        wait_ms = (_time.monotonic() - t_wait) * 1e3
+        _telemetry.observe_phase("collective_wait", wait_ms)
+        _telemetry.set_gauge("collective.last_wait_ms", round(wait_ms, 4))
+        _telemetry.set_gauge("collective.rank", jax.process_index())
+    return result
 
 
 def rowwise_sharded(S, A, mesh: Mesh):

@@ -53,9 +53,9 @@ class Entry:
 
     __slots__ = (
         "request", "future", "key", "op", "payload", "squeeze",
-        "t_admit", "deadline", "sketch", "counter_base", "entity",
-        "trace", "tctx", "tenant", "tenant_label", "cache_key",
-        "cache_entity", "idem_key",
+        "t_admit", "t_pop", "phases", "deadline", "sketch",
+        "counter_base", "entity", "trace", "tctx", "tenant",
+        "tenant_label", "cache_key", "cache_entity", "idem_key",
     )
 
     def __init__(self, request, future, key, op, payload=None):
@@ -66,6 +66,11 @@ class Entry:
         self.payload = payload
         self.squeeze = False
         self.t_admit = None
+        # Phase-clock stamps: monotonic pop time (take_batch, telemetry
+        # on only) and the phases dict the batcher assembles for traced
+        # requests; both stay None on a disabled run.
+        self.t_pop = None
+        self.phases = None
         self.deadline = None
         self.sketch = None
         self.counter_base = None
@@ -203,12 +208,15 @@ class AdmissionQueue:
             if self._active and self._active[0] == tenant:
                 self._active.rotate(-1)
 
-    def _take_same_key_locked(self, lane, batch, max_coalesce):
+    def _take_same_key_locked(self, lane, batch, max_coalesce,
+                              stamp: bool = False):
         key = batch[0].key
         keep = deque()
         while lane and len(batch) < max_coalesce:
             e = lane.popleft()
             if e.key == key:
+                if stamp:
+                    e.t_pop = time.monotonic()
                 batch.append(e)
                 # Freed at pop, not at take_batch return: entries in the
                 # in-flight batch no longer hold queue depth, so a
@@ -229,6 +237,8 @@ class AdmissionQueue:
         yet full — latency traded for fuller batches.  Depth is released
         entry-by-entry as the batch forms, so lingering never holds
         admission capacity against ``offer``."""
+        from .. import telemetry
+
         with self._cond:
             while True:
                 tenant = self._pick_lane_locked()
@@ -237,10 +247,16 @@ class AdmissionQueue:
                 if self._closed:
                     return None
                 self._cond.wait(timeout=0.1)
+            # Phase-clock pop stamps (admit_wait ends / coalesce_linger
+            # starts here) — gated so a disabled run allocates nothing.
+            stamp = telemetry.enabled()
             lane = self._lanes[tenant]
-            batch = [lane.popleft()]
+            head = lane.popleft()
+            if stamp:
+                head.t_pop = time.monotonic()
+            batch = [head]
             self._depth -= 1
-            self._take_same_key_locked(lane, batch, max_coalesce)
+            self._take_same_key_locked(lane, batch, max_coalesce, stamp)
             if window_s > 0:
                 end = time.monotonic() + window_s
                 while len(batch) < max_coalesce and not self._closed:
@@ -251,7 +267,8 @@ class AdmissionQueue:
                     lane = self._lanes.get(tenant)
                     if lane is None:
                         break
-                    self._take_same_key_locked(lane, batch, max_coalesce)
+                    self._take_same_key_locked(lane, batch, max_coalesce,
+                                               stamp)
             self._settle_lane_locked(tenant)
             return batch
 

@@ -330,13 +330,40 @@ def _decode(entry, out):
     return out
 
 
-def _finish_ok(entry, out, batch_size, bucket, t_exec_ms, registry=None):
+def _stamp_phases(entry, phase_info) -> None:
+    """Finalize the phase clock for one traced entry: the stamps form a
+    contiguous monotonic chain (admit → pop → take → exec start → exec
+    end → here), so the phases sum to the end-to-end latency by
+    construction; ``plan_compile`` is carved out of the executor wall
+    time via the plan-cache compile-seconds delta."""
+    t0m, t1m, compile_ms = phase_info
+    t_done = time.monotonic()
+    exec_ms = (t1m - t0m) * 1e3
+    p = entry.phases
+    t_take = p.pop("_t_take", t0m)
+    p["dispatch_queue"] = (t0m - t_take) * 1e3
+    p["plan_compile"] = min(max(compile_ms, 0.0), exec_ms)
+    p["device_execute"] = exec_ms - p["plan_compile"]
+    p["depad_serialize"] = (t_done - t1m) * 1e3
+    entry.phases = None  # consumed — a solo retry would restamp fresh
+    phases = {k: round(v, 4) for k, v in p.items()}
+    entry.trace["phases"] = phases
+    if entry.t_admit is not None:
+        entry.trace["e2e_ms"] = round((t_done - entry.t_admit) * 1e3, 4)
+    for k, v in phases.items():
+        telemetry.observe_phase(k, v)
+
+
+def _finish_ok(entry, out, batch_size, bucket, t_exec_ms, registry=None,
+               phase_info=None):
     entry.trace.update(
         batch_size=batch_size,
         bucket=bucket,
         coalesced=batch_size > 1,
         exec_ms=round(t_exec_ms, 4),
     )
+    if entry.phases is not None and phase_info is not None:
+        _stamp_phases(entry, phase_info)
     if entry.counter_base is not None:
         entry.trace["counter_base"] = entry.counter_base
     if entry.entity is not None:
@@ -415,6 +442,16 @@ def run_batch(registry, entries, device=None) -> None:
 def _dispatch(registry, entries, device=None) -> None:
     executor = _EXECUTORS[entries[0].op]
     n = len(entries)
+    # Phase clock: only when the worker armed at least one entry (traced
+    # request with SKYLARK_PHASES on) — otherwise not even a timestamp.
+    phase_t0 = (
+        time.monotonic()
+        if any(e.phases is not None for e in entries)
+        else None
+    )
+    compile_before = (
+        plans.stats()["compile_seconds"] if phase_t0 is not None else 0.0
+    )
     t0 = time.perf_counter()
     try:
         outs, bucket = executor(registry, entries, device)
@@ -436,6 +473,15 @@ def _dispatch(registry, entries, device=None) -> None:
             run_batch(registry, [e2], device)
         return
     t_ms = (time.perf_counter() - t0) * 1e3
+    phase_info = None
+    if phase_t0 is not None:
+        # Executor wall time is device time: every executor lands its
+        # result via np.asarray, which blocks until the device is done.
+        phase_info = (
+            phase_t0,
+            time.monotonic(),
+            (plans.stats()["compile_seconds"] - compile_before) * 1e3,
+        )
     for entry, out in zip(entries, outs):
         if not _result_finite(out):
             if n > 1:
@@ -467,4 +513,5 @@ def _dispatch(registry, entries, device=None) -> None:
                 n,
             )
             continue
-        _finish_ok(entry, _decode(entry, out), n, bucket, t_ms, registry)
+        _finish_ok(entry, _decode(entry, out), n, bucket, t_ms, registry,
+                   phase_info)

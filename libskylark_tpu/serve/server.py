@@ -51,27 +51,44 @@ from .registry import Registry
 
 __all__ = ["ServeParams", "Server", "latency_percentiles", "record_latency"]
 
-# Process-wide latency reservoir (most recent completions) feeding the
-# p50/p99 in telemetry.snapshot()["serve"]; the registry's histograms
-# keep only streaming moments, so the tails need their own samples.
-# Appended ONLY when telemetry is enabled — a disabled run allocates
-# nothing here.
-_LATENCIES: deque[float] = deque(maxlen=4096)
+# Process-wide latency reservoir (most recent completions AND sheds)
+# feeding the p50/p99 in telemetry.snapshot()["serve"]; the registry's
+# histograms keep only streaming moments, so the tails need their own
+# samples.  Shed requests record their queue time with ``shed=True`` —
+# otherwise saturation, the one regime where sheds dominate, is exactly
+# when the reservoir would flatter p99 by dropping them.  Appended ONLY
+# when telemetry is enabled — a disabled run allocates nothing here.
+_LATENCIES: deque[tuple[float, bool]] = deque(maxlen=4096)
 
 
-def record_latency(ms: float) -> None:
+def record_latency(ms: float, shed: bool = False) -> None:
     if telemetry.enabled():
-        _LATENCIES.append(float(ms))
+        _LATENCIES.append((float(ms), bool(shed)))
 
 
 def latency_percentiles() -> dict:
+    """p50/p99 over ALL samples (sheds included), plus ``_served``
+    variants excluding sheds and the shed sample count whenever any
+    shed is in the window — so both views are always computable."""
     if not _LATENCIES:
         return {}
-    lat = np.sort(np.asarray(_LATENCIES))
-    return {
+    samples = list(_LATENCIES)
+    lat = np.sort(np.asarray([m for m, _ in samples]))
+    out = {
         "latency_p50_ms": round(float(np.percentile(lat, 50)), 4),
         "latency_p99_ms": round(float(np.percentile(lat, 99)), 4),
     }
+    served = np.asarray([m for m, s in samples if not s])
+    shed_n = len(samples) - served.size
+    if shed_n:
+        out["latency_shed_samples"] = int(shed_n)
+        if served.size:
+            served = np.sort(served)
+            out["latency_p50_ms_served"] = round(
+                float(np.percentile(served, 50)), 4)
+            out["latency_p99_ms_served"] = round(
+                float(np.percentile(served, 99)), 4)
+    return out
 
 
 @dataclass
@@ -204,6 +221,13 @@ class Server:
             len(self._metric_tenants),
             int(os.environ.get("SKYLARK_QOS_TENANT_METRICS_MAX", "32")),
         )
+        # Bucket registration for the phase clock + the serve latency
+        # histogram: configuration, not data (registration is free and
+        # survives telemetry.reset()), so the fleet's _bucket{le=...}
+        # series exist from the first traced request onward.  Non-serve
+        # processes never call this, so their histograms stay moment-only.
+        telemetry.enable_phase_buckets()
+        telemetry.enable_buckets("serve.latency_ms")
         self.warm_summary: dict | None = None
         self.primed: list[str] = []
         self._thread: threading.Thread | None = None
@@ -450,6 +474,9 @@ class Server:
                 ms = (time.monotonic() - t_hit) * 1e3
                 telemetry.observe("serve.latency_ms", ms)
                 record_latency(ms)
+                telemetry.observe_slo(
+                    entry.op, ms, tenant=entry.tenant_label
+                )
                 self._tenant_observe(entry.tenant_label, ms)
                 fut.set_result(
                     protocol.ok_response(request.get("id"), hit, entry.trace)
@@ -500,6 +527,13 @@ class Server:
                 telemetry.error_event("serve.admission", e, op=entry.op)
             telemetry.finish_trace(
                 entry.tctx, "shed_admission", code=e.code
+            )
+            # Door sheds spend ~0ms queued, but they still count:
+            # excluding them is what flattered p99 under saturation.
+            shed_ms = (time.monotonic() - t_hit) * 1e3
+            record_latency(shed_ms, shed=True)
+            telemetry.observe_slo(
+                entry.op, shed_ms, tenant=entry.tenant_label, shed=True
             )
             fut.set_result(
                 protocol.error_response(request.get("id"), e, entry.trace)
@@ -970,6 +1004,7 @@ class Server:
             if batch is None:
                 return
             now = time.monotonic()
+            phased = telemetry.phases_enabled()
             live = []
             for e in batch:
                 waited_ms = (now - e.t_admit) * 1e3
@@ -997,8 +1032,23 @@ class Server:
                             "serve.deadline", exc, op=e.op
                         )
                     self._resolve_error(e, exc, status="shed_deadline")
+                    # A deadline shed IS the saturation signal: its
+                    # queue time joins the reservoir flagged shed=True.
+                    record_latency(waited_ms, shed=True)
+                    telemetry.observe_slo(
+                        e.op, waited_ms, tenant=e.tenant_label, shed=True
+                    )
                     continue
                 telemetry.observe("serve.queue_ms", waited_ms)
+                if phased and e.tctx is not None and e.t_pop is not None:
+                    # Phase clock: the chained monotonic stamps make the
+                    # phases sum to the request's end-to-end latency by
+                    # construction (the batcher fills in the rest).
+                    e.phases = {
+                        "admit_wait": (e.t_pop - e.t_admit) * 1e3,
+                        "coalesce_linger": (now - e.t_pop) * 1e3,
+                        "_t_take": now,
+                    }
                 live.append(e)
             if not live:
                 continue
@@ -1021,7 +1071,13 @@ class Server:
                 ms = (done - e.t_admit) * 1e3
                 telemetry.observe("serve.latency_ms", ms)
                 record_latency(ms)
+                telemetry.observe_slo(e.op, ms, tenant=e.tenant_label)
                 self._tenant_observe(e.tenant_label, ms)
+            # Roll the time-series ring forward (lazy tick: a no-op
+            # until the window interval elapses, nothing when disabled).
+            telemetry.timeline_tick(
+                extra={"queue_depth": len(self.queue)}
+            )
 
     def _fold_key_stats(self, live, busy_s: float) -> None:
         """Per-placement-key throughput accounting, fed by every batch

@@ -25,6 +25,7 @@ torn registry (pinned by the concurrent-scrape test in
 from __future__ import annotations
 
 import json
+import os
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from .. import telemetry
@@ -151,6 +152,24 @@ class _Handler(BaseHTTPRequestHandler):
                 ),
                 CONTENT_TYPE,
             )
+        elif path == "/slo":
+            # Error-budget state for every declared objective (empty
+            # objectives dict when SKYLARK_SLO is unset — the endpoint
+            # answers either way so probes can distinguish "no SLOs"
+            # from "old replica without the endpoint").
+            self._send(200, {
+                "objectives": telemetry.slo_report(),
+                "slo_spec": os.environ.get("SKYLARK_SLO") or "",
+            })
+        elif path == "/timeline":
+            # Serving the ring also rolls it forward: an idle replica
+            # still closes windows when scraped.
+            queue = getattr(srv, "queue", None)
+            telemetry.timeline_tick(
+                extra={"queue_depth": len(queue)}
+                if queue is not None else None
+            )
+            self._send(200, telemetry.timeline_state())
         elif path == "/traces":
             if "drain=1" in query.split("&"):
                 self._send(200, telemetry.drain_traces())

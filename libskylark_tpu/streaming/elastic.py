@@ -491,10 +491,19 @@ def _handshake(
             np.asarray(multihost_utils.process_allgather(mine))
         )
 
+    # Straggler attribution: the allgather IS the barrier, so its wall
+    # time is this rank's wait-for-peers; the fastest-arriving rank
+    # waits longest and the straggler's own wait is ~0.
+    t_wait = time.monotonic() if telemetry.enabled() else None
     if watchdog is not None:
         theirs = watchdog.guard("handshake", _gather)
     else:
         theirs = _gather()
+    if t_wait is not None:
+        wait_ms = (time.monotonic() - t_wait) * 1e3
+        telemetry.observe_phase("collective_wait", wait_ms)
+        telemetry.set_gauge("collective.last_wait_ms", round(wait_ms, 4))
+        telemetry.set_gauge("collective.rank", rank)
     for r in range(theirs.shape[0]):
         if not np.array_equal(theirs[r], mine):
             raise WorldMismatchError(

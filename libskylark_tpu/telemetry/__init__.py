@@ -40,8 +40,25 @@ from .config import enabled, ledger_dir
 from .exposition import prometheus_text
 from .fleet import fleet_snapshot, fold_ledgers, merge_snapshots
 from .ledger import close, configure, emit, event, flush, ledger_path
-from .registry import LOCK, REGISTRY, Registry, inc, observe, reset, set_gauge
+from .phases import PHASES, enable_phase_buckets, observe_phase, phases_enabled
+from .registry import (
+    LOCK,
+    REGISTRY,
+    Registry,
+    enable_buckets,
+    inc,
+    observe,
+    reset,
+    set_gauge,
+)
 from .report import report, run_summary, snapshot
+from .slo import observe_slo, reset_slo, slo_report
+from .timeline import (
+    reset_timeline,
+    timeline_state,
+    timeline_tick,
+    timeline_windows,
+)
 from .spans import NOOP_SPAN, Span, span
 from .trace import (
     RECORDER,
@@ -75,7 +92,20 @@ __all__ = [
     "inc",
     "set_gauge",
     "observe",
+    "enable_buckets",
     "reset",
+    # phase clock + SLO engine + timeline ring
+    "PHASES",
+    "phases_enabled",
+    "observe_phase",
+    "enable_phase_buckets",
+    "observe_slo",
+    "slo_report",
+    "reset_slo",
+    "timeline_tick",
+    "timeline_windows",
+    "timeline_state",
+    "reset_timeline",
     "span",
     "Span",
     "NOOP_SPAN",

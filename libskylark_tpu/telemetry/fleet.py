@@ -66,12 +66,33 @@ def merge_snapshots(snaps: list[dict]) -> dict:
         for k, h in (snap.get("histograms") or {}).items():
             m = histograms.get(k)
             if m is None:
-                histograms[k] = dict(h)
+                m = dict(h)
+                if "buckets" in h:
+                    m["buckets"] = {
+                        "le": list(h["buckets"]["le"]),
+                        "counts": list(h["buckets"]["counts"]),
+                        "count": h["buckets"]["count"],
+                        "sum": h["buckets"]["sum"],
+                    }
+                histograms[k] = m
             else:
                 m["count"] += h["count"]
                 m["sum"] += h["sum"]
                 m["min"] = min(m["min"], h["min"])
                 m["max"] = max(m["max"], h["max"])
+                # Buckets merge in this PURE path only (the allgathered
+                # fleet vectors stay 4-row moments so cross-rank CRC
+                # signatures are untouched); mismatched bounds drop the
+                # buckets rather than sum misaligned bins.
+                bm, bh = m.get("buckets"), h.get("buckets")
+                if bm is not None:
+                    if bh is not None and list(bm["le"]) == list(bh["le"]):
+                        bm["counts"] = [a + b for a, b in
+                                        zip(bm["counts"], bh["counts"])]
+                        bm["count"] += bh["count"]
+                        bm["sum"] += bh["sum"]
+                    else:
+                        m.pop("buckets", None)
         for k, g in (snap.get("gauges") or {}).items():
             gauges_by_rank.setdefault(k, {})[rank] = g
         for k, v in (snap.get("plans") or {}).items():

@@ -160,6 +160,32 @@ def snapshot(fleet: bool = False, root=None) -> dict:
         # merges, escalations, repartitions, registered hand-offs) —
         # present only when a trainer ran.
         snap["train"] = train
+    slo_counters = {
+        k.split(".", 1)[1]: v
+        for k, v in counters.items()
+        if k.startswith("slo.") and not k.startswith("slo.budget_remaining")
+    }
+    if slo_counters or any(
+        k.startswith("slo.budget_remaining.") for k in snap["gauges"]
+    ):
+        # SLO error-budget state: counters (observed, breaches, burns,
+        # recoveries) plus the per-objective budget report — present
+        # only once an objective observed traffic.
+        from .slo import slo_report
+
+        snap["slo"] = slo_counters
+        objectives = slo_report()
+        if objectives:
+            snap["slo"]["objectives"] = objectives
+    timeline_counters = {
+        k.split(".", 1)[1]: v
+        for k, v in counters.items()
+        if k.startswith("timeline.")
+    }
+    if timeline_counters:
+        # Time-series ring counters (ticks) — present only once a
+        # window closed.
+        snap["timeline"] = timeline_counters
     return snap
 
 

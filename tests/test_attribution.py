@@ -1,0 +1,667 @@
+"""Latency attribution, SLO error budgets, and the timeline ring
+(ISSUE PR 20).
+
+The acceptance contracts pinned here:
+
+- A traced request's ``trace["phases"]`` decomposition sums to within
+  10% of its own ``e2e_ms`` (the phases are consecutive diffs of one
+  monotonic timestamp chain, so they tile the wall by construction).
+- ``/metrics`` exposes real Prometheus 0.0.4 histogram families —
+  cumulative ``_bucket{le="..."}`` rows with monotone counts, the
+  ``+Inf`` bucket equal to ``_count`` — and the whole body survives a
+  strict parse (name charset, TYPE-before-samples, two tokens a line).
+- An induced slow-tenant drill drives ``slo.budget_remaining`` below
+  the burn threshold and lands a ledgered ``slo_burn`` violation in the
+  flight recorder's violations ring.
+- ``SKYLARK_TELEMETRY=0`` runs bit-identical with zero phase-clock
+  allocations; ``SKYLARK_PHASES=0`` keeps tracing hot but stamps no
+  phases (the bench isolation knob).
+- Distinct raw metric names that sanitize identically stay distinct on
+  the wire (hash suffix), and per-tenant counters ride ONE family with
+  a ``tenant`` label.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from libskylark_tpu import serve, telemetry
+from libskylark_tpu.core.context import SketchContext
+from libskylark_tpu.serve import server as server_mod
+from libskylark_tpu.telemetry import timeline as timeline_mod
+from libskylark_tpu.telemetry.fleet import merge_snapshots
+from libskylark_tpu.telemetry.phases import PHASES
+
+pytestmark = pytest.mark.trace
+
+M, N = 64, 5
+_rng = np.random.default_rng(777)
+A = _rng.standard_normal((M, N))
+
+
+@pytest.fixture
+def traced(monkeypatch):
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "1")
+    monkeypatch.delenv("SKYLARK_TRACE", raising=False)
+    monkeypatch.delenv("SKYLARK_PHASES", raising=False)
+    monkeypatch.delenv("SKYLARK_SLO", raising=False)
+    telemetry.reset()
+    telemetry.drain_traces()
+    telemetry.reset_slo()
+    telemetry.reset_timeline()
+    server_mod._LATENCIES.clear()
+    yield
+    telemetry.reset()
+    telemetry.drain_traces()
+    telemetry.reset_slo()
+    telemetry.reset_timeline()
+    server_mod._LATENCIES.clear()
+
+
+def _ls_server(**kw):
+    params = serve.ServeParams(
+        max_coalesce=kw.pop("max_coalesce", 4),
+        max_queue=kw.pop("max_queue", 256),
+        warm_start=False,
+        prime=False,
+        **kw,
+    )
+    srv = serve.Server(params, seed=7)
+    srv.registry.register_system("sys", A, context=SketchContext(seed=3))
+    return srv
+
+
+def _fresh_req():
+    return serve.make_request(
+        "ls_solve", system="sys", b=_rng.standard_normal(M)
+    )
+
+
+# ---------------------------------------------------------------------------
+# the phase clock
+
+
+def test_phase_clock_sums_to_e2e(traced):
+    srv = _ls_server().start()
+    try:
+        srv.call(_fresh_req())  # warm: the measured request won't compile
+        r = srv.call(_fresh_req())
+    finally:
+        srv.stop()
+    assert r["ok"]
+    phases = r["trace"]["phases"]
+    serve_phases = [p for p in PHASES if p != "collective_wait"]
+    assert sorted(phases) == sorted(serve_phases)
+    assert all(v >= 0 for v in phases.values()), phases
+    e2e = r["trace"]["e2e_ms"]
+    assert e2e > 0
+    # THE acceptance contract: the decomposition tiles the wall
+    assert abs(sum(phases.values()) - e2e) / e2e <= 0.10, (phases, e2e)
+    # each phase also landed on its bucketed histogram
+    hists = telemetry.REGISTRY.snapshot()["histograms"]
+    for p in serve_phases:
+        h = hists[f"phase.{p}_ms"]
+        assert h["count"] >= 1
+        assert "buckets" in h
+
+
+def test_phases_gate_keeps_tracing_hot(traced, monkeypatch):
+    monkeypatch.setenv("SKYLARK_PHASES", "0")
+    srv = _ls_server().start()
+    try:
+        r = srv.call(_fresh_req())
+    finally:
+        srv.stop()
+    assert r["ok"]
+    assert r["trace"]["trace_id"]  # tracing still on
+    assert "phases" not in r["trace"]
+    hists = telemetry.REGISTRY.snapshot()["histograms"]
+    assert not any(k.startswith("phase.") for k in hists)
+
+
+def test_disabled_run_allocates_no_phase_state(monkeypatch):
+    monkeypatch.setenv("SKYLARK_TELEMETRY", "0")
+    telemetry.reset()
+    server_mod._LATENCIES.clear()
+    srv = _ls_server().start()
+    try:
+        r = srv.call(_fresh_req())
+    finally:
+        srv.stop()
+    assert r["ok"]
+    assert "trace_id" not in r["trace"]
+    assert "phases" not in r["trace"]
+    snap = telemetry.REGISTRY.snapshot()
+    assert snap["counters"] == {} and snap["histograms"] == {}
+    assert len(server_mod._LATENCIES) == 0
+    assert telemetry.timeline_windows() == []
+
+
+def test_cache_hit_carries_no_phases(traced):
+    srv = _ls_server(cache=True).start()
+    b = _rng.standard_normal(M)
+    try:
+        r1 = srv.call(serve.make_request("ls_solve", system="sys", b=b))
+        r2 = srv.call(serve.make_request("ls_solve", system="sys", b=b))
+    finally:
+        srv.stop()
+    assert "phases" in r1["trace"]
+    assert r2["trace"].get("cache_hit") is True
+    assert "phases" not in r2["trace"]
+
+
+def test_observe_phase_registers_buckets(traced):
+    telemetry.observe_phase("collective_wait", 3.0)
+    h = telemetry.REGISTRY.snapshot()["histograms"][
+        "phase.collective_wait_ms"
+    ]
+    assert h["count"] == 1
+    assert h["buckets"]["count"] == 1
+    assert sum(h["buckets"]["counts"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# bucketed histograms in the registry
+
+
+def test_enable_buckets_counts_and_inf_overflow(traced):
+    telemetry.enable_buckets("t.ms", (1.0, 10.0, 100.0))
+    for v in (0.5, 10.0, 50.0, 5000.0):
+        telemetry.observe("t.ms", v)
+    b = telemetry.REGISTRY.snapshot()["histograms"]["t.ms"]["buckets"]
+    assert b["le"] == [1.0, 10.0, 100.0]
+    # le semantics: 10.0 lands IN the le=10 bucket; 5000 overflows +Inf
+    assert b["counts"] == [1, 1, 1, 1]
+    assert b["count"] == 4
+    assert b["sum"] == pytest.approx(5060.5)
+
+
+def test_bucket_bounds_survive_reset(traced):
+    telemetry.enable_buckets("t.ms", (1.0, 10.0))
+    telemetry.observe("t.ms", 5.0)
+    telemetry.reset()
+    telemetry.observe("t.ms", 0.5)  # bounds are config, counts are data
+    b = telemetry.REGISTRY.snapshot()["histograms"]["t.ms"]["buckets"]
+    assert b["counts"] == [1, 0, 0] and b["count"] == 1
+
+
+def test_bucket_quantile_upper_bound():
+    le = [1.0, 10.0, 100.0]
+    assert timeline_mod.bucket_quantile(le, [0, 0, 0, 0], 0.99) is None
+    assert timeline_mod.bucket_quantile(le, [100, 0, 0, 0], 0.5) == 1.0
+    assert timeline_mod.bucket_quantile(le, [50, 48, 2, 0], 0.99) == 100.0
+    # overflow bucket reports the last finite bound
+    assert timeline_mod.bucket_quantile(le, [0, 0, 0, 5], 0.99) == 100.0
+
+
+# ---------------------------------------------------------------------------
+# exposition: collisions, tenant labels, strict 0.0.4
+
+
+def test_colliding_raw_names_stay_distinct(traced):
+    telemetry.inc("col.a.b", 2)
+    telemetry.inc("col.a_b", 3)
+    text = telemetry.prometheus_text()
+    rows = {
+        line.split()[0]: line.split()[1]
+        for line in text.splitlines()
+        if line.startswith("skylark_col_a_b")
+    }
+    # both raws export, under DIFFERENT hash-suffixed names, and the
+    # unsuffixed collision name is gone entirely
+    assert len(rows) == 2
+    assert "skylark_col_a_b_total" not in rows
+    assert sorted(int(v) for v in rows.values()) == [2, 3]
+    for name in rows:
+        assert re.fullmatch(r"skylark_col_a_b_[0-9a-f]{6}_total", name)
+
+
+def test_tenant_counters_export_as_labels(traced):
+    telemetry.inc("serve.tenant.a-b.requests", 2)
+    telemetry.inc("serve.tenant.a.b.requests", 3)
+    text = telemetry.prometheus_text()
+    assert 'skylark_serve_tenant_requests_total{tenant="a-b"} 2' in text
+    assert 'skylark_serve_tenant_requests_total{tenant="a.b"} 3' in text
+    assert (
+        text.count("# TYPE skylark_serve_tenant_requests_total counter")
+        == 1
+    )
+
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+def _strict_parse(text):
+    """Strict Prometheus text-format 0.0.4 parse: returns
+    ``(types, samples)`` or asserts with the offending line."""
+    types: dict = {}
+    sampled: set = set()
+    samples: list = []
+    for line in text.splitlines():
+        assert line == line.rstrip(), repr(line)
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            assert parts[0] == "#" and parts[1] == "TYPE", line
+            fam, kind = parts[2], parts[3]
+            assert _NAME_RE.match(fam), line
+            assert kind in (
+                "counter", "gauge", "histogram", "summary", "untyped"
+            ), line
+            assert fam not in types, f"duplicate TYPE: {line}"
+            assert fam not in sampled, f"TYPE after samples: {line}"
+            types[fam] = kind
+            continue
+        toks = line.split()
+        assert len(toks) == 2, line
+        namelab, val = toks
+        name, brace, labels = namelab.partition("{")
+        assert _NAME_RE.match(name), line
+        if brace:
+            assert labels.endswith("}"), line
+            labels = labels[:-1]
+            for part in labels.split(","):
+                m = re.fullmatch(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"',
+                                 part)
+                assert m, line
+        float(val)  # must parse (inf/nan spellings included)
+        # every sample belongs to a family whose TYPE line came first
+        fam = name
+        if fam not in types:
+            for suffix in ("_bucket", "_count", "_sum"):
+                if name.endswith(suffix):
+                    fam = name[: -len(suffix)]
+                    break
+        assert fam in types, f"sample without TYPE: {line}"
+        sampled.add(fam)
+        samples.append((fam, name, labels if brace else "", float(val)))
+    return types, samples
+
+
+def _histogram_families_check(types, samples):
+    checked = 0
+    for fam, kind in types.items():
+        if kind != "histogram":
+            continue
+        buckets = []
+        count = total = None
+        for f, name, labels, val in samples:
+            if f != fam:
+                continue
+            if name == fam + "_bucket":
+                m = re.search(r'le="([^"]+)"', labels)
+                assert m, (fam, labels)
+                buckets.append((float(m.group(1)), val))
+            elif name == fam + "_count":
+                count = val
+            elif name == fam + "_sum":
+                total = val
+        assert buckets and count is not None and total is not None, fam
+        les = [le for le, _ in buckets]
+        assert les == sorted(les) and len(set(les)) == len(les), fam
+        cum = [c for _, c in buckets]
+        assert all(a <= b for a, b in zip(cum, cum[1:])), (fam, cum)
+        assert les[-1] == float("inf"), fam
+        assert cum[-1] == count, (fam, cum[-1], count)
+        checked += 1
+    return checked
+
+
+@pytest.mark.serve
+def test_metrics_strict_prometheus_004_under_traffic(traced, monkeypatch):
+    monkeypatch.setenv("SKYLARK_SLO", "ls_solve:5000:99")
+    srv = _ls_server().start()
+    httpd = serve.serve_http(srv)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        failures = []
+        stop = threading.Event()
+
+        def scrape():
+            while not stop.is_set():
+                try:
+                    with urllib.request.urlopen(
+                        base + "/metrics", timeout=10
+                    ) as resp:
+                        _strict_parse(resp.read().decode())
+                except Exception as e:  # noqa: BLE001 — collected
+                    failures.append(repr(e))
+                    return
+
+        t = threading.Thread(target=scrape, daemon=True)
+        t.start()
+        results = [srv.call(_fresh_req()) for _ in range(12)]
+        stop.set()
+        t.join(timeout=10)
+        assert not failures, failures
+        assert all(r["ok"] for r in results)
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+    finally:
+        httpd.shutdown()
+        srv.stop()
+    types, samples = _strict_parse(text)
+    # at least the serve latency + phase histograms expose real buckets
+    assert _histogram_families_check(types, samples) >= 2
+    assert "skylark_serve_latency_ms_bucket" in text
+    assert 'skylark_slo_budget_remaining{objective="ls_solve"}' in text
+
+
+# ---------------------------------------------------------------------------
+# the SLO engine
+
+
+@pytest.mark.serve
+def test_slo_burn_drill_lands_in_violations_ring(traced, monkeypatch):
+    # an impossible threshold: every request breaches, the budget burns
+    monkeypatch.setenv("SKYLARK_SLO", "ls_solve:0.0001:99")
+    srv = _ls_server().start()
+    httpd = serve.serve_http(srv)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        for _ in range(12):
+            assert srv.call(_fresh_req())["ok"]
+        with urllib.request.urlopen(base + "/slo", timeout=10) as resp:
+            endpoint = json.loads(resp.read())
+    finally:
+        httpd.shutdown()
+        srv.stop()
+
+    report = telemetry.slo_report()["ls_solve"]
+    assert report["bad"] == report["window"] >= 12
+    assert report["budget_remaining"] < 0.25
+    assert report["burning"] is True
+    snap = telemetry.snapshot()
+    assert snap["slo"]["burns"] == 1  # edge-triggered: ONE incident
+    assert snap["slo"]["breaches"] >= 12
+    assert snap["gauges"]["slo.budget_remaining.ls_solve"] < 0.25
+    # the minted slo_burn violation is in the recorder's violations ring
+    burns = [
+        t for t in telemetry.trace_ids()["violations"]
+        if t.startswith("slo-burn-")
+    ]
+    assert len(burns) == 1
+    payload = telemetry.get_trace(burns[0])
+    assert payload["op"] == "slo_burn" and payload["slo"] == "ls_solve"
+    assert payload["budget_remaining"] < 0.25
+    # the endpoint serves the same state
+    assert endpoint["slo_spec"] == "ls_solve:0.0001:99"
+    assert endpoint["objectives"]["ls_solve"]["burning"] is True
+
+
+def test_slo_tenant_scoping_and_parse_errors(traced, monkeypatch):
+    monkeypatch.setenv(
+        "SKYLARK_SLO", "bogus,ls_solve@acme:0.0001:99,also:bad"
+    )
+    # default-tenant traffic never touches the acme-scoped objective
+    telemetry.observe_slo("ls_solve", 100.0, tenant="default")
+    assert telemetry.slo_report()["ls_solve@acme"]["window"] == 0
+    telemetry.observe_slo("ls_solve", 100.0, tenant="acme")
+    report = telemetry.slo_report()
+    assert list(report) == ["ls_solve@acme"]  # malformed entries skipped
+    assert report["ls_solve@acme"]["bad"] == 1
+    counters = telemetry.REGISTRY.snapshot()["counters"]
+    assert counters["slo.parse_errors"] >= 2
+
+
+def test_slo_sheds_always_breach(traced, monkeypatch):
+    monkeypatch.setenv("SKYLARK_SLO", "ls_solve:1000000:50")
+    telemetry.observe_slo("ls_solve", 0.1)
+    telemetry.observe_slo("ls_solve", 0.1, shed=True)
+    report = telemetry.slo_report()["ls_solve"]
+    assert report["window"] == 2 and report["bad"] == 1
+
+
+# ---------------------------------------------------------------------------
+# the shed-aware latency reservoir
+
+
+def test_latency_reservoir_records_sheds(traced):
+    server_mod.record_latency(1.0)
+    server_mod.record_latency(2.0)
+    server_mod.record_latency(500.0, shed=True)
+    pct = server_mod.latency_percentiles()
+    assert pct["latency_shed_samples"] == 1
+    assert pct["latency_p99_ms"] > 400  # sheds dominate the full view
+    assert pct["latency_p99_ms_served"] <= 2.0  # …and vanish from served
+
+
+@pytest.mark.serve
+def test_admission_shed_lands_in_reservoir(traced):
+    srv = _ls_server(max_queue=1, max_coalesce=1)
+    futures = [srv.submit(_fresh_req()) for _ in range(8)]
+    srv.start()
+    results = [f.result() for f in futures]
+    srv.stop()
+    sheds = [r for r in results if not r["ok"]]
+    assert sheds and all(
+        r["error"]["code"] == 112 for r in sheds
+    )
+    pct = server_mod.latency_percentiles()
+    assert pct["latency_shed_samples"] == len(sheds)
+    assert "latency_p50_ms_served" in pct
+
+
+# ---------------------------------------------------------------------------
+# the timeline ring
+
+
+def test_timeline_windows_and_derived_series(traced, monkeypatch):
+    assert telemetry.timeline_tick() is False  # first tick baselines
+    telemetry.inc("serve.requests", 10)
+    telemetry.inc("serve.cache.hit", 3)
+    telemetry.inc("serve.cache.miss", 1)
+    assert telemetry.timeline_tick(
+        extra={"queue_depth": 7}, force=True
+    ) is True
+    (w,) = telemetry.timeline_windows()
+    assert w["counters"]["serve.requests"] == 10
+    assert w["dt_s"] >= 0
+    assert w["derived"]["qps"] > 0
+    assert w["derived"]["cache_hit_rate"] == 0.75
+    assert w["derived"]["queue_depth"] == 7
+    assert telemetry.REGISTRY.snapshot()["counters"]["timeline.ticks"] == 1
+
+    # deltas, not totals: a quiet window shows zero requests
+    assert telemetry.timeline_tick(force=True) is True
+    w2 = telemetry.timeline_windows()[-1]
+    assert "serve.requests" not in w2["counters"]
+
+    # the ring is bounded by the capacity knob
+    monkeypatch.setenv("SKYLARK_TIMELINE_CAPACITY", "2")
+    for _ in range(4):
+        telemetry.timeline_tick(force=True)
+    assert len(telemetry.timeline_windows()) == 2
+
+
+def test_timeline_interval_gates_lazy_ticks(traced, monkeypatch):
+    monkeypatch.setenv("SKYLARK_TIMELINE_INTERVAL_S", "3600")
+    telemetry.timeline_tick()  # baseline
+    assert telemetry.timeline_tick() is False  # interval not elapsed
+    monkeypatch.setenv("SKYLARK_TIMELINE_INTERVAL_S", "0.05")
+    time.sleep(0.06)
+    assert telemetry.timeline_tick() is True
+
+
+@pytest.mark.serve
+def test_timeline_endpoint_rolls_the_ring(traced, monkeypatch):
+    monkeypatch.setenv("SKYLARK_TIMELINE_INTERVAL_S", "0.05")
+    srv = _ls_server().start()
+    httpd = serve.serve_http(srv)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        srv.call(_fresh_req())
+        time.sleep(0.06)
+        srv.call(_fresh_req())  # the worker loop ticks past the interval
+        time.sleep(0.06)
+        with urllib.request.urlopen(base + "/timeline", timeout=10) as r:
+            state = json.loads(r.read())
+    finally:
+        httpd.shutdown()
+        srv.stop()
+    assert state["capacity"] == 120
+    assert state["windows"], "scraping /timeline closes a window"
+    assert "derived" in state["windows"][-1]
+
+
+# ---------------------------------------------------------------------------
+# fleet merge of bucketed histograms
+
+
+def test_merge_snapshots_sums_matching_buckets():
+    def snap(counts, count, total):
+        return {
+            "counters": {},
+            "gauges": {},
+            "histograms": {
+                "h": {
+                    "count": count, "sum": total, "min": 1.0, "max": 9.0,
+                    "buckets": {"le": [1.0, 10.0], "counts": counts,
+                                "count": count, "sum": total},
+                }
+            },
+        }
+
+    merged = merge_snapshots([snap([1, 2, 0], 3, 6.0),
+                              snap([0, 1, 1], 2, 15.0)])
+    b = merged["histograms"]["h"]["buckets"]
+    assert b["counts"] == [1, 3, 1]
+    assert b["count"] == 5 and b["sum"] == 21.0
+
+    # mismatched bounds DROP the buckets instead of summing misaligned
+    other = snap([4, 4], 8, 1.0)
+    other["histograms"]["h"]["buckets"]["le"] = [5.0]
+    merged = merge_snapshots([snap([1, 2, 0], 3, 6.0), other])
+    assert "buckets" not in merged["histograms"]["h"]
+    assert merged["histograms"]["h"]["count"] == 11  # moments still fold
+
+
+# ---------------------------------------------------------------------------
+# skylark-top: SLO panel + sparklines, degradation-safe
+
+
+def test_top_slo_and_timeline_panels_render():
+    from libskylark_tpu.cli import top
+
+    assert top._slo_lines({"_error": "boom"}) == ["  slo: n/a"]
+    assert top._slo_lines({"objectives": {}}) == []
+    lines = top._slo_lines({"objectives": {
+        "ls_solve": {"threshold_ms": 5.0, "target_pct": 99.0,
+                     "window": 64, "bad": 3, "budget_remaining": -3.7,
+                     "burning": True},
+    }})
+    assert any("BURNING" in ln for ln in lines)
+
+    assert top._timeline_lines({"_error": "x"}) == ["  timeline: n/a"]
+    assert top._timeline_lines({"windows": []}) == [
+        "  timeline: (no windows yet)"
+    ]
+    lines = top._timeline_lines({
+        "interval_s": 5.0,
+        "windows": [
+            {"derived": {"qps": float(q), "p99_ms": 1.0,
+                         "queue_depth": 0, "cache_hit_rate": None}}
+            for q in range(6)
+        ],
+    })
+    assert any("qps" in ln and "▁" in ln for ln in lines)
+
+    assert top._spark([]) == "n/a"
+    assert top._spark([2, 2, 2]) == "▁▁▁"  # flat series, no div-by-zero
+
+
+def test_top_survives_malformed_slo_and_timeline(monkeypatch):
+    from libskylark_tpu.cli import top
+
+    shapes = {
+        "http://c/healthz": {"ok": True},
+        "http://c/stats": {"counters": {}},
+        "http://c/slo": {"objectives": "not-a-dict"},
+        "http://c/timeline": {"windows": [17, "junk", {"derived": None}]},
+    }
+    monkeypatch.setattr(
+        top, "_fetch_json",
+        lambda url, timeout=2.0: shapes.get(url, {"_error": "boom"}),
+    )
+    args = type(
+        "A", (), {"url": ["http://c"], "root": None, "telemetry_dir": None}
+    )()
+    status = {}
+    frame = top.render_frame(args, status)
+    assert status["answered"] == 1
+    assert "serve http://c" in frame  # rendered, did not crash
+
+    # an older replica: /slo and /timeline 404 into _error → n/a panels
+    monkeypatch.setattr(
+        top, "_fetch_json",
+        lambda url, timeout=2.0: (
+            {"ok": True} if url.endswith(("/healthz", "/stats"))
+            else {"_error": "HTTP Error 404"}
+        ),
+    )
+    frame = top.render_frame(args, {})
+    assert "slo: n/a" in frame and "timeline: n/a" in frame
+
+
+# ---------------------------------------------------------------------------
+# static doc contracts
+
+
+def _docs_text():
+    import pathlib
+
+    root = pathlib.Path(__file__).parent.parent
+    return (root / "docs" / "observability.md").read_text(encoding="utf-8")
+
+
+def test_every_phase_name_documented():
+    docs = _docs_text()
+    for phase in PHASES:
+        assert f"`{phase}`" in docs, (
+            f"phase {phase!r} has no row in docs/observability.md"
+        )
+
+
+def test_every_slo_and_timeline_counter_documented():
+    import pathlib
+
+    tel = pathlib.Path(__file__).parent.parent / "libskylark_tpu" / (
+        "telemetry"
+    )
+    minted = set()
+    for mod in ("slo.py", "timeline.py"):
+        src = (tel / mod).read_text(encoding="utf-8")
+        minted.update(
+            re.findall(r'inc\("((?:slo|timeline)\.[a-z_]+)"', src)
+        )
+    assert minted >= {"slo.burns", "timeline.ticks"}, minted
+    docs = _docs_text()
+    missing = sorted(
+        c for c in minted if f"`{c}`" not in docs and c not in docs
+    )
+    assert not missing, (
+        f"counters minted but undocumented in docs/observability.md: "
+        f"{missing}"
+    )
+
+
+def test_slo_and_timeline_knobs_documented():
+    docs = _docs_text()
+    for knob in (
+        "SKYLARK_PHASES",
+        "SKYLARK_SLO",
+        "SKYLARK_SLO_WINDOW",
+        "SKYLARK_SLO_BURN",
+        "SKYLARK_TIMELINE_INTERVAL_S",
+        "SKYLARK_TIMELINE_CAPACITY",
+    ):
+        assert knob in docs

@@ -262,7 +262,11 @@ def test_tenant_stamped_and_folded_into_telemetry(monkeypatch):
         )
         assert snap["serve"]["cache_hit_rate"] is not None
         text = telemetry.prometheus_text()
-        assert "skylark_serve_tenant_acme_requests_total 2" in text
+        # per-tenant counters export as ONE family with a tenant label
+        # (PR 20: distinct raw tenants must stay distinct on the wire)
+        assert (
+            'skylark_serve_tenant_requests_total{tenant="acme"} 2' in text
+        )
         assert "skylark_serve_cache_hit_total 2" in text
     finally:
         telemetry.REGISTRY.reset()
